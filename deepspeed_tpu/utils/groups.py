@@ -1,0 +1,179 @@
+"""Named-axis device mesh factory — the process-group layer.
+
+TPU-native rebuild of ``deepspeed/utils/groups.py`` (``initialize`` :74,
+``initialize_model_parallel`` :132, ``initialize_expert_parallel`` :183,
+getters :371-515). Where the reference creates torch.distributed process
+groups for every (data, model, expert) scenario, here there is ONE
+:class:`jax.sharding.Mesh` whose named axes *are* the groups:
+
+    axes = ("pipe", "data", "expert", "model")
+
+* ``data``    — ZeRO / data parallelism (reference DP group)
+* ``model``   — tensor (megatron-style) model parallelism (reference MP)
+* ``pipe``    — pipeline stages (reference PipeModelDataParallelTopology)
+* ``expert``  — expert parallelism; carved out of the DP dimension exactly
+  like the reference (expert_parallel_size divides the DP world,
+  groups.py:20-48 docstring scenarios D / E+D / M / E+D+M).
+
+A collective "over group G" is simply an XLA collective bound to that axis
+name; XLA routes it over ICI/DCN. The expert-data-parallel group (the DP
+group *between* expert replicas) is the ("expert","data") axis pair minus
+the expert axis — i.e. collectives over "data" alone.
+"""
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from deepspeed_tpu.utils.logging import log_dist
+
+# Canonical axis order: pipe outermost (crosses DCN first), then the
+# data/expert block, then model innermost (model-parallel collectives are the
+# most latency-sensitive, so they get the fastest ICI neighbours).
+PIPE_AXIS = "pipe"
+DATA_AXIS = "data"
+EXPERT_AXIS = "expert"
+MODEL_AXIS = "model"
+MESH_AXES = (PIPE_AXIS, DATA_AXIS, EXPERT_AXIS, MODEL_AXIS)
+
+# Module state (the analogue of the reference's _DATA_PARALLEL_GROUP etc.)
+_MESH: Optional[Mesh] = None
+_EXPERT_PARALLEL_SIZE = 1
+_MODEL_PARALLEL_SIZE = 1
+_PIPE_PARALLEL_SIZE = 1
+
+
+def _check_initialized():
+    assert _MESH is not None, "device mesh is not initialized; call groups.initialize()"
+
+
+def mesh_is_initialized():
+    return _MESH is not None
+
+
+def initialize(ep_size: int = 1,
+               mp_size: int = 1,
+               pp_size: int = 1,
+               devices: Optional[Sequence] = None,
+               mpu=None):
+    """Build the global mesh. Mirrors groups.initialize(ep_size, mpu).
+
+    The device count must factor as pp * dp * mp with ep dividing dp.
+    When *mpu* (a Megatron-style model-parallel unit) is given, its model
+    parallel size is honoured, mirroring initialize_model_and_expert_parallel
+    (groups.py:270).
+    """
+    global _MESH, _EXPERT_PARALLEL_SIZE, _MODEL_PARALLEL_SIZE, _PIPE_PARALLEL_SIZE
+
+    if mpu is not None:
+        mp_size = mpu.get_model_parallel_world_size()
+
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    assert n % (mp_size * pp_size) == 0, (
+        f"device count {n} not divisible by mp_size*pp_size = {mp_size * pp_size}")
+    dp_size = n // (mp_size * pp_size)
+    assert dp_size % ep_size == 0, (
+        f"data-parallel world {dp_size} not divisible by expert-parallel size {ep_size}")
+
+    dev_array = np.asarray(devices).reshape(
+        pp_size, dp_size // ep_size, ep_size, mp_size)
+    _MESH = Mesh(dev_array, MESH_AXES)
+    _EXPERT_PARALLEL_SIZE = ep_size
+    _MODEL_PARALLEL_SIZE = mp_size
+    _PIPE_PARALLEL_SIZE = pp_size
+    log_dist(
+        f"initialized mesh: pipe={pp_size} data={dp_size // ep_size} "
+        f"expert={ep_size} model={mp_size} over {n} devices", ranks=[0])
+    return _MESH
+
+
+def initialize_model_parallel(model_parallel_size: int):
+    """Parity with groups.initialize_model_parallel (groups.py:132)."""
+    return initialize(mp_size=model_parallel_size)
+
+
+def initialize_expert_parallel(expert_parallel_size: int):
+    """Parity with groups.initialize_expert_parallel (groups.py:183)."""
+    return initialize(ep_size=expert_parallel_size)
+
+
+def get_mesh() -> Mesh:
+    _check_initialized()
+    return _MESH
+
+
+def set_mesh(mesh: Mesh):
+    """Install an externally built mesh (tests, custom topologies)."""
+    global _MESH, _EXPERT_PARALLEL_SIZE, _MODEL_PARALLEL_SIZE, _PIPE_PARALLEL_SIZE
+    for ax in MESH_AXES:
+        assert ax in mesh.axis_names, f"mesh must carry axis '{ax}'"
+    _MESH = mesh
+    _EXPERT_PARALLEL_SIZE = mesh.shape[EXPERT_AXIS]
+    _MODEL_PARALLEL_SIZE = mesh.shape[MODEL_AXIS]
+    _PIPE_PARALLEL_SIZE = mesh.shape[PIPE_AXIS]
+
+
+def destroy():
+    global _MESH, _EXPERT_PARALLEL_SIZE, _MODEL_PARALLEL_SIZE, _PIPE_PARALLEL_SIZE
+    _MESH = None
+    _EXPERT_PARALLEL_SIZE = 1
+    _MODEL_PARALLEL_SIZE = 1
+    _PIPE_PARALLEL_SIZE = 1
+
+
+# --------------------------- world-size getters ----------------------------
+# (reference getters groups.py:371-515; ranks are per-device concepts that
+# only exist inside jit via lax.axis_index — host code uses world sizes.)
+
+
+def get_data_parallel_world_size():
+    _check_initialized()
+    # DeepSpeed's DP group spans the non-expert data dimension times expert
+    # dim for non-expert params; the getter mirrors dp world = data*expert.
+    return _MESH.shape[DATA_AXIS] * _MESH.shape[EXPERT_AXIS]
+
+
+def get_expert_parallel_world_size():
+    _check_initialized()
+    return _MESH.shape[EXPERT_AXIS]
+
+
+def get_expert_data_parallel_world_size():
+    """DP degree between expert replicas (reference: expert-DP group)."""
+    _check_initialized()
+    return _MESH.shape[DATA_AXIS]
+
+
+def get_model_parallel_world_size():
+    _check_initialized()
+    return _MESH.shape[MODEL_AXIS]
+
+
+def get_pipe_parallel_world_size():
+    _check_initialized()
+    return _MESH.shape[PIPE_AXIS]
+
+
+def get_world_size():
+    _check_initialized()
+    return int(np.prod(list(_MESH.shape.values())))
+
+
+def model_parallel_is_initialized():
+    return _MESH is not None and _MESH.shape[MODEL_AXIS] > 1
+
+
+# Axis-name views used by sharding rules:
+
+def data_parallel_axes():
+    """Axes a non-expert gradient all-reduces over (DP = data × expert)."""
+    return (DATA_AXIS, EXPERT_AXIS)
+
+
+def expert_data_parallel_axes():
+    """Axes an expert gradient all-reduces over (expert replicas only)."""
+    return (DATA_AXIS,)

@@ -1,0 +1,141 @@
+"""Collective verb + mesh factory tests (reference: tests/unit/test_dist.py,
+test_coalesced_collectives.py) on the 8-device virtual CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+from jax import shard_map
+
+import deepspeed_tpu.comm as dist
+from deepspeed_tpu.utils import groups
+
+
+def _data_shard_map(mesh, fn, in_spec, out_spec):
+    return shard_map(fn, mesh=mesh, in_specs=in_spec, out_specs=out_spec)
+
+
+class TestVerbs:
+    def test_all_reduce_sum(self, mesh8):
+        x = jnp.arange(8.0)
+
+        def body(xs):
+            return dist.all_reduce(xs, "data")
+
+        out = _data_shard_map(mesh8, body, P("data"), P("data"))(x)
+        np.testing.assert_allclose(np.asarray(out), np.full(8, 28.0))
+
+    def test_all_reduce_max(self, mesh8):
+        x = jnp.arange(8.0)
+        out = _data_shard_map(
+            mesh8, lambda xs: dist.all_reduce(xs, "data", op="max"),
+            P("data"), P("data"))(x)
+        np.testing.assert_allclose(np.asarray(out), np.full(8, 7.0))
+
+    def test_all_gather_tiled(self, mesh8):
+        x = jnp.arange(16.0)
+
+        def body(xs):  # each shard has 2 elements; gather -> 16 on every shard
+            full = dist.all_gather(xs, "data")
+            return full.sum(keepdims=True)[:1]
+
+        out = _data_shard_map(mesh8, body, P("data"), P("data"))(x)
+        np.testing.assert_allclose(np.asarray(out), np.full(8, 120.0))
+
+    def test_reduce_scatter(self, mesh8):
+        # Every shard holds the same 8-vector; psum_scatter gives each shard
+        # 8 * its slice.
+        x = jnp.tile(jnp.arange(8.0), (8, 1))
+
+        def body(xs):
+            return dist.reduce_scatter(xs[0], "data")
+
+        out = _data_shard_map(mesh8, body, P("data", None), P("data"))(x)
+        np.testing.assert_allclose(np.asarray(out), 8.0 * np.arange(8.0))
+
+    def test_all_to_all(self, mesh8):
+        # shard i holds row of 8 values [i*8 .. i*8+7]; all_to_all transposes
+        # the (shard, slot) matrix.
+        x = jnp.arange(64.0).reshape(8, 8)
+
+        def body(xs):
+            return dist.all_to_all(xs, "data", split_axis=1, concat_axis=0)
+
+        out = _data_shard_map(mesh8, body, P("data", None), P("data", None))(x)
+        # shard i ends up with column i of the global matrix: the (shard,
+        # slot) transpose, stacked to a (64, 1) global array.
+        expected = np.arange(64.0).reshape(8, 8).T.reshape(64, 1)
+        np.testing.assert_allclose(np.asarray(out), expected)
+
+    def test_broadcast(self, mesh8):
+        x = jnp.arange(8.0)
+        out = _data_shard_map(
+            mesh8, lambda xs: dist.broadcast(xs, "data", root=3),
+            P("data"), P("data"))(x)
+        np.testing.assert_allclose(np.asarray(out), np.full(8, 3.0))
+
+    def test_ppermute_ring(self, mesh8):
+        x = jnp.arange(8.0)
+        out = _data_shard_map(
+            mesh8, lambda xs: dist.send_next(xs, "data", 8),
+            P("data"), P("data"))(x)
+        np.testing.assert_allclose(np.asarray(out), np.roll(np.arange(8.0), 1))
+
+    def test_axis_index(self, mesh8):
+        out = _data_shard_map(
+            mesh8,
+            lambda xs: xs + dist.axis_index("data").astype(jnp.float32),
+            P("data"), P("data"))(jnp.zeros(8))
+        np.testing.assert_allclose(np.asarray(out), np.arange(8.0))
+
+
+class TestGroups:
+    def test_default_mesh(self):
+        mesh = groups.initialize()
+        assert groups.get_data_parallel_world_size() == 8
+        assert groups.get_model_parallel_world_size() == 1
+        assert groups.get_expert_parallel_world_size() == 1
+        assert groups.get_pipe_parallel_world_size() == 1
+        assert groups.get_world_size() == 8
+        assert set(mesh.axis_names) == {"pipe", "data", "expert", "model"}
+
+    def test_model_parallel_mesh(self):
+        groups.initialize(mp_size=2)
+        assert groups.get_model_parallel_world_size() == 2
+        assert groups.get_data_parallel_world_size() == 4
+        assert groups.model_parallel_is_initialized()
+
+    def test_expert_parallel_mesh(self):
+        groups.initialize(ep_size=4)
+        assert groups.get_expert_parallel_world_size() == 4
+        # DP world (for non-expert params) still spans all 8
+        assert groups.get_data_parallel_world_size() == 8
+        assert groups.get_expert_data_parallel_world_size() == 2
+
+    def test_3d_mesh(self):
+        groups.initialize(ep_size=1, mp_size=2, pp_size=2)
+        assert groups.get_pipe_parallel_world_size() == 2
+        assert groups.get_model_parallel_world_size() == 2
+        assert groups.get_data_parallel_world_size() == 2
+
+    def test_indivisible_raises(self):
+        with pytest.raises(AssertionError):
+            groups.initialize(mp_size=3)
+
+    def test_ep_must_divide_dp(self):
+        with pytest.raises(AssertionError):
+            groups.initialize(ep_size=8, mp_size=2)
+
+    def test_uninitialized_raises(self):
+        with pytest.raises(AssertionError):
+            groups.get_data_parallel_world_size()
+
+
+class TestBootstrap:
+    def test_init_distributed_single(self):
+        dist.init_distributed(verbose=False)
+        assert dist.is_initialized()
+        assert dist.get_world_size() == 8
+        assert dist.get_rank() == 0
+        dist.barrier()

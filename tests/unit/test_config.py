@@ -1,0 +1,186 @@
+"""Config parsing + batch triangulation tests.
+
+Mirrors the reference's tests/unit/test_config.py + test_ds_config.py:
+batch-size triangulation identities, precision flag exclusivity, optimizer
+gating under ZeRO, sub-config defaults.
+"""
+
+import pytest
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig, DeepSpeedConfigError
+
+
+def basic(**over):
+    d = {"train_batch_size": 32, "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}}
+    d.update(over)
+    return d
+
+
+class TestBatchConfig:
+    def test_all_three_consistent(self):
+        cfg = DeepSpeedConfig(
+            {"train_batch_size": 32, "train_micro_batch_size_per_gpu": 4,
+             "gradient_accumulation_steps": 2}, data_parallel_size=4)
+        assert cfg.train_batch_size == 32
+        assert cfg.train_micro_batch_size_per_gpu == 4
+        assert cfg.gradient_accumulation_steps == 2
+
+    def test_all_three_inconsistent_raises(self):
+        with pytest.raises(DeepSpeedConfigError):
+            DeepSpeedConfig(
+                {"train_batch_size": 33, "train_micro_batch_size_per_gpu": 4,
+                 "gradient_accumulation_steps": 2}, data_parallel_size=4)
+
+    def test_derive_gas(self):
+        cfg = DeepSpeedConfig(
+            {"train_batch_size": 32, "train_micro_batch_size_per_gpu": 4},
+            data_parallel_size=4)
+        assert cfg.gradient_accumulation_steps == 2
+
+    def test_derive_micro_batch(self):
+        cfg = DeepSpeedConfig(
+            {"train_batch_size": 32, "gradient_accumulation_steps": 2},
+            data_parallel_size=4)
+        assert cfg.train_micro_batch_size_per_gpu == 4
+
+    def test_derive_train_batch(self):
+        cfg = DeepSpeedConfig(
+            {"train_micro_batch_size_per_gpu": 4, "gradient_accumulation_steps": 2},
+            data_parallel_size=4)
+        assert cfg.train_batch_size == 32
+
+    def test_only_train_batch(self):
+        cfg = DeepSpeedConfig({"train_batch_size": 32}, data_parallel_size=4)
+        assert cfg.train_micro_batch_size_per_gpu == 8
+        assert cfg.gradient_accumulation_steps == 1
+
+    def test_only_micro_batch(self):
+        cfg = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 4},
+                              data_parallel_size=4)
+        assert cfg.train_batch_size == 16
+        assert cfg.gradient_accumulation_steps == 1
+
+    def test_none_raises(self):
+        with pytest.raises(DeepSpeedConfigError):
+            DeepSpeedConfig({"steps_per_print": 10})
+
+
+class TestPrecisionConfig:
+    def test_fp16(self):
+        cfg = DeepSpeedConfig(basic(fp16={"enabled": True, "loss_scale": 0,
+                                          "initial_scale_power": 16}))
+        assert cfg.fp16_enabled
+        assert cfg.fp16.dynamic_loss_scale
+        assert cfg.initial_dynamic_scale == 2 ** 16
+        assert cfg.dynamic_loss_scale_args["scale_window"] == 1000
+
+    def test_static_loss_scale(self):
+        cfg = DeepSpeedConfig(basic(fp16={"enabled": True, "loss_scale": 128.0}))
+        assert not cfg.fp16.dynamic_loss_scale
+        assert cfg.loss_scale == 128.0
+
+    def test_bf16(self):
+        cfg = DeepSpeedConfig(basic(bf16={"enabled": True}))
+        assert cfg.bfloat16_enabled and not cfg.fp16_enabled
+
+    def test_bf16_old_spelling(self):
+        cfg = DeepSpeedConfig(basic(bfloat16={"enabled": True}))
+        assert cfg.bfloat16_enabled
+
+    def test_fp16_bf16_exclusive(self):
+        with pytest.raises(DeepSpeedConfigError):
+            DeepSpeedConfig(basic(fp16={"enabled": True}, bf16={"enabled": True}))
+
+
+class TestZeroConfig:
+    def test_defaults(self):
+        cfg = DeepSpeedConfig(basic())
+        assert cfg.zero_optimization_stage == 0
+        assert not cfg.zero_enabled
+
+    def test_stage_and_buckets(self):
+        cfg = DeepSpeedConfig(basic(zero_optimization={
+            "stage": 2, "reduce_bucket_size": 1000, "allgather_bucket_size": 2000,
+            "overlap_comm": True}))
+        z = cfg.zero_config
+        assert z.stage == 2 and cfg.zero_enabled
+        assert z.reduce_bucket_size == 1000
+        assert z.allgather_bucket_size == 2000
+        assert z.overlap_comm
+
+    def test_stage3_offload(self):
+        cfg = DeepSpeedConfig(basic(zero_optimization={
+            "stage": 3,
+            "offload_optimizer": {"device": "cpu", "pin_memory": True},
+            "offload_param": {"device": "nvme", "nvme_path": "/tmp/nvme"}}))
+        z = cfg.zero_config
+        assert z.offload_optimizer.device == "cpu"
+        assert z.offload_optimizer.pin_memory
+        assert z.offload_param.device == "nvme"
+        assert z.offload_param.nvme_path == "/tmp/nvme"
+        assert z.overlap_comm  # stage-3 default
+
+    def test_deprecated_cpu_offload(self):
+        cfg = DeepSpeedConfig(basic(zero_optimization={"stage": 2, "cpu_offload": True}))
+        assert cfg.zero_config.offload_optimizer.device == "cpu"
+
+    def test_invalid_stage(self):
+        with pytest.raises(AssertionError):
+            DeepSpeedConfig(basic(zero_optimization={"stage": 5}))
+
+    def test_untested_optimizer_gating(self):
+        with pytest.raises(DeepSpeedConfigError):
+            DeepSpeedConfig({"train_batch_size": 8,
+                             "optimizer": {"type": "Ranger"},
+                             "zero_optimization": {"stage": 1}})
+        cfg = DeepSpeedConfig({"train_batch_size": 8,
+                               "optimizer": {"type": "Ranger"},
+                               "zero_allow_untested_optimizer": True,
+                               "zero_optimization": {"stage": 1}})
+        assert cfg.optimizer_name == "Ranger"
+
+
+class TestSubConfigs:
+    def test_optimizer_scheduler(self):
+        cfg = DeepSpeedConfig(basic(scheduler={
+            "type": "WarmupLR",
+            "params": {"warmup_min_lr": 0, "warmup_max_lr": 1e-3}}))
+        assert cfg.optimizer_name == "adam"
+        assert cfg.optimizer_params == {"lr": 1e-3}
+        assert cfg.scheduler_name == "WarmupLR"
+        assert cfg.scheduler_params["warmup_max_lr"] == 1e-3
+
+    def test_pld(self):
+        cfg = DeepSpeedConfig(basic(progressive_layer_drop={
+            "enabled": True, "theta": 0.5, "gamma": 0.01}))
+        assert cfg.pld_enabled
+        assert cfg.pld_config.theta == 0.5
+
+    def test_flops_profiler(self):
+        cfg = DeepSpeedConfig(basic(flops_profiler={"enabled": True, "profile_step": 5}))
+        assert cfg.flops_profiler_config.enabled
+        assert cfg.flops_profiler_config.profile_step == 5
+
+    def test_aio_defaults(self):
+        cfg = DeepSpeedConfig(basic())
+        assert cfg.aio_config.block_size == 1048576
+        assert cfg.aio_config.queue_depth == 8
+
+    def test_gradient_clipping(self):
+        cfg = DeepSpeedConfig(basic(gradient_clipping=1.0))
+        assert cfg.gradient_clipping == 1.0
+
+    def test_file_roundtrip(self, tmp_path):
+        import json
+        p = tmp_path / "ds_config.json"
+        p.write_text(json.dumps(basic()))
+        cfg = DeepSpeedConfig(str(p))
+        assert cfg.train_batch_size == 32
+
+    def test_curriculum(self):
+        cfg = DeepSpeedConfig(basic(curriculum_learning={
+            "enabled": True, "curriculum_type": "seqlen", "min_difficulty": 8,
+            "max_difficulty": 1024, "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 40000, "difficulty_step": 8}}))
+        assert cfg.curriculum_enabled
+        assert cfg.curriculum_config.params["curriculum_type"] == "seqlen"
