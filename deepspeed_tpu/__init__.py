@@ -1,0 +1,75 @@
+"""deepspeed_tpu — a TPU-native large-scale training framework.
+
+Public API parity with ``deepspeed/__init__.py``: ``initialize`` (:50),
+``init_distributed``, ``add_config_arguments`` (:204), plus the TPU-native
+module surface (``ops``, ``moe``, ``pipe`` via runtime, ``zero``).
+"""
+
+__version__ = "0.1.0"
+version = __version__
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig, DeepSpeedConfigError
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+from deepspeed_tpu.runtime import lr_schedules
+from deepspeed_tpu.utils import groups
+from deepspeed_tpu.utils.logging import logger, log_dist
+import deepspeed_tpu.comm as comm
+
+
+def init_distributed(dist_backend="xla", **kwargs):
+    """Reference: deepspeed.init_distributed (utils/distributed.py:12)."""
+    comm.init_distributed(dist_backend=dist_backend, **kwargs)
+
+
+def initialize(args=None,
+               model=None,
+               optimizer=None,
+               model_parameters=None,
+               training_data=None,
+               lr_scheduler=None,
+               mpu=None,
+               dist_init_required=None,
+               collate_fn=None,
+               config=None,
+               config_params=None,
+               **kwargs):
+    """Create a training engine (reference: deepspeed.initialize,
+    deepspeed/__init__.py:50).
+
+    Returns ``(engine, optimizer, training_dataloader, lr_scheduler)`` with
+    the same tuple contract as the reference. ``model`` is a flax module
+    (or ``(params, apply_fn)`` protocol object); extra TPU-native kwargs:
+    ``loss_fn``, ``sample_batch`` (for shape init), ``mp_rules``
+    (megatron-style tensor-parallel sharding rules).
+    """
+    assert model is not None, "deepspeed_tpu.initialize: model is required"
+
+    engine = DeepSpeedEngine(args=args,
+                             model=model,
+                             optimizer=optimizer,
+                             model_parameters=model_parameters,
+                             training_data=training_data,
+                             lr_scheduler=lr_scheduler,
+                             mpu=mpu,
+                             dist_init_required=dist_init_required,
+                             collate_fn=collate_fn,
+                             config=config,
+                             config_params=config_params,
+                             **kwargs)
+    return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
+
+
+def add_config_arguments(parser):
+    """Reference: deepspeed.add_config_arguments (deepspeed/__init__.py:204)."""
+    group = parser.add_argument_group("DeepSpeed", "DeepSpeed configurations")
+    group.add_argument("--deepspeed", default=False, action="store_true",
+                       help="Enable DeepSpeed (helper flag to ease transition)")
+    group.add_argument("--deepspeed_config", default=None, type=str,
+                       help="DeepSpeed json configuration file.")
+    group.add_argument("--deepscale", default=False, action="store_true",
+                       help="Deprecated enable flag")
+    group.add_argument("--deepscale_config", default=None, type=str,
+                       help="Deprecated config path")
+    group.add_argument("--deepspeed_mpi", default=False, action="store_true",
+                       help="Run via MPI")
+    return parser

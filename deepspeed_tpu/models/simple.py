@@ -1,0 +1,68 @@
+"""Test-fixture models.
+
+The analogue of the reference's ``tests/unit/simple_model.py``
+(``SimpleModel`` :14, ``LinearStack`` :67, random-data loaders) as flax
+modules that return the loss directly from ``__call__(batch)`` — matching
+the DeepSpeed convention where the wrapped module computes its own loss.
+"""
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class SimpleModel(nn.Module):
+    """hidden→hidden linear + CE-ish loss (reference SimpleModel)."""
+    hidden_dim: int
+    nlayers: int = 1
+
+    @nn.compact
+    def __call__(self, batch):
+        x, y = batch
+        for _ in range(self.nlayers):
+            x = nn.Dense(self.hidden_dim)(x)
+        # squared error against targets (reference uses CrossEntropy on
+        # random labels; MSE keeps the fixture dtype-agnostic)
+        return jnp.mean((x - y) ** 2)
+
+
+class LinearStack(nn.Module):
+    """Deep stack of equal Linear layers (reference LinearStack :67)."""
+    input_dim: int = 128
+    hidden_dim: int = 128
+    output_dim: int = 128
+    num_layers: int = 4
+
+    @nn.compact
+    def __call__(self, batch):
+        x, y = batch
+        x = nn.Dense(self.hidden_dim, use_bias=False)(x)
+        for _ in range(self.num_layers):
+            x = nn.relu(nn.Dense(self.hidden_dim, use_bias=False)(x))
+        x = nn.Dense(self.output_dim, use_bias=False)(x)
+        return jnp.mean((x - y) ** 2)
+
+
+def random_dataset(total_samples, hidden_dim, seed=0, dtype=np.float32):
+    """(x, y) pairs of gaussian vectors (reference random_dataset)."""
+    rng = np.random.default_rng(seed)
+    xs = rng.standard_normal((total_samples, hidden_dim)).astype(dtype)
+    ys = rng.standard_normal((total_samples, hidden_dim)).astype(dtype)
+    return [(xs[i], ys[i]) for i in range(total_samples)]
+
+
+def random_dataloader(model_engine, total_samples, hidden_dim, seed=0,
+                      dtype=np.float32):
+    batch_size = model_engine.train_micro_batch_size_per_gpu() * \
+        model_engine.dp_world_size
+    ds = random_dataset(total_samples, hidden_dim, seed=seed, dtype=dtype)
+    from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
+    return DeepSpeedDataLoader(ds, batch_size=batch_size)
+
+
+def sample_batch(batch_size, hidden_dim, dtype=jnp.float32):
+    return (jnp.zeros((batch_size, hidden_dim), dtype),
+            jnp.zeros((batch_size, hidden_dim), dtype))

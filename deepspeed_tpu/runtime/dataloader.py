@@ -1,0 +1,97 @@
+"""Data loading.
+
+Parity with ``deepspeed/runtime/dataloader.py`` (``DeepSpeedDataLoader``
+:33, ``RepeatingLoader`` :10). TPU-native differences: batches are numpy /
+jax arrays (no torch dependency required, though torch datasets work), and
+instead of a per-rank ``DistributedSampler`` the loader yields the GLOBAL
+batch — the engine shards it over the mesh's data axis with
+``jax.device_put``; XLA then keeps each shard on its own chip. In a
+multi-host setup each process loads only its host's slice
+(``process_index``-strided sampling), matching DistributedSampler
+semantics.
+"""
+
+import numpy as np
+
+
+class RepeatingLoader:
+    """Wrap an iterator to restart on StopIteration (reference :10)."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.data_iter = iter(self.loader)
+
+    def __iter__(self):
+        return self
+
+    def __len__(self):
+        return len(self.loader)
+
+    def __next__(self):
+        try:
+            batch = next(self.data_iter)
+        except StopIteration:
+            self.data_iter = iter(self.loader)
+            batch = next(self.data_iter)
+        return batch
+
+
+class DeepSpeedDataLoader:
+    """Batched, optionally shuffled, epoch-aware loader over an indexable
+    dataset of (x, y) pairs or dicts; built by ``engine.deepspeed_io``
+    (reference engine.py:1474)."""
+
+    def __init__(self, dataset, batch_size, shuffle=False, seed=0,
+                 drop_last=True, collate_fn=None, num_local_io_workers=None,
+                 data_sampler=None, process_index=0, process_count=1):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.collate_fn = collate_fn or _default_collate
+        self.process_index = process_index
+        self.process_count = process_count
+        self.epoch = 0
+        self.data_sampler = data_sampler
+        n = len(dataset)
+        per_proc = n // process_count if drop_last else -(-n // process_count)
+        if drop_last:
+            self.len = per_proc // batch_size
+        else:
+            self.len = -(-per_proc // batch_size)
+
+    def set_epoch(self, epoch):
+        self.epoch = epoch
+
+    def __len__(self):
+        return self.len
+
+    def __iter__(self):
+        n = len(self.dataset)
+        if self.data_sampler is not None:
+            order = np.fromiter(iter(self.data_sampler), dtype=np.int64)
+        elif self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            order = rng.permutation(n)
+        else:
+            order = np.arange(n)
+        # host slice (DistributedSampler analogue): strided by process
+        order = order[self.process_index::self.process_count]
+        limit = self.len * self.batch_size
+        for start in range(0, min(len(order), limit), self.batch_size):
+            idx = order[start:start + self.batch_size]
+            if self.drop_last and len(idx) < self.batch_size:
+                break
+            yield self.collate_fn([self.dataset[int(i)] for i in idx])
+
+
+def _default_collate(samples):
+    """Stack a list of samples into batched numpy arrays."""
+    first = samples[0]
+    if isinstance(first, dict):
+        return {k: np.stack([np.asarray(s[k]) for s in samples]) for k in first}
+    if isinstance(first, (tuple, list)):
+        return tuple(np.stack([np.asarray(s[i]) for s in samples])
+                     for i in range(len(first)))
+    return np.stack([np.asarray(s) for s in samples])

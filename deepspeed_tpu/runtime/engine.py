@@ -1,0 +1,646 @@
+"""The training engine.
+
+TPU-native rebuild of ``DeepSpeedEngine`` (reference
+deepspeed/runtime/engine.py:165). The reference wraps an eager PyTorch
+module and imperatively orchestrates precision, ZeRO hooks, collectives and
+the optimizer across ``forward``/``backward``/``step``. Here the same user
+surface drives ONE pjit-compiled micro-step and ONE compiled apply-step
+over a named device mesh:
+
+* ``forward(batch)`` computes the (scaled) loss AND the gradients in a
+  single fused compiled call, accumulating fp32 grads into the train state
+  (the reference's separate backward exists because autograd is eager; in
+  JAX loss and grads come from one ``value_and_grad``). ``backward()``
+  advances the micro-step counter; ``step()`` applies the optimizer at the
+  gradient-accumulation boundary — matching the reference's
+  ``is_gradient_accumulation_boundary`` semantics (engine.py:1747).
+* ZeRO stages are sharding rules (runtime/zero/partition.py), not hooks:
+  the state carries NamedShardings and XLA inserts the all-gather /
+  reduce-scatter traffic that stage_1_and_2.py / stage3.py issue by hand.
+* Mixed precision: fp32 master params live in the state; the forward casts
+  to bf16/fp16 (``_configure_distributed_model`` engine.py:997 analogue);
+  dynamic loss scaling runs inside the compiled step with a ``lax.cond``
+  skip — no per-step host sync (reference overflow check engine.py:1747+
+  forces D2H).
+
+Checkpoint layout keeps the reference's file naming
+(``{tag}/mp_rank_00_model_states.pt``, ``zero_pp_rank_*_optim_states.pt``,
+``latest`` tag file — engine.py:2350/:2345/:2889) so downstream tooling and
+the zero_to_fp32 converter work unchanged.
+"""
+
+import os
+import pickle
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.runtime import optim as optim_lib
+from deepspeed_tpu.runtime.config import (
+    ADAGRAD_OPTIMIZER, ADAM_OPTIMIZER, ADAMW_OPTIMIZER, DeepSpeedConfig,
+    LAMB_OPTIMIZER, ONEBIT_ADAM_OPTIMIZER, ONEBIT_LAMB_OPTIMIZER, SGD_OPTIMIZER)
+from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader, RepeatingLoader
+from deepspeed_tpu.runtime.fp16.loss_scaler import (
+    LossScaleState, make_scale_state, update_scale)
+from deepspeed_tpu.runtime.lr_schedules import get_lr_schedule
+from deepspeed_tpu.runtime.zero.partition import (
+    ModelParallelRules, build_opt_shardings, build_param_shardings,
+    grad_constraint_fn)
+from deepspeed_tpu.utils import groups
+from deepspeed_tpu.utils.logging import log_dist, logger
+from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer, ThroughputTimer
+
+MODEL_FILE_SUFFIX = "_model_states.pt"
+OPTIM_FILE_SUFFIX = "_optim_states.pt"
+LATEST_FILE = "latest"
+
+
+class TrainState(NamedTuple):
+    """All mutable training state, as one donated pytree."""
+    step: jnp.ndarray          # global (optimizer) steps taken
+    micro_step: jnp.ndarray    # micro-batches since last boundary
+    params: Any                # fp32 master parameters
+    opt_state: Any
+    acc_grads: Any             # fp32 accumulation buffer (ZeRO-sharded)
+    scale: LossScaleState
+    skipped_steps: jnp.ndarray
+
+
+def _cast_tree(tree, dtype):
+    return jax.tree.map(
+        lambda x: x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x,
+        tree)
+
+
+class DeepSpeedEngine:
+    """See module docstring. Constructed via ``deepspeed_tpu.initialize``."""
+
+    def __init__(self,
+                 args=None,
+                 model=None,
+                 optimizer=None,
+                 model_parameters=None,
+                 training_data=None,
+                 lr_scheduler=None,
+                 mpu=None,
+                 dist_init_required=None,
+                 collate_fn=None,
+                 config=None,
+                 config_params=None,
+                 loss_fn=None,
+                 sample_batch=None,
+                 mp_rules=None,
+                 dont_change_device=False,
+                 seed=42):
+        import deepspeed_tpu.comm as dist
+        dist.init_distributed(verbose=False)
+
+        self.module = model
+        self.model = model
+        self.loss_fn = loss_fn
+        self.client_optimizer = optimizer
+        self.client_lr_scheduler = lr_scheduler
+        self.training_data = training_data
+        self.collate_fn = collate_fn
+        self.mpu = mpu
+        self.global_steps = 0
+        self.global_samples = 0
+        self.micro_steps = 0
+        self.skipped_steps = 0
+        self._seed = seed
+
+        # ---- mesh (reference: groups.initialize, engine.py:1031) ----------
+        if not groups.mesh_is_initialized():
+            groups.initialize(mpu=mpu)
+        self.mesh = groups.get_mesh()
+        self.dp_world_size = groups.get_data_parallel_world_size()
+        self.mp_world_size = groups.get_model_parallel_world_size()
+
+        # ---- config -------------------------------------------------------
+        if config is None and config_params is not None:
+            config = config_params
+        if config is None and args is not None:
+            config = getattr(args, "deepspeed_config", None)
+        assert config is not None, "DeepSpeed requires --deepspeed_config or config dict"
+        if isinstance(config, DeepSpeedConfig):
+            assert config.world_size == self.dp_world_size, (
+                f"pre-built DeepSpeedConfig was triangulated for data-parallel "
+                f"world {config.world_size}, but the mesh has {self.dp_world_size}")
+            self.config = config
+        else:
+            self.config = DeepSpeedConfig(config, mpu=None,
+                                          data_parallel_size=self.dp_world_size)
+
+        self.zero_stage = self.config.zero_optimization_stage
+        self.mp_rules = mp_rules or ModelParallelRules()
+
+        # ---- precision ----------------------------------------------------
+        if self.config.fp16_enabled:
+            self.compute_dtype = jnp.float16
+        elif self.config.bfloat16_enabled:
+            self.compute_dtype = jnp.bfloat16
+        else:
+            self.compute_dtype = jnp.float32
+        self._dynamic_scale = (self.config.fp16_enabled
+                               and self.config.fp16.dynamic_loss_scale)
+        if self.config.fp16_enabled:
+            init_scale = (self.config.initial_dynamic_scale
+                          if self._dynamic_scale else self.config.loss_scale)
+        else:
+            init_scale = 1.0
+        self._init_scale = float(init_scale)
+
+        # ---- optimizer (reference _configure_basic_optimizer, :1163) ------
+        self.optimizer = self._configure_optimizer()
+
+        # ---- lr schedule (reference _configure_lr_scheduler, :790) --------
+        self.lr_scheduler, self._lr_fn, self._base_lr = self._configure_lr_scheduler()
+
+        # ---- parameters / state init --------------------------------------
+        self._init_state(model_parameters, sample_batch)
+
+        # ---- dataloader (reference deepspeed_io, :1474) -------------------
+        self.training_dataloader = None
+        if training_data is not None:
+            self.training_dataloader = self.deepspeed_io(training_data)
+
+        # ---- timers -------------------------------------------------------
+        self.timers = SynchronizedWallClockTimer()
+        self.tput_timer = ThroughputTimer(
+            batch_size=self.train_micro_batch_size_per_gpu() * self.dp_world_size,
+            steps_per_output=self.steps_per_print())
+
+        log_dist(
+            f"DeepSpeedEngine ready: zero_stage={self.zero_stage} "
+            f"dtype={self.compute_dtype.__name__} dp={self.dp_world_size} "
+            f"mp={self.mp_world_size} gas={self.gradient_accumulation_steps()}",
+            ranks=[0])
+
+    # ------------------------------------------------------------------ config
+    def train_batch_size(self):
+        return self.config.train_batch_size
+
+    def train_micro_batch_size_per_gpu(self):
+        return self.config.train_micro_batch_size_per_gpu
+
+    def gradient_accumulation_steps(self):
+        return self.config.gradient_accumulation_steps
+
+    def steps_per_print(self):
+        return self.config.steps_per_print
+
+    def zero_optimization_stage(self):
+        return self.zero_stage
+
+    def fp16_enabled(self):
+        return self.config.fp16_enabled
+
+    def bfloat16_enabled(self):
+        return self.config.bfloat16_enabled
+
+    def gradient_clipping(self):
+        return self.config.gradient_clipping
+
+    @property
+    def loss_scale(self):
+        return float(jax.device_get(self.state.scale.loss_scale))
+
+    def get_lr(self):
+        """Current lr — the value the NEXT applied step will use. Indexed by
+        successful steps (state.step), matching the scheduler's counter."""
+        applied_steps = self.global_steps - self.skipped_steps
+        return [float(self._lr_fn(max(0, applied_steps)))]
+
+    def get_global_grad_norm(self):
+        return self._last_grad_norm
+
+    # --------------------------------------------------------------- optimizer
+    def _configure_optimizer(self):
+        if self.client_optimizer is not None:
+            assert isinstance(self.client_optimizer, optim_lib.Optimizer), (
+                "client optimizer must be a deepspeed_tpu Optimizer(init, update) pair")
+            return self.client_optimizer
+
+        name = self.config.optimizer_name or ADAM_OPTIMIZER
+        params = dict(self.config.optimizer_params or {})
+        params.pop("lr", None)
+        betas = params.pop("betas", (0.9, 0.999))
+        torch_adam = params.pop("torch_adam", False)
+        params.pop("max_grad_norm", None)
+
+        if name in (ADAM_OPTIMIZER, ADAMW_OPTIMIZER, ONEBIT_ADAM_OPTIMIZER):
+            # Reference: both "adam" and "adamw" route to FusedAdam, which
+            # defaults to adam_w_mode=True (ops/adam/fused_adam.py:16).
+            adam_w_mode = params.pop("adam_w_mode", True)
+            del torch_adam
+            return optim_lib.adam(b1=betas[0], b2=betas[1],
+                                  eps=params.get("eps", 1e-8),
+                                  weight_decay=params.get("weight_decay", 0.0),
+                                  adam_w_mode=adam_w_mode,
+                                  bias_correction=params.get("bias_correction", True))
+        if name in (LAMB_OPTIMIZER, ONEBIT_LAMB_OPTIMIZER):
+            return optim_lib.lamb(b1=betas[0], b2=betas[1],
+                                  eps=params.get("eps", 1e-6),
+                                  weight_decay=params.get("weight_decay", 0.0),
+                                  min_coeff=params.get("min_coeff", 0.01),
+                                  max_coeff=params.get("max_coeff", 10.0))
+        if name == SGD_OPTIMIZER:
+            return optim_lib.sgd(momentum=params.get("momentum", 0.0),
+                                 weight_decay=params.get("weight_decay", 0.0),
+                                 nesterov=params.get("nesterov", False))
+        if name == ADAGRAD_OPTIMIZER:
+            return optim_lib.adagrad(eps=params.get("eps", 1e-8),
+                                     weight_decay=params.get("weight_decay", 0.0))
+        raise ValueError(f"Unsupported optimizer: {name}")
+
+    def _configure_lr_scheduler(self):
+        base_lr = float((self.config.optimizer_params or {}).get("lr", 1e-3))
+        if self.client_lr_scheduler is not None:
+            sched = self.client_lr_scheduler
+            return sched, sched.as_schedule_fn(), base_lr
+        if self.config.scheduler_name is not None:
+            sched = get_lr_schedule(self.config.scheduler_name,
+                                    self.config.scheduler_params)
+            return sched, sched.as_schedule_fn(), base_lr
+        return None, (lambda step: base_lr), base_lr
+
+    # ------------------------------------------------------------------- state
+    def _init_state(self, model_parameters, sample_batch):
+        if model_parameters is not None:
+            params = model_parameters
+        else:
+            assert sample_batch is not None, (
+                "need model_parameters or sample_batch to initialise the model")
+            rng = jax.random.PRNGKey(self._seed)
+            params = self.module.init(rng, sample_batch)
+            if isinstance(params, dict) and set(params.keys()) == {"params"}:
+                params = params["params"]
+        # fp32 master copy (reference FP16_Optimizer master weights)
+        params = _cast_tree(params, jnp.float32)
+
+        min_numel = self.config.zero_config.param_persistence_threshold
+        self.param_shardings = build_param_shardings(
+            params, self.mesh, self.zero_stage, self.mp_rules,
+            min_shard_numel=min_numel)
+
+        # persistence threshold only gates stage-3 param sharding (the
+        # ds_persist analogue); optimizer/grad shards have no fetch cost so
+        # they always shard when divisible.
+        opt_shape = jax.eval_shape(self.optimizer.init, params)
+        self.opt_shardings = build_opt_shardings(
+            opt_shape, self.mesh, self.zero_stage, self.mp_rules,
+            min_shard_numel=0)
+
+        # grads accumulate with the stage>=2 layout (reduce-scattered);
+        # stage<2 keeps them like the params (replicated across DP).
+        self.grad_shardings = build_opt_shardings(
+            jax.eval_shape(lambda p: p, params), self.mesh,
+            1 if self.zero_stage >= 2 else 0, self.mp_rules,
+            min_shard_numel=0)
+        self._grad_constraint = grad_constraint_fn(
+            self.mesh, self.zero_stage, self.mp_rules, min_shard_numel=0)
+
+        scalar_sh = NamedSharding(self.mesh, P())
+        self.state_shardings = TrainState(
+            step=scalar_sh, micro_step=scalar_sh,
+            params=self.param_shardings,
+            opt_state=self.opt_shardings,
+            acc_grads=self.grad_shardings,
+            scale=LossScaleState(loss_scale=scalar_sh, good_steps=scalar_sh,
+                                 hysteresis=scalar_sh),
+            skipped_steps=scalar_sh)
+
+        # Build the initial state ON the mesh with one compiled init fn so
+        # every leaf is born sharded (no host round-trip of full params).
+        def make_state(p):
+            return TrainState(
+                step=jnp.zeros([], jnp.int32),
+                micro_step=jnp.zeros([], jnp.int32),
+                params=p,
+                opt_state=self.optimizer.init(p),
+                acc_grads=jax.tree.map(lambda x: jnp.zeros_like(x, jnp.float32), p),
+                scale=make_scale_state(
+                    self._init_scale,
+                    delayed_shift=self.config.fp16.hysteresis),
+                skipped_steps=jnp.zeros([], jnp.int32))
+
+        with self.mesh:
+            params = jax.device_put(params, self.param_shardings)
+            self.state = jax.jit(
+                make_state, out_shardings=self.state_shardings)(params)
+
+        self._build_step_fns()
+        self._pending_loss = None
+        self._last_grad_norm = None
+
+    # -------------------------------------------------------- compiled steps
+    def _batch_sharding(self, batch):
+        dp_axes = tuple(a for a in groups.data_parallel_axes()
+                        if self.mesh.shape[a] > 1)
+        spec = P(dp_axes) if dp_axes else P()
+        return jax.tree.map(
+            lambda _: NamedSharding(self.mesh, spec), batch)
+
+    def _compute_loss(self, params, batch, rng):
+        """Forward in compute dtype; returns scalar fp32 loss."""
+        cparams = _cast_tree(params, self.compute_dtype)
+        model_kwargs = {}
+        if rng is not None:
+            model_kwargs["rngs"] = {"dropout": rng}
+        if hasattr(self.module, "apply"):
+            out = self.module.apply(
+                {"params": cparams} if not (isinstance(cparams, dict)
+                                            and "params" in cparams) else cparams,
+                batch, **model_kwargs)
+        else:
+            out = self.module(cparams, batch)
+        loss = self.loss_fn(out, batch) if self.loss_fn is not None else out
+        return jnp.asarray(loss, jnp.float32)
+
+    def _build_step_fns(self):
+        gas = self.gradient_accumulation_steps()
+        cfg = self.config
+
+        def micro_step(state, batch, rng):
+            def scaled_loss(p):
+                loss = self._compute_loss(p, batch, rng)
+                return loss * state.scale.loss_scale / gas
+
+            sloss, grads = jax.value_and_grad(scaled_loss)(state.params)
+            grads = self._grad_constraint(grads)
+            acc = jax.tree.map(jnp.add, state.acc_grads, grads)
+            loss = sloss * gas / state.scale.loss_scale
+            return state._replace(micro_step=state.micro_step + 1,
+                                  acc_grads=acc), loss
+
+        def apply_step(state):
+            inv_scale = 1.0 / state.scale.loss_scale
+            grads = jax.tree.map(lambda g: g * inv_scale, state.acc_grads)
+
+            finite = jnp.array(True)
+            if cfg.fp16_enabled:
+                finite = jnp.all(jnp.stack(
+                    [jnp.isfinite(g).all() for g in jax.tree.leaves(grads)]))
+
+            grad_norm = optim_lib.global_norm(grads)
+            if cfg.gradient_clipping > 0:
+                grads, _ = optim_lib.clip_by_global_norm(grads, cfg.gradient_clipping)
+
+            lr = self._lr_fn_traced(state.step)
+
+            def do_update(operand):
+                st, g = operand
+                updates, new_opt = self.optimizer.update(
+                    g, st.opt_state, st.params, lr)
+                new_params = jax.tree.map(jnp.add, st.params, updates)
+                return st._replace(step=st.step + 1, params=new_params,
+                                   opt_state=new_opt)
+
+            def skip_update(operand):
+                st, _ = operand
+                return st._replace(skipped_steps=st.skipped_steps + 1)
+
+            state = jax.lax.cond(finite, do_update, skip_update, (state, grads))
+            new_scale = update_scale(
+                state.scale, ~finite,
+                dynamic=self._dynamic_scale,
+                scale_window=cfg.fp16.loss_scale_window,
+                min_scale=cfg.fp16.min_loss_scale,
+                delayed_shift=cfg.fp16.hysteresis)
+            zeros = jax.tree.map(jnp.zeros_like, state.acc_grads)
+            return state._replace(micro_step=jnp.zeros([], jnp.int32),
+                                  acc_grads=zeros, scale=new_scale), \
+                grad_norm, ~finite
+
+        sh = self.state_shardings
+        self._jit_micro = jax.jit(
+            micro_step, donate_argnums=0,
+            in_shardings=(sh, None, None),
+            out_shardings=(sh, NamedSharding(self.mesh, P())))
+        self._jit_apply = jax.jit(
+            apply_step, donate_argnums=0,
+            in_shardings=(sh,),
+            out_shardings=(sh, NamedSharding(self.mesh, P()),
+                           NamedSharding(self.mesh, P())))
+        self._jit_eval = jax.jit(
+            lambda params, batch: self._compute_loss(params, batch, None))
+
+    def _lr_fn_traced(self, step):
+        """LR schedule on a traced step: the four built-in schedules are
+        written in jnp so they compile straight into the apply step."""
+        return jnp.asarray(self._lr_fn(step), jnp.float32)
+
+    # ------------------------------------------------------------------ train
+    def _next_rng(self):
+        key = jax.random.PRNGKey(self._seed)
+        return jax.random.fold_in(key, self.micro_steps)
+
+    def forward(self, batch):
+        """Compute loss for one micro-batch (and, fused, its gradients).
+
+        Returns the unscaled loss as a jax scalar. The reference's separate
+        autograd backward is folded in (see module docstring)."""
+        with self.mesh:
+            batch = jax.device_put(batch, self._batch_sharding(batch))
+            self.state, loss = self._jit_micro(self.state, batch, self._next_rng())
+        self._pending_loss = loss
+        return loss
+
+    def backward(self, loss=None, allreduce_gradients=True, release_loss=False):
+        """Bookkeeping half of the fused forward/backward (see ``forward``)."""
+        assert self._pending_loss is not None, "backward() requires a prior forward()"
+        self._pending_loss = None
+        self.micro_steps += 1
+        return loss
+
+    def is_gradient_accumulation_boundary(self):
+        return (self.micro_steps % self.gradient_accumulation_steps()) == 0
+
+    def step(self, lr_kwargs=None):
+        """Optimizer step at the gradient-accumulation boundary
+        (reference engine.step, engine.py:1862)."""
+        if not self.is_gradient_accumulation_boundary():
+            return
+        self.state, grad_norm, overflow = self._jit_apply(self.state)
+        self._last_grad_norm = grad_norm
+        self.global_steps += 1
+        self.global_samples += self.train_batch_size()
+        if bool(jax.device_get(overflow)):
+            # reference engine.py:1844-1854: scheduler does NOT advance on a
+            # skipped step, keeping it in lock-step with the applied-lr index
+            # (state.step, which also only advances on success).
+            self.skipped_steps += 1
+            log_dist(
+                f"[deepspeed] OVERFLOW! skipping step; new loss scale: "
+                f"{self.loss_scale}", ranks=[0])
+        elif self.lr_scheduler is not None:
+            self.lr_scheduler.step(**(lr_kwargs or {}))
+
+    def train_batch(self, data_iter=None, batch=None):
+        """One full global step: gas micro-batches + optimizer step."""
+        self.tput_timer.start()
+        losses = []
+        for _ in range(self.gradient_accumulation_steps()):
+            if batch is not None:
+                micro = batch
+            else:
+                assert data_iter is not None
+                micro = next(data_iter)
+            loss = self.forward(micro)
+            self.backward(loss)
+            losses.append(loss)
+        self.step()
+        self.tput_timer.stop(global_step=True)
+        mean_loss = jnp.mean(jnp.stack(losses))
+        if self.global_steps % self.steps_per_print() == 0:
+            log_dist(f"step={self.global_steps} loss={float(mean_loss):.6f} "
+                     f"lr={self.get_lr()[0]:.3e}", ranks=[0])
+        return mean_loss
+
+    def eval_batch(self, batch):
+        with self.mesh:
+            batch = jax.device_put(batch, self._batch_sharding(batch))
+            return self._jit_eval(self.state.params, batch)
+
+    def __call__(self, batch):
+        return self.eval_batch(batch)
+
+    # ------------------------------------------------------------------- data
+    def deepspeed_io(self, dataset, batch_size=None, route=None,
+                     data_sampler=None, collate_fn=None, num_local_io_workers=None):
+        import deepspeed_tpu.comm as dist
+        # Each process loads its host's slice of the global micro-batch.
+        per_process = (self.train_micro_batch_size_per_gpu() *
+                       self.dp_world_size) // dist.get_process_count()
+        return DeepSpeedDataLoader(
+            dataset,
+            batch_size=batch_size or per_process,
+            shuffle=data_sampler is None,
+            drop_last=True,
+            collate_fn=collate_fn or self.collate_fn,
+            data_sampler=data_sampler,
+            process_index=dist.get_rank(),
+            process_count=dist.get_process_count())
+
+    # ------------------------------------------------------------ checkpoints
+    def _get_ckpt_name(self, checkpoints_path, tag):
+        mp_rank = (self.mpu.get_model_parallel_rank()
+                   if self.mpu is not None else 0)
+        return os.path.join(checkpoints_path, str(tag),
+                            f"mp_rank_{mp_rank:02d}" + MODEL_FILE_SUFFIX)
+
+    def _get_zero_ckpt_name(self, checkpoints_path, tag):
+        import deepspeed_tpu.comm as dist
+        pp_rank = dist.get_rank()
+        return os.path.join(checkpoints_path, str(tag),
+                            f"zero_pp_rank_{pp_rank}_mp_rank_00" + OPTIM_FILE_SUFFIX)
+
+    def save_checkpoint(self, save_dir, tag=None, client_state=None,
+                        save_latest=True):
+        import deepspeed_tpu.comm as dist
+        if tag is None:
+            tag = f"global_step{self.global_steps}"
+        os.makedirs(os.path.join(save_dir, str(tag)), exist_ok=True)
+
+        host_state = jax.device_get(self.state)
+        # model-states + 'latest' are dp-shared files: only process 0 writes
+        # them (reference guards on dp_rank==0, engine.py:812-826); each
+        # process writes its own zero_pp_rank file below.
+        if dist.get_rank() != 0:
+            self._save_zero_checkpoint(save_dir, tag, host_state)
+            return True
+        model_np = jax.tree.map(np.asarray, host_state.params)
+        sd = {
+            "module": model_np,
+            "global_steps": self.global_steps,
+            "global_samples": self.global_samples,
+            "skipped_steps": self.skipped_steps,
+            "micro_steps": self.micro_steps,
+            "dp_world_size": self.dp_world_size,
+            "mp_world_size": self.mp_world_size,
+            "loss_scale": float(np.asarray(host_state.scale.loss_scale)),
+            "lr_scheduler": (self.lr_scheduler.state_dict()
+                             if self.lr_scheduler else None),
+            "ds_config": self.config._param_dict,
+            "ds_version": "tpu-0.1",
+            "client_state": client_state or {},
+        }
+        with open(self._get_ckpt_name(save_dir, tag), "wb") as f:
+            pickle.dump(sd, f)
+
+        self._save_zero_checkpoint(save_dir, tag, host_state)
+
+        if save_latest:
+            with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
+                f.write(str(tag))
+        log_dist(f"saved checkpoint {save_dir}/{tag}", ranks=[0])
+        return True
+
+    def _save_zero_checkpoint(self, save_dir, tag, host_state):
+        zero_sd = {
+            "optimizer_state_dict": jax.tree.map(np.asarray, host_state.opt_state),
+            "scale_state": {k: np.asarray(v) for k, v in
+                            host_state.scale._asdict().items()},
+            "zero_stage": self.zero_stage,
+            "partition_count": self.dp_world_size,
+        }
+        with open(self._get_zero_ckpt_name(save_dir, tag), "wb") as f:
+            pickle.dump(zero_sd, f)
+
+    def load_checkpoint(self, load_dir, tag=None, load_module_strict=True,
+                        load_optimizer_states=True, load_lr_scheduler_states=True,
+                        load_module_only=False):
+        if tag is None:
+            latest = os.path.join(load_dir, LATEST_FILE)
+            if not os.path.isfile(latest):
+                logger.warning(f"no 'latest' file at {latest}; nothing loaded")
+                return None, {}
+            with open(latest) as f:
+                tag = f.read().strip()
+
+        path = self._get_ckpt_name(load_dir, tag)
+        with open(path, "rb") as f:
+            sd = pickle.load(f)
+
+        params = jax.device_put(sd["module"], self.param_shardings)
+        new_state = self.state._replace(params=params)
+
+        client_state = sd.get("client_state", {})
+        if not load_module_only:
+            self.global_steps = sd.get("global_steps", 0)
+            self.global_samples = sd.get("global_samples", 0)
+            self.skipped_steps = sd.get("skipped_steps", 0)
+            self.micro_steps = sd.get("micro_steps", 0)
+            new_state = new_state._replace(
+                step=jnp.asarray(self.global_steps, jnp.int32),
+                scale=new_state.scale._replace(
+                    loss_scale=jnp.float32(sd.get("loss_scale", 1.0))))
+            if load_lr_scheduler_states and self.lr_scheduler is not None \
+                    and sd.get("lr_scheduler") is not None:
+                self.lr_scheduler.load_state_dict(sd["lr_scheduler"])
+
+            if load_optimizer_states:
+                zpath = self._get_zero_ckpt_name(load_dir, tag)
+                if os.path.isfile(zpath):
+                    with open(zpath, "rb") as f:
+                        zsd = pickle.load(f)
+                    opt_state = jax.tree.map(
+                        jnp.asarray, zsd["optimizer_state_dict"])
+                    opt_state = jax.device_put(opt_state, self.opt_shardings)
+                    new_state = new_state._replace(opt_state=opt_state)
+                    # full dynamic-scaler state so a resumed run is
+                    # bit-identical to an uninterrupted one
+                    ss = zsd.get("scale_state")
+                    if ss is not None:
+                        new_state = new_state._replace(
+                            scale=LossScaleState(
+                                loss_scale=jnp.float32(ss["loss_scale"]),
+                                good_steps=jnp.int32(ss["good_steps"]),
+                                hysteresis=jnp.int32(ss["hysteresis"])))
+
+        self.state = new_state
+        log_dist(f"loaded checkpoint {load_dir}/{tag}", ranks=[0])
+        return path, client_state

@@ -1,0 +1,172 @@
+"""Loss scaling state machines.
+
+Parity with ``deepspeed/runtime/fp16/loss_scaler.py`` (``LossScaler`` :56,
+``DynamicLossScaler`` :79). TPU-native twist: the scaler state is a pytree
+(:class:`LossScaleState`) threaded through the jitted train step, and the
+update rule is a pure function built from ``lax`` ops so the
+overflow-skip + scale-adjust logic compiles into the step instead of
+requiring a host sync per iteration (the reference's ``_has_inf_or_nan``
+forces a D2H copy each step).
+"""
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+from jax import lax
+
+INITIAL_LOSS_SCALE = "init_scale"
+SCALE_WINDOW = "scale_window"
+DELAYED_SHIFT = "delayed_shift"
+MIN_LOSS_SCALE = "min_scale"
+
+
+class LossScaleState(NamedTuple):
+    """Functional scaler state living inside the TrainState.
+
+    Only the dynamic scalars live here (the static knobs — window, factor,
+    hysteresis depth — are closed over by the jitted step so they never
+    appear as traced values)."""
+    loss_scale: jnp.ndarray       # f32 scalar
+    good_steps: jnp.ndarray       # i32 scalar — consecutive overflow-free steps
+    hysteresis: jnp.ndarray       # i32 scalar — remaining tolerated overflows
+
+
+def make_scale_state(init_scale, delayed_shift=1):
+    return LossScaleState(loss_scale=jnp.float32(init_scale),
+                          good_steps=jnp.int32(0),
+                          hysteresis=jnp.int32(delayed_shift))
+
+
+def update_scale(state: LossScaleState, overflow, *, dynamic=True,
+                 scale_factor=2.0, scale_window=1000, min_scale=1.0,
+                 delayed_shift=1) -> LossScaleState:
+    """Pure scale-update rule (reference DynamicLossScaler.update_scale).
+
+    On overflow: consume hysteresis; once exhausted, halve the scale
+    (clamped at min_scale) and reset the good-step counter. After
+    ``scale_window`` consecutive good steps: double the scale and restore
+    hysteresis.
+    """
+    if not dynamic:
+        return state
+
+    overflow = jnp.asarray(overflow)
+
+    def on_overflow(s):
+        new_hyst = s.hysteresis - 1
+        must_shift = new_hyst <= 0
+        new_scale = jnp.where(
+            must_shift,
+            jnp.maximum(s.loss_scale / scale_factor, min_scale),
+            s.loss_scale)
+        new_hyst = jnp.where(must_shift, jnp.int32(delayed_shift), new_hyst)
+        return LossScaleState(loss_scale=new_scale, good_steps=jnp.int32(0),
+                              hysteresis=new_hyst)
+
+    def on_good(s):
+        grown = (s.good_steps + 1) % scale_window == 0
+        new_scale = jnp.where(grown, s.loss_scale * scale_factor, s.loss_scale)
+        new_hyst = jnp.where(grown, jnp.int32(delayed_shift), s.hysteresis)
+        return LossScaleState(loss_scale=new_scale, good_steps=s.good_steps + 1,
+                              hysteresis=new_hyst)
+
+    return lax.cond(overflow, on_overflow, on_good, state)
+
+
+# ---------------------------------------------------------------------------
+# Class API parity (reference LossScalerBase/LossScaler/DynamicLossScaler)
+# ---------------------------------------------------------------------------
+
+
+class LossScalerBase:
+    def __init__(self, cur_scale):
+        self.cur_scale = cur_scale
+        self.dynamic = False
+
+    @property
+    def loss_scale(self):
+        return self.cur_scale
+
+    def scale_gradient(self, module, grad_in, grad_out):
+        return tuple(self.loss_scale * g for g in grad_in)
+
+    def update_scale(self, overflow):
+        pass
+
+    def backward(self, loss, retain_graph=False):
+        # JAX has no .backward(); the engine scales loss inside its jitted
+        # grad computation. Kept for signature parity.
+        return loss * self.loss_scale
+
+
+class LossScaler(LossScalerBase):
+    """Static scaler (reference :56)."""
+
+    def __init__(self, scale=1):
+        super().__init__(scale)
+
+    def has_overflow(self, params):
+        return False
+
+    def _has_inf_or_nan(self, x):
+        return False
+
+
+class DynamicLossScaler(LossScalerBase):
+    """Host-side mirror of the dynamic state machine (reference :79)."""
+
+    def __init__(self, init_scale=2 ** 32, scale_factor=2.0, scale_window=1000,
+                 min_scale=1, delayed_shift=1, consecutive_hysteresis=False):
+        super().__init__(init_scale)
+        self.cur_iter = 0
+        self.last_overflow_iter = -1
+        self.scale_factor = scale_factor
+        self.scale_window = scale_window
+        self.min_scale = min_scale
+        self.delayed_shift = delayed_shift
+        self.cur_hysteresis = delayed_shift
+        self.consecutive_hysteresis = consecutive_hysteresis
+        self.dynamic = True
+
+    def _has_inf_or_nan(self, x):
+        a = jnp.asarray(x)
+        return bool(~jnp.isfinite(a).all())
+
+    def has_overflow(self, grads):
+        import jax
+        return any(self._has_inf_or_nan(g) for g in jax.tree.leaves(grads))
+
+    def update_scale(self, overflow):
+        if overflow:
+            if self.delayed_shift == 1 or self.cur_hysteresis == 1:
+                self.cur_scale = max(self.cur_scale / self.scale_factor, self.min_scale)
+            else:
+                self.cur_hysteresis -= 1
+            self.last_overflow_iter = self.cur_iter
+        else:
+            if self.consecutive_hysteresis:
+                self.cur_hysteresis = self.delayed_shift
+            if (self.cur_iter - self.last_overflow_iter) % self.scale_window == 0:
+                if not self.consecutive_hysteresis:
+                    self.cur_hysteresis = self.delayed_shift
+                self.cur_scale *= self.scale_factor
+        self.cur_iter += 1
+
+
+CONFIG_MAPPING = {
+    INITIAL_LOSS_SCALE: "init_scale",
+    SCALE_WINDOW: "scale_window",
+    DELAYED_SHIFT: "delayed_shift",
+    MIN_LOSS_SCALE: "min_scale",
+}
+
+
+def CreateLossScaler(dtype, static_loss_scale, dynamic_scaling, dynamic_loss_args):
+    """Factory mirroring the reference's engine wiring: fp16+dynamic →
+    DynamicLossScaler; fp16+static → LossScaler(static); bf16/fp32 →
+    LossScaler(1)."""
+    if dynamic_scaling:
+        kwargs = dynamic_loss_args or {}
+        return DynamicLossScaler(**{CONFIG_MAPPING.get(k, k): v
+                                    for k, v in kwargs.items()})
+    return LossScaler(scale=static_loss_scale if static_loss_scale else 1)

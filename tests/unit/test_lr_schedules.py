@@ -1,0 +1,116 @@
+"""LR schedule tests (reference: tests/unit/test_lr_schedulers.py)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.lr_schedules import (
+    LRRangeTest, OneCycle, WarmupDecayLR, WarmupLR, get_lr_schedule)
+
+
+class TestWarmupLR:
+    def test_linear_warmup(self):
+        s = WarmupLR(warmup_min_lr=0.0, warmup_max_lr=1.0,
+                     warmup_num_steps=10, warmup_type="linear")
+        for step in range(10):
+            s.step()
+            expected = min(1.0, step / 10)
+            assert abs(s.get_lr()[0] - expected) < 1e-6
+        for _ in range(5):
+            s.step()
+        assert s.get_lr()[0] == pytest.approx(1.0)
+
+    def test_log_warmup(self):
+        s = WarmupLR(warmup_min_lr=0.0, warmup_max_lr=1.0,
+                     warmup_num_steps=100, warmup_type="log")
+        s.step(50)
+        assert s.get_lr()[0] == pytest.approx(math.log(51) / math.log(100), rel=1e-5)
+
+    def test_state_dict_roundtrip(self):
+        s = WarmupLR(warmup_max_lr=0.1)
+        for _ in range(7):
+            s.step()
+        sd = s.state_dict()
+        s2 = WarmupLR(warmup_max_lr=0.1)
+        s2.load_state_dict(sd)
+        assert s2.get_lr() == s.get_lr()
+
+
+class TestWarmupDecayLR:
+    def test_decays_to_zero(self):
+        s = WarmupDecayLR(total_num_steps=100, warmup_max_lr=1.0,
+                          warmup_num_steps=10, warmup_type="linear")
+        s.step(10)
+        assert s.get_lr()[0] == pytest.approx(1.0)
+        s.step(55)
+        assert s.get_lr()[0] == pytest.approx(0.5)
+        s.step(100)
+        assert s.get_lr()[0] == pytest.approx(0.0)
+
+
+class TestOneCycle:
+    def test_triangle(self):
+        s = OneCycle(cycle_min_lr=0.1, cycle_max_lr=1.0,
+                     cycle_first_step_size=10)
+        s.step(0)
+        assert s.get_lr()[0] == pytest.approx(0.1)
+        s.step(10)
+        assert s.get_lr()[0] == pytest.approx(1.0)
+        s.step(20)
+        assert s.get_lr()[0] == pytest.approx(0.1, abs=1e-6)
+
+    def test_momentum_inverse(self):
+        s = OneCycle(cycle_min_lr=0.1, cycle_max_lr=1.0,
+                     cycle_first_step_size=10, cycle_momentum=True,
+                     cycle_min_mom=0.85, cycle_max_mom=0.99)
+        s.step(0)
+        assert s.get_mom()[0] == pytest.approx(0.99)
+        s.step(10)
+        assert s.get_mom()[0] == pytest.approx(0.85)
+
+    def test_decay_phase(self):
+        s = OneCycle(cycle_min_lr=0.1, cycle_max_lr=1.0,
+                     cycle_first_step_size=5, decay_lr_rate=0.5,
+                     decay_step_size=1)
+        s.step(12)  # 2 steps past the 10-step cycle
+        assert s.get_lr()[0] == pytest.approx(0.1 / (1 + 2 * 0.5))
+
+
+class TestLRRangeTest:
+    def test_continuous(self):
+        s = LRRangeTest(lr_range_test_min_lr=0.01,
+                        lr_range_test_step_size=10,
+                        lr_range_test_step_rate=1.0)
+        s.step(0)
+        assert s.get_lr()[0] == pytest.approx(0.01)
+        s.step(10)
+        assert s.get_lr()[0] == pytest.approx(0.02)
+
+    def test_staircase(self):
+        s = LRRangeTest(lr_range_test_min_lr=0.01,
+                        lr_range_test_step_size=10,
+                        lr_range_test_step_rate=1.0,
+                        lr_range_test_staircase=True)
+        s.step(9)
+        assert s.get_lr()[0] == pytest.approx(0.01)
+        s.step(10)
+        assert s.get_lr()[0] == pytest.approx(0.02)
+
+
+class TestFactory:
+    def test_by_name(self):
+        s = get_lr_schedule("WarmupLR", {"warmup_max_lr": 0.5})
+        assert isinstance(s, WarmupLR)
+
+    def test_unknown_raises(self):
+        with pytest.raises(AssertionError):
+            get_lr_schedule("Cosine", {})
+
+    def test_traced(self):
+        import jax
+        import jax.numpy as jnp
+        s = WarmupDecayLR(total_num_steps=100, warmup_max_lr=1.0,
+                          warmup_num_steps=10, warmup_type="linear")
+        fn = jax.jit(s.as_schedule_fn())
+        np.testing.assert_allclose(float(fn(jnp.int32(10))), 1.0, rtol=1e-6)
