@@ -1,0 +1,82 @@
+"""Benchmark: GPT-2 training throughput through the full engine on one chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+``vs_baseline`` compares achieved model-FLOPs TFLOPS/chip against the
+reference's headline transformer-kernel efficiency claim of 64 TFLOPS/GPU
+(docs/_posts/2020-05-28-fastest-bert-training.md:16, BASELINE.md).
+"""
+
+import json
+import time
+
+import jax
+import numpy as np
+
+REFERENCE_TFLOPS_PER_GPU = 64.0  # DeepSpeed's best published per-device claim
+
+
+def model_flops_per_token(cfg, seq_len):
+    """6*N_active + attention term, the standard training-FLOPs model."""
+    n = cfg.num_params()
+    # 6ND for matmuls + 12*L*E*S for attention scores/values
+    return 6 * n + 12 * cfg.n_layer * cfg.n_embd * seq_len
+
+
+def main():
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import (
+        GPT2Config, GPT2LMHeadModel, PRESETS, synthetic_batch)
+    from deepspeed_tpu.utils import groups
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg = PRESETS["gpt2"]          # 125M
+        batch_size, seq_len, steps = 8, 1024, 20
+    else:  # CPU smoke fallback so the bench always emits a line
+        cfg = GPT2Config(vocab_size=2048, n_positions=256, n_embd=128,
+                         n_layer=2, n_head=4)
+        batch_size, seq_len, steps = 2, 128, 3
+
+    groups.destroy()
+    groups.initialize()
+    ds_config = {
+        "train_batch_size": batch_size,
+        "train_micro_batch_size_per_gpu": batch_size // max(
+            1, groups.get_data_parallel_world_size()),
+        "steps_per_print": 10 ** 9,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+        "zero_optimization": {"stage": 0},
+        "bf16": {"enabled": True},
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=GPT2LMHeadModel(cfg), config=ds_config,
+        sample_batch=synthetic_batch(batch_size, seq_len, cfg.vocab_size))
+
+    batch = synthetic_batch(batch_size, seq_len, cfg.vocab_size, seed=1)
+    engine.train_batch(batch=batch)  # compile
+    jax.block_until_ready(engine.state.params)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        engine.train_batch(batch=batch)
+    jax.block_until_ready(engine.state.params)
+    dt = time.perf_counter() - t0
+
+    tokens_per_s = batch_size * seq_len * steps / dt
+    tflops = tokens_per_s * model_flops_per_token(cfg, seq_len) / 1e12
+    n_chips = jax.device_count()
+    tflops_per_chip = tflops / n_chips
+
+    print(json.dumps({
+        "metric": f"gpt2-{'125M' if on_tpu else 'toy'} train TFLOPS/chip "
+                  f"(bs={batch_size} seq={seq_len} bf16, full engine)",
+        "value": round(tflops_per_chip, 2),
+        "unit": "TFLOPS/chip",
+        "vs_baseline": round(tflops_per_chip / REFERENCE_TFLOPS_PER_GPU, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,199 @@
+"""GPT-2 family — the flagship causal-LM for the BASELINE.json configs
+("GPT-2 125M/350M/1.5B — ZeRO stages 1/2/3 + FusedAdam, fp16").
+
+The reference trains GPT-2 through external Megatron-LM scripts
+(tests/model/Megatron_GPT2/); the model itself is not in-tree. Here it is a
+first-class flax module designed for the TPU compute path:
+
+* attention runs through :func:`deepspeed_tpu.ops.transformer.attention`
+  (Pallas flash kernel on TPU — O(seq) memory, MXU-shaped blocks);
+* vocab padded to a multiple of 128 so the logits matmul tiles the MXU;
+* ``remat`` wraps each block in ``jax.checkpoint`` (the activation-
+  checkpointing analogue of the reference's
+  runtime/activation_checkpointing);
+* :func:`gpt2_tp_rules` gives megatron-style tensor-parallel
+  PartitionSpecs (column-parallel QKV/fc1, row-parallel proj/fc2, vocab-
+  sharded embedding) consumed by the engine's ModelParallelRules.
+
+Batch convention: dict with ``input_ids`` [B, S] (int32); optional
+``labels`` (defaults to next-token on input_ids). ``__call__`` returns the
+scalar mean cross-entropy loss (the engine convention).
+"""
+
+import dataclasses
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.ops.transformer.attention import attention
+
+
+def _pad_vocab(v: int, multiple: int = 128) -> int:
+    return ((v + multiple - 1) // multiple) * multiple
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    n_positions: int = 1024
+    n_embd: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    dropout: float = 0.0
+    remat: bool = False
+    use_flash: Optional[bool] = None   # None = auto (Pallas on TPU)
+    dtype: jnp.dtype = jnp.float32     # activation compute dtype is set by
+                                       # the engine via param cast; this is
+                                       # only for explicitly built models
+
+    @property
+    def padded_vocab(self) -> int:
+        return _pad_vocab(self.vocab_size)
+
+    def num_params(self) -> int:
+        wpe = self.n_positions * self.n_embd
+        wte = self.padded_vocab * self.n_embd
+        per_layer = (12 * self.n_embd ** 2          # qkv+proj+fc1+fc2 kernels
+                     + 13 * self.n_embd)            # biases + 2 LN
+        return wte + wpe + self.n_layer * per_layer + 2 * self.n_embd
+
+
+# Reference GPT-2 family sizes (125M/350M/774M/1.5B) — the BASELINE configs.
+PRESETS = {
+    "tiny": GPT2Config(vocab_size=512, n_positions=128, n_embd=64,
+                       n_layer=2, n_head=4),
+    "gpt2": GPT2Config(n_embd=768, n_layer=12, n_head=12),            # 125M
+    "gpt2-medium": GPT2Config(n_embd=1024, n_layer=24, n_head=16),    # 350M
+    "gpt2-large": GPT2Config(n_embd=1280, n_layer=36, n_head=20),     # 774M
+    "gpt2-xl": GPT2Config(n_embd=1600, n_layer=48, n_head=25),        # 1.5B
+}
+
+
+class CausalSelfAttention(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        cfg = self.config
+        B, S, E = x.shape
+        H, D = cfg.n_head, E // cfg.n_head
+        qkv = nn.Dense(3 * E, name="qkv",
+                       kernel_init=nn.initializers.normal(0.02))(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, S, H, D).transpose(0, 2, 1, 3)
+        k = k.reshape(B, S, H, D).transpose(0, 2, 1, 3)
+        v = v.reshape(B, S, H, D).transpose(0, 2, 1, 3)
+        out = attention(q, k, v, causal=True, use_flash=cfg.use_flash)
+        out = out.transpose(0, 2, 1, 3).reshape(B, S, E)
+        out = nn.Dense(E, name="proj",
+                       kernel_init=nn.initializers.normal(
+                           0.02 / np.sqrt(2 * cfg.n_layer)))(out)
+        if cfg.dropout > 0:
+            out = nn.Dropout(cfg.dropout)(out, deterministic=deterministic)
+        return out
+
+
+class MLP(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        cfg = self.config
+        E = x.shape[-1]
+        h = nn.Dense(4 * E, name="fc",
+                     kernel_init=nn.initializers.normal(0.02))(x)
+        h = nn.gelu(h, approximate=True)
+        h = nn.Dense(E, name="proj",
+                     kernel_init=nn.initializers.normal(
+                         0.02 / np.sqrt(2 * cfg.n_layer)))(h)
+        if cfg.dropout > 0:
+            h = nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
+        return h
+
+
+class Block(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        x = x + CausalSelfAttention(self.config, name="attn")(
+            nn.LayerNorm(epsilon=1e-5, name="ln_1")(x), deterministic)
+        x = x + MLP(self.config, name="mlp")(
+            nn.LayerNorm(epsilon=1e-5, name="ln_2")(x), deterministic)
+        return x
+
+
+class GPT2LMHeadModel(nn.Module):
+    """GPT-2 causal LM; returns mean next-token cross-entropy."""
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, batch, deterministic: Optional[bool] = None):
+        cfg = self.config
+        if isinstance(batch, (tuple, list)):
+            input_ids, labels = batch[0], (batch[1] if len(batch) > 1 else None)
+        else:
+            input_ids = batch["input_ids"]
+            labels = batch.get("labels")
+        if deterministic is None:
+            deterministic = not self.has_rng("dropout")
+
+        B, S = input_ids.shape
+        wte = self.param("wte", nn.initializers.normal(0.02),
+                         (cfg.padded_vocab, cfg.n_embd))
+        wpe = self.param("wpe", nn.initializers.normal(0.01),
+                         (cfg.n_positions, cfg.n_embd))
+        x = wte[input_ids] + wpe[None, :S].astype(wte.dtype)
+        if cfg.dropout > 0:
+            x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
+
+        block = Block
+        if cfg.remat:
+            block = nn.remat(Block, static_argnums=(2,))
+        for i in range(cfg.n_layer):
+            x = block(cfg, name=f"h_{i}")(x, deterministic)
+        x = nn.LayerNorm(epsilon=1e-5, name="ln_f")(x)
+
+        # tied LM head; fp32 logits for a stable softmax
+        logits = jnp.einsum("bse,ve->bsv", x, wte,
+                            preferred_element_type=jnp.float32)
+
+        if labels is None:
+            shift_labels = input_ids[:, 1:]
+        else:
+            shift_labels = labels[:, 1:]
+        shift_logits = logits[:, :-1]
+        logp = jax.nn.log_softmax(shift_logits, axis=-1)
+        ll = jnp.take_along_axis(logp, shift_labels[..., None], axis=-1)
+        # ignore_index=-100 convention (masked positions)
+        valid = (shift_labels >= 0).astype(jnp.float32)
+        return -(ll[..., 0] * valid).sum() / jnp.maximum(valid.sum(), 1.0)
+
+
+def gpt2_tp_rules():
+    """Megatron-style tensor-parallel rules for this model family.
+
+    Column-parallel: qkv + mlp/fc kernels split on the output dim.
+    Row-parallel: attn/proj + mlp/proj split on the input dim (XLA inserts
+    the psum the reference's RowParallelLinear issues by hand).
+    Embedding: vocab-sharded (megatron VocabParallelEmbedding).
+    """
+    return [
+        (r"\bwte$", P("model", None)),
+        (r"attn/qkv/kernel", P(None, "model")),
+        (r"attn/qkv/bias", P("model",)),
+        (r"attn/proj/kernel", P("model", None)),
+        (r"mlp/fc/kernel", P(None, "model")),
+        (r"mlp/fc/bias", P("model",)),
+        (r"mlp/proj/kernel", P("model", None)),
+    ]
+
+
+def synthetic_batch(batch_size: int, seq_len: int, vocab_size: int, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, vocab_size, (batch_size, seq_len), dtype=np.int32)
+    return {"input_ids": jnp.asarray(ids)}

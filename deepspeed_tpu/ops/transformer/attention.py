@@ -1,0 +1,71 @@
+"""Multi-head attention ops — dispatcher between the Pallas flash kernel
+and a jnp reference.
+
+This is the TPU-native replacement for the reference's fused attention
+paths: the softmax/transform kernels inside the training transformer
+(``csrc/transformer/softmax_kernels.cu``, ``transform_kernels.cu``) and the
+strided-batch-gemm attention core (``csrc/includes/strided_batch_gemm.h``).
+On TPU the entire attention block is ONE flash-attention Pallas kernel
+(O(seq) memory, online softmax); off-TPU (CPU tests) the mathematically
+identical jnp path runs.
+
+Layout convention: ``[batch, heads, seq, head_dim]`` throughout.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def mha_reference(q, k, v, *, causal=True, sm_scale=None, bias=None,
+                  mask=None):
+    """Plain-XLA attention: the parity oracle and the CPU fallback.
+
+    q,k,v: [B, H, S, D]; bias broadcastable to [B, H, Sq, Sk]; mask is a
+    boolean tensor broadcastable to the same (True = keep).
+    """
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * sm_scale
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
+    if causal:
+        sq, sk = q.shape[2], k.shape[2]
+        # offset handles decode where q is a suffix of the kv sequence
+        causal_mask = (jnp.arange(sk)[None, :] <=
+                       jnp.arange(sq)[:, None] + (sk - sq))
+        logits = jnp.where(causal_mask[None, None], logits, -1e30)
+    if mask is not None:
+        logits = jnp.where(mask, logits, -1e30)
+    weights = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", weights.astype(v.dtype), v)
+
+
+@functools.lru_cache(maxsize=None)
+def _flash_available():
+    try:
+        if jax.default_backend() != "tpu":
+            return False
+        from deepspeed_tpu.ops.transformer import flash  # noqa: F401
+        return True
+    except Exception:
+        return False
+
+
+def attention(q, k, v, *, causal=True, sm_scale=None, bias=None, mask=None,
+              use_flash: Optional[bool] = None):
+    """Dispatch: Pallas flash kernel on TPU, jnp reference elsewhere.
+
+    ``use_flash`` forces one path (tests use False for the oracle)."""
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    if use_flash is None:
+        use_flash = _flash_available() and bias is None and mask is None
+    if use_flash:
+        from deepspeed_tpu.ops.transformer import flash
+        return flash.flash_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+    return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale,
+                         bias=bias, mask=mask)

@@ -70,14 +70,17 @@ class DeepSpeedDataLoader:
     def __iter__(self):
         n = len(self.dataset)
         if self.data_sampler is not None:
+            # a user sampler already yields THIS process's indices
+            # (DistributedSampler semantics) — no further striding
             order = np.fromiter(iter(self.data_sampler), dtype=np.int64)
-        elif self.shuffle:
-            rng = np.random.default_rng(self.seed + self.epoch)
-            order = rng.permutation(n)
         else:
-            order = np.arange(n)
-        # host slice (DistributedSampler analogue): strided by process
-        order = order[self.process_index::self.process_count]
+            if self.shuffle:
+                rng = np.random.default_rng(self.seed + self.epoch)
+                order = rng.permutation(n)
+            else:
+                order = np.arange(n)
+            # host slice (DistributedSampler analogue): strided by process
+            order = order[self.process_index::self.process_count]
         limit = self.len * self.batch_size
         for start in range(0, min(len(order), limit), self.batch_size):
             idx = order[start:start + self.batch_size]

@@ -59,14 +59,17 @@ LATEST_FILE = "latest"
 
 
 class TrainState(NamedTuple):
-    """All mutable training state, as one donated pytree."""
-    step: jnp.ndarray          # global (optimizer) steps taken
-    micro_step: jnp.ndarray    # micro-batches since last boundary
+    """All mutable training state, as one donated pytree.
+
+    ``step`` counts APPLIED (non-skipped) optimizer steps — it indexes the
+    LR schedule inside the compiled apply step. Micro-step and skipped-step
+    counters live host-side only (self.micro_steps / self.skipped_steps);
+    keeping device copies would create a second source of truth."""
+    step: jnp.ndarray          # applied optimizer steps
     params: Any                # fp32 master parameters
     opt_state: Any
     acc_grads: Any             # fp32 accumulation buffer (ZeRO-sharded)
     scale: LossScaleState
-    skipped_steps: jnp.ndarray
 
 
 def _cast_tree(tree, dtype):
@@ -305,27 +308,24 @@ class DeepSpeedEngine:
 
         scalar_sh = NamedSharding(self.mesh, P())
         self.state_shardings = TrainState(
-            step=scalar_sh, micro_step=scalar_sh,
+            step=scalar_sh,
             params=self.param_shardings,
             opt_state=self.opt_shardings,
             acc_grads=self.grad_shardings,
             scale=LossScaleState(loss_scale=scalar_sh, good_steps=scalar_sh,
-                                 hysteresis=scalar_sh),
-            skipped_steps=scalar_sh)
+                                 hysteresis=scalar_sh))
 
         # Build the initial state ON the mesh with one compiled init fn so
         # every leaf is born sharded (no host round-trip of full params).
         def make_state(p):
             return TrainState(
                 step=jnp.zeros([], jnp.int32),
-                micro_step=jnp.zeros([], jnp.int32),
                 params=p,
                 opt_state=self.optimizer.init(p),
                 acc_grads=jax.tree.map(lambda x: jnp.zeros_like(x, jnp.float32), p),
                 scale=make_scale_state(
                     self._init_scale,
-                    delayed_shift=self.config.fp16.hysteresis),
-                skipped_steps=jnp.zeros([], jnp.int32))
+                    delayed_shift=self.config.fp16.hysteresis))
 
         with self.mesh:
             params = jax.device_put(params, self.param_shardings)
@@ -373,8 +373,7 @@ class DeepSpeedEngine:
             grads = self._grad_constraint(grads)
             acc = jax.tree.map(jnp.add, state.acc_grads, grads)
             loss = sloss * gas / state.scale.loss_scale
-            return state._replace(micro_step=state.micro_step + 1,
-                                  acc_grads=acc), loss
+            return state._replace(acc_grads=acc), loss
 
         def apply_step(state):
             inv_scale = 1.0 / state.scale.loss_scale
@@ -401,7 +400,7 @@ class DeepSpeedEngine:
 
             def skip_update(operand):
                 st, _ = operand
-                return st._replace(skipped_steps=st.skipped_steps + 1)
+                return st
 
             state = jax.lax.cond(finite, do_update, skip_update, (state, grads))
             new_scale = update_scale(
@@ -411,8 +410,7 @@ class DeepSpeedEngine:
                 min_scale=cfg.fp16.min_loss_scale,
                 delayed_shift=cfg.fp16.hysteresis)
             zeros = jax.tree.map(jnp.zeros_like, state.acc_grads)
-            return state._replace(micro_step=jnp.zeros([], jnp.int32),
-                                  acc_grads=zeros, scale=new_scale), \
+            return state._replace(acc_grads=zeros, scale=new_scale), \
                 grad_norm, ~finite
 
         sh = self.state_shardings
@@ -614,8 +612,11 @@ class DeepSpeedEngine:
             self.global_samples = sd.get("global_samples", 0)
             self.skipped_steps = sd.get("skipped_steps", 0)
             self.micro_steps = sd.get("micro_steps", 0)
+            # state.step counts APPLIED steps only (it indexes the LR
+            # schedule), so skipped steps must be subtracted on restore.
             new_state = new_state._replace(
-                step=jnp.asarray(self.global_steps, jnp.int32),
+                step=jnp.asarray(self.global_steps - self.skipped_steps,
+                                 jnp.int32),
                 scale=new_state.scale._replace(
                     loss_scale=jnp.float32(sd.get("loss_scale", 1.0))))
             if load_lr_scheduler_states and self.lr_scheduler is not None \
@@ -624,6 +625,10 @@ class DeepSpeedEngine:
 
             if load_optimizer_states:
                 zpath = self._get_zero_ckpt_name(load_dir, tag)
+                if not os.path.isfile(zpath):
+                    logger.warning(
+                        f"optimizer-state file {zpath} missing; resuming "
+                        f"with FRESH optimizer state and loss scale")
                 if os.path.isfile(zpath):
                     with open(zpath, "rb") as f:
                         zsd = pickle.load(f)
