@@ -33,7 +33,7 @@ def main():
     on_tpu = jax.default_backend() == "tpu"
     if on_tpu:
         cfg = PRESETS["gpt2"]          # 125M
-        batch_size, seq_len, steps = 8, 1024, 20
+        batch_size, seq_len, steps = 16, 1024, 20
     else:  # CPU smoke fallback so the bench always emits a line
         cfg = GPT2Config(vocab_size=2048, n_positions=256, n_embd=128,
                          n_layer=2, n_head=4)

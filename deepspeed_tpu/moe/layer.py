@@ -1,0 +1,92 @@
+"""User-facing MoE API.
+
+Rebuild of deepspeed/moe/layer.py (``MoE`` :18): same constructor surface
+(hidden_size, expert, num_experts, k, capacity factors, noisy gating, RTS,
+use_residual for MoS) as a flax module. Where the reference mutates global
+process groups on first use (layer.py:40 ``initialize`` call), here the
+expert axis already exists on the mesh (utils/groups.py) and the stacked
+expert params shard over it declaratively (moe/sharding rules below).
+"""
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.moe.sharded_moe import MOELayer
+
+
+class MLPExpert(nn.Module):
+    """Default FFN expert (what DeepSpeedExamples passes as ``expert``)."""
+    hidden_size: int
+    intermediate_size: Optional[int] = None
+
+    @nn.compact
+    def __call__(self, x):
+        inner = self.intermediate_size or 4 * self.hidden_size
+        h = nn.Dense(inner, name="fc1")(x)
+        h = nn.gelu(h, approximate=True)
+        return nn.Dense(self.hidden_size, name="fc2")(h)
+
+
+class MoE(nn.Module):
+    """Mixture of experts layer (reference moe/layer.py:18).
+
+    Returns (output, l_aux, exp_counts) exactly like the reference's
+    ``MoE.forward`` (layer.py:98)."""
+    hidden_size: int
+    expert: Any = None                  # flax module CLASS for one expert
+    expert_kwargs: Optional[dict] = None
+    num_experts: int = 1
+    ep_size: int = 1                    # informational; mesh axis rules
+    k: int = 1
+    capacity_factor: float = 1.0
+    eval_capacity_factor: float = 1.0
+    min_capacity: int = 4
+    use_residual: bool = False          # MoS (residual MoE)
+    noisy_gate_policy: Optional[str] = None
+    drop_tokens: bool = True
+    use_rts: bool = True
+
+    @nn.compact
+    def __call__(self, hidden_states, used_token=None, train=True):
+        expert_cls = self.expert or MLPExpert
+        kwargs = dict(self.expert_kwargs or {})
+        if expert_cls is MLPExpert and "hidden_size" not in kwargs:
+            kwargs["hidden_size"] = self.hidden_size
+
+        out, l_aux, exp_counts = MOELayer(
+            expert_module=expert_cls,
+            expert_kwargs=kwargs,
+            num_experts=self.num_experts,
+            k=self.k,
+            capacity_factor=self.capacity_factor,
+            eval_capacity_factor=self.eval_capacity_factor,
+            min_capacity=self.min_capacity,
+            noisy_gate_policy=self.noisy_gate_policy,
+            drop_tokens=self.drop_tokens,
+            use_rts=self.use_rts,
+            name="deepspeed_moe")(hidden_states, train,
+                                  used_token=used_token)
+
+        if self.use_residual:
+            # Mixture-of-Students residual path (reference layer.py:98-113)
+            mlp_out = MLPExpert(self.hidden_size, name="mlp")(hidden_states)
+            coef = nn.Dense(2, name="coefficient")(hidden_states)
+            coef = nn.softmax(coef, axis=-1)
+            out = out * coef[..., 0:1] + mlp_out * coef[..., 1:2]
+        return out, l_aux, exp_counts
+
+
+def moe_sharding_rules():
+    """ModelParallelRules entries for stacked expert params: the leading
+    expert dim shards over the mesh expert axis (the EP analogue of
+    reference groups initialize_expert_parallel)."""
+    return [(r"deepspeed_experts.*", P("expert"))]
+
+
+def is_moe_param(path: str) -> bool:
+    """Reference moe/utils.py:18 checks param.allreduce == False; here
+    expert params are identified by their module path."""
+    return "deepspeed_experts" in path

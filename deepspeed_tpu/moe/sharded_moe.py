@@ -1,0 +1,241 @@
+"""GShard-style top-k gating and the sharded MoE layer.
+
+TPU-native rebuild of deepspeed/moe/sharded_moe.py (``top1gating`` :170,
+``top2gating`` :271, ``TopKGate`` :343, ``MOELayer`` :473). The gating math
+is identical tensor algebra; the transport differs: the reference wraps
+``dist.all_to_all_single`` in an autograd function (``_AllToAll`` :84),
+while here the dispatched [E, C, M] tensor carries a
+``with_sharding_constraint(P("expert", ...))`` and XLA lowers the
+resharding to an ICI all-to-all (and its transpose in the backward pass) —
+the GSPMD formulation of the same exchange.
+
+Capacity is static (derived from shapes), so the whole layer jits with
+fixed shapes; token overflow drops follow the reference's policy.
+"""
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.utils import groups
+
+
+def _capacity(num_tokens: int, num_experts: int, capacity_factor: float,
+              min_capacity: int) -> int:
+    """Static per-expert capacity (reference sharded_moe.py:120)."""
+    cap = int(np.ceil(num_tokens / num_experts * capacity_factor))
+    return max(cap, min_capacity)
+
+
+def _one_hot(idx, num):
+    return jax.nn.one_hot(idx, num, dtype=jnp.float32)
+
+
+def _expert_constraint(x):
+    """Shard dim 0 (experts) over the expert mesh axis when a mesh is
+    active — this is the all-to-all insertion point."""
+    if not groups.mesh_is_initialized():
+        return x
+    mesh = groups.get_mesh()
+    if mesh.shape[groups.EXPERT_AXIS] == 1:
+        return x
+    spec = P(groups.EXPERT_AXIS, *([None] * (x.ndim - 1)))
+    return jax.lax.with_sharding_constraint(
+        x, jax.sharding.NamedSharding(mesh, spec))
+
+
+def top1gating(logits, capacity_factor=1.0, min_capacity=4,
+               noisy_gate_policy: Optional[str] = None, noise_rng=None,
+               drop_tokens=True, use_rts=True, used_token=None):
+    """Top-1 gating (reference sharded_moe.py:170).
+
+    logits: [S, E]. Returns (l_aux, combine_weights [S,E,C],
+    dispatch_mask [S,E,C] bool, exp_counts [E])."""
+    S, E = logits.shape
+    # drop_tokens=False must never drop: the reference grows capacity to
+    # max(exp_counts) at runtime (sharded_moe.py:207); under jit capacity
+    # must be static, so use the worst case (all tokens on one expert).
+    C = S if not drop_tokens else _capacity(S, E, capacity_factor,
+                                            min_capacity)
+
+    if noisy_gate_policy == "RSample" and noise_rng is not None:
+        logits_w_noise = logits + jax.random.normal(noise_rng, logits.shape)
+    else:
+        logits_w_noise = logits
+
+    gates = jax.nn.softmax(logits, axis=1)
+    indices1_s = jnp.argmax(logits_w_noise, axis=1)
+    mask1 = _one_hot(indices1_s, E)
+    if used_token is not None:
+        mask1 = mask1 * used_token[:, None]
+
+    exp_counts = jnp.sum(mask1, axis=0)
+
+    # load-balancing auxiliary loss (GShard eq. 4; reference :225)
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(mask1, axis=0)
+    l_aux = jnp.sum(me * ce) * E
+
+    # Random Token Selection: prioritise tokens by uniform noise instead of
+    # sequence order when over capacity (reference :238-247)
+    if use_rts and noise_rng is not None:
+        rts_key = jax.random.fold_in(noise_rng, 1)
+        priority = jax.random.uniform(rts_key, (S,))
+    else:
+        priority = -jnp.arange(S, dtype=jnp.float32)  # earlier tokens win
+
+    # rank tokens per expert by priority: position of each token within its
+    # expert's queue (stable ordering via sorted cumsum)
+    order = jnp.argsort(-priority)               # high priority first
+    mask1_sorted = mask1[order]
+    loc_sorted = jnp.cumsum(mask1_sorted, axis=0) - 1.0
+    inv = jnp.argsort(order)
+    locations1 = jnp.sum(loc_sorted[inv] * mask1, axis=1)  # [S]
+
+    if drop_tokens:
+        keep = locations1 < C
+        mask1 = mask1 * keep[:, None]
+
+    gates1_s = jnp.sum(gates * mask1, axis=1)              # [S]
+    locations1_sc = _one_hot(locations1.astype(jnp.int32), C)  # [S, C]
+    combine = gates1_s[:, None, None] * mask1[:, :, None] * \
+        locations1_sc[:, None, :]                          # [S, E, C]
+    dispatch = combine.astype(bool)
+    return l_aux, combine, dispatch, exp_counts
+
+
+def top2gating(logits, capacity_factor=1.0, min_capacity=4, noise_rng=None):
+    """Top-2 gating (reference sharded_moe.py:271): second expert chosen
+    after masking the first; gate pair renormalised."""
+    S, E = logits.shape
+    C = _capacity(S, E, capacity_factor * 2, min_capacity)
+
+    gates = jax.nn.softmax(logits, axis=1)
+    indices1_s = jnp.argmax(gates, axis=1)
+    mask1 = _one_hot(indices1_s, E)
+
+    if noise_rng is not None:
+        logits_w_noise = logits + jax.random.gumbel(noise_rng, logits.shape)
+    else:
+        logits_w_noise = logits
+    logits_except1 = jnp.where(mask1.astype(bool), -jnp.inf, logits_w_noise)
+    indices2_s = jnp.argmax(logits_except1, axis=1)
+    mask2 = _one_hot(indices2_s, E)
+
+    locations1 = jnp.cumsum(mask1, axis=0) - 1.0
+    locations2 = jnp.cumsum(mask2, axis=0) - 1.0 + \
+        jnp.sum(mask1, axis=0, keepdims=True)
+
+    exp_counts = jnp.sum(mask1 + mask2, axis=0)
+
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(mask1, axis=0)
+    l_aux = jnp.sum(me * ce) * E
+
+    loc1_s = jnp.sum(locations1 * mask1, axis=1)
+    loc2_s = jnp.sum(locations2 * mask2, axis=1)
+    mask1 = mask1 * (loc1_s < C)[:, None]
+    mask2 = mask2 * (loc2_s < C)[:, None]
+
+    gates1_s = jnp.sum(gates * mask1, axis=1)
+    gates2_s = jnp.sum(gates * mask2, axis=1)
+    denom = gates1_s + gates2_s
+    denom = jnp.where(denom < 1e-9, 1.0, denom)
+    gates1_s /= denom
+    gates2_s /= denom
+
+    combine = (gates1_s[:, None, None] * mask1[:, :, None] *
+               _one_hot(loc1_s.astype(jnp.int32), C)[:, None, :] +
+               gates2_s[:, None, None] * mask2[:, :, None] *
+               _one_hot(loc2_s.astype(jnp.int32), C)[:, None, :])
+    dispatch = combine.astype(bool)
+    return l_aux, combine, dispatch, exp_counts
+
+
+class TopKGate(nn.Module):
+    """Gate network (reference TopKGate :343): fp32 linear + top-k."""
+    num_experts: int
+    k: int = 1
+    capacity_factor: float = 1.0
+    eval_capacity_factor: float = 1.0
+    min_capacity: int = 4
+    noisy_gate_policy: Optional[str] = None
+    drop_tokens: bool = True
+    use_rts: bool = True
+
+    @nn.compact
+    def __call__(self, x, train=True, used_token=None):
+        # gate runs in fp32 always (reference :368 autocast exemption)
+        wg = self.param("wg", nn.initializers.lecun_normal(),
+                        (x.shape[-1], self.num_experts))
+        logits = jnp.dot(x.astype(jnp.float32), wg.astype(jnp.float32))
+        rng = None
+        if train and (self.use_rts or self.noisy_gate_policy):
+            if self.has_rng("gating"):
+                rng = self.make_rng("gating")
+        cf = self.capacity_factor if train else self.eval_capacity_factor
+        if self.k == 1:
+            return top1gating(logits, cf, self.min_capacity,
+                              self.noisy_gate_policy if train else None,
+                              rng, self.drop_tokens, self.use_rts,
+                              used_token=used_token)
+        return top2gating(logits, cf, self.min_capacity, rng)
+
+
+class MOELayer(nn.Module):
+    """Dispatch → experts → combine (reference MOELayer :473).
+
+    ``expert_fn`` is a flax module class for ONE expert; it is vmapped over
+    a leading expert axis with split params, giving stacked [E, ...] expert
+    weights that shard over the mesh's expert axis."""
+    expert_module: type
+    expert_kwargs: dict
+    num_experts: int
+    k: int = 1
+    capacity_factor: float = 1.0
+    eval_capacity_factor: float = 1.0
+    min_capacity: int = 4
+    noisy_gate_policy: Optional[str] = None
+    drop_tokens: bool = True
+    use_rts: bool = True
+
+    @nn.compact
+    def __call__(self, x, train=True, used_token=None):
+        orig_shape = x.shape
+        M = orig_shape[-1]
+        xf = x.reshape(-1, M)                                # [S, M]
+        if used_token is not None:
+            used_token = used_token.reshape(-1)
+
+        l_aux, combine, dispatch, exp_counts = TopKGate(
+            num_experts=self.num_experts, k=self.k,
+            capacity_factor=self.capacity_factor,
+            eval_capacity_factor=self.eval_capacity_factor,
+            min_capacity=self.min_capacity,
+            noisy_gate_policy=self.noisy_gate_policy,
+            drop_tokens=self.drop_tokens, use_rts=self.use_rts,
+            name="gate")(xf, train, used_token=used_token)
+
+        # dispatch: [S,E,C] × [S,M] → [E,C,M]; the expert-axis constraint
+        # makes XLA insert the all-to-all (reference _AllToAll :84/:507)
+        dispatched = jnp.einsum("sec,sm->ecm",
+                                dispatch.astype(xf.dtype), xf)
+        dispatched = _expert_constraint(dispatched)
+
+        experts = nn.vmap(
+            self.expert_module,
+            in_axes=0, out_axes=0,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+            metadata_params={nn.PARTITION_NAME: "expert"},
+        )(name="deepspeed_experts", **self.expert_kwargs)
+        expert_out = experts(dispatched)                     # [E, C, M]
+        expert_out = _expert_constraint(expert_out)
+
+        combined = jnp.einsum("sec,ecm->sm",
+                              combine.astype(expert_out.dtype), expert_out)
+        return combined.reshape(orig_shape), l_aux, exp_counts

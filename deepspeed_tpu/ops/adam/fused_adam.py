@@ -1,0 +1,134 @@
+"""FusedAdam — the Adam update as a single Pallas kernel per shard.
+
+TPU-native equivalent of the reference's multi-tensor Adam
+(csrc/adam/multi_tensor_adam.cu + wrapper ops/adam/fused_adam.py:16): one
+elementwise kernel reads (p, g, m, v) once from HBM and writes (update, m,
+v) — the fused chain the CUDA kernel hand-schedules over 512-element
+chunks. Exposed two ways:
+
+* :func:`fused_adam_update` — raw per-tensor kernel;
+* :func:`fused_adam` — a runtime ``Optimizer(init, update)`` drop-in that
+  the engine selects via config ``optimizer.params.fused=true``; its jnp
+  twin (runtime/optim.py:adam) is the default since XLA fuses the same
+  chain automatically. Both are parity-tested (test_fused_ops.py) — keep
+  whichever profiles faster on your slice.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from deepspeed_tpu.runtime import optim as optim_lib
+
+_BLOCK_ROWS = 256
+_LANES = 128
+
+
+def _interpret():
+    return jax.default_backend() != "tpu"
+
+
+def _adam_kernel(s_ref, p_ref, g_ref, m_ref, v_ref,
+                 u_ref, mo_ref, vo_ref, *, b1, b2, eps, weight_decay,
+                 adam_w_mode):
+    lr, bc1, bc2 = s_ref[0, 0], s_ref[0, 1], s_ref[0, 2]
+    p = p_ref[:].astype(jnp.float32)
+    g = g_ref[:].astype(jnp.float32)
+    if not adam_w_mode and weight_decay > 0.0:
+        g = g + weight_decay * p
+    m = b1 * m_ref[:] + (1.0 - b1) * g
+    v = b2 * v_ref[:] + (1.0 - b2) * g * g
+    u = -lr * (m / bc1) / (jnp.sqrt(v / bc2) + eps)
+    if adam_w_mode and weight_decay > 0.0:
+        u = u - lr * weight_decay * p
+    u_ref[:] = u.astype(u_ref.dtype)
+    mo_ref[:] = m
+    vo_ref[:] = v
+
+
+def fused_adam_update(p, g, m, v, lr, bc1, bc2, *, b1=0.9, b2=0.999,
+                      eps=1e-8, weight_decay=0.0, adam_w_mode=True):
+    """One fused Adam step for a single tensor; returns (update, m, v).
+
+    lr/bc1/bc2 are traced scalars (LR schedules stay inside jit)."""
+    shape, dtype = p.shape, p.dtype
+    n = p.size
+    width = _BLOCK_ROWS * _LANES
+    n_pad = -(-n // width) * width
+
+    def flat(x, fill=0.0):
+        xf = jnp.ravel(x)
+        return jnp.pad(xf, (0, n_pad - n), constant_values=fill).reshape(
+            -1, _LANES)
+
+    scal = jnp.stack([jnp.asarray(lr, jnp.float32),
+                      jnp.asarray(bc1, jnp.float32),
+                      jnp.asarray(bc2, jnp.float32)]).reshape(1, 3)
+    rows = n_pad // _LANES
+    kernel = functools.partial(_adam_kernel, b1=b1, b2=b2, eps=eps,
+                               weight_decay=weight_decay,
+                               adam_w_mode=adam_w_mode)
+    grid = (rows // _BLOCK_ROWS,)
+    blk = pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0))
+    u, m_new, v_new = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[pl.BlockSpec((1, 3), lambda i: (0, 0)),
+                  blk, blk, blk, blk],
+        out_specs=[blk, blk, blk],
+        out_shape=[jax.ShapeDtypeStruct((rows, _LANES), dtype),
+                   jax.ShapeDtypeStruct((rows, _LANES), jnp.float32),
+                   jax.ShapeDtypeStruct((rows, _LANES), jnp.float32)],
+        interpret=_interpret(),
+    )(scal, flat(p), flat(g), flat(m), flat(v))
+
+    unflat = lambda x: jnp.ravel(x)[:n].reshape(shape)
+    return (unflat(u).astype(dtype), unflat(m_new), unflat(v_new))
+
+
+def fused_adam(b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.0,
+               adam_w_mode=True, bias_correction=True):
+    """Optimizer pair backed by the Pallas kernel (reference FusedAdam)."""
+
+    def init(params):
+        return optim_lib.AdamState(
+            step=jnp.zeros([], jnp.int32),
+            mu=jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params),
+            nu=jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params))
+
+    def update(grads, state, params, lr):
+        step = state.step + 1
+        if bias_correction:
+            bc1 = 1.0 - b1 ** step.astype(jnp.float32)
+            bc2 = 1.0 - b2 ** step.astype(jnp.float32)
+        else:
+            bc1 = bc2 = jnp.float32(1.0)
+
+        flat_p, treedef = jax.tree.flatten(params)
+        flat_g = treedef.flatten_up_to(grads)
+        flat_m = treedef.flatten_up_to(state.mu)
+        flat_v = treedef.flatten_up_to(state.nu)
+        out = [fused_adam_update(p, g, m, v, lr, bc1, bc2, b1=b1, b2=b2,
+                                 eps=eps, weight_decay=weight_decay,
+                                 adam_w_mode=adam_w_mode)
+               for p, g, m, v in zip(flat_p, flat_g, flat_m, flat_v)]
+        updates = treedef.unflatten([o[0] for o in out])
+        mu = treedef.unflatten([o[1] for o in out])
+        nu = treedef.unflatten([o[2] for o in out])
+        return updates, optim_lib.AdamState(step=step, mu=mu, nu=nu)
+
+    return optim_lib.Optimizer(init, update)
+
+
+class FusedAdam:
+    """API-parity shell of the reference wrapper (ops/adam/fused_adam.py:16);
+    construct and pass as ``optimizer=`` to ``initialize``."""
+
+    def __new__(cls, params=None, lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
+                weight_decay=0.0, adam_w_mode=True, bias_correction=True,
+                **_):
+        return fused_adam(b1=betas[0], b2=betas[1], eps=eps,
+                          weight_decay=weight_decay, adam_w_mode=adam_w_mode,
+                          bias_correction=bias_correction)

@@ -1,0 +1,292 @@
+"""Flash attention — Pallas TPU kernels with a custom VJP.
+
+The TPU-native replacement for the reference's attention core: the
+softmax kernels (csrc/transformer/softmax_kernels.cu), the attention-score
+strided-batch GEMMs (csrc/includes/strided_batch_gemm.h) and the attn
+``attn_dropout_checkpoint`` memory knobs of the fused transformer layer
+(csrc/transformer/ds_transformer_cuda.cpp). Online-softmax tiling keeps
+memory O(seq) instead of O(seq^2) — the kernel never materialises the
+[S, S] score matrix, which is what lets the TPU build run the long-context
+configs (SURVEY.md §5.7) densely where the reference needed block-sparsity.
+
+Layout: [batch, heads, seq, head_dim]; grid over (batch*heads, blocks);
+fp32 accumulators in VMEM; causal blocks above the diagonal are skipped via
+the loop bound (not masked), so causal attention does ~half the FLOPs.
+
+All kernels run in interpret mode off-TPU so CPU tests exercise the same
+code path bit-for-bit (tests/unit/test_flash.py).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu only imports on TPU-enabled jaxlibs; interpret mode needs no TPU
+    from jax.experimental.pallas import tpu as pltpu
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+
+def _interpret():
+    return jax.default_backend() != "tpu"
+
+
+NEG_INF = -1e30
+LANES = 8  # replication width for per-row stats (lse/delta) — see _fwd_kernel
+
+
+# --------------------------------------------------------------------- forward
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
+                block_q, block_k, seq_k, offset):
+    qi = pl.program_id(1)
+    q = q_ref[0]  # [BQ, D] native dtype — bf16 operands keep the MXU at
+    # full rate; accumulation is f32 via preferred_element_type
+
+    num_kv = pl.cdiv(seq_k, block_k)
+    if causal:
+        # last kv block that intersects rows [qi*BQ, (qi+1)*BQ) after the
+        # decode suffix offset (q rows map to keys [0, row + offset])
+        num_kv = jnp.minimum(num_kv,
+                             pl.cdiv((qi + 1) * block_q + offset, block_k))
+
+    def body(j, carry):
+        acc, m, l = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :]
+        v = v_ref[0, pl.ds(j * block_k, block_k), :]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(cols <= rows + offset, s, NEG_INF)
+
+        m_new = jnp.maximum(m, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=1)
+        acc = acc * alpha[:, None] + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc, m_new, l_new
+
+    d = q.shape[-1]
+    acc = jnp.zeros((block_q, d), jnp.float32)
+    m = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l = jnp.zeros((block_q,), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, num_kv, body, (acc, m, l))
+
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    # lse is replicated over LANES trailing lanes so the 2D-per-row value
+    # satisfies the TPU (8, 128)-tile constraint (same trick as jax's own
+    # flash kernel, which pads to 128; 8 keeps the buffer small)
+    lse_ref[0] = jnp.broadcast_to((m + jnp.log(l_safe))[:, None],
+                                  (block_q, LANES))
+
+
+# -------------------------------------------------------------------- backward
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+               sm_scale, causal, block_q, block_k, seq_k, offset):
+    qi = pl.program_id(1)
+    q = q_ref[0]
+    do = do_ref[0]
+    lse = lse_ref[0, :, 0:1]      # [BQ, 1] (lane-replicated stats)
+    delta = delta_ref[0, :, 0:1]
+
+    num_kv = pl.cdiv(seq_k, block_k)
+    if causal:
+        num_kv = jnp.minimum(num_kv,
+                             pl.cdiv((qi + 1) * block_q + offset, block_k))
+
+    def body(j, dq):
+        k = k_ref[0, pl.ds(j * block_k, block_k), :]
+        v = v_ref[0, pl.ds(j * block_k, block_k), :]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(cols <= rows + offset, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        return dq + jax.lax.dot_general(ds.astype(k.dtype), k,
+                                        (((1,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+
+    dq = jnp.zeros(q.shape, jnp.float32)
+    dq = jax.lax.fori_loop(0, num_kv, body, dq)
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, *, sm_scale, causal, block_q, block_k, seq_q,
+                offset):
+    kj = pl.program_id(1)
+    k = k_ref[0]  # [BK, D]
+    v = v_ref[0]
+
+    num_q = pl.cdiv(seq_q, block_q)
+    start_q = jnp.int32(0)
+    if causal:
+        # first q block whose last key index (row + offset) reaches kj*BK
+        start_q = jnp.maximum(kj * block_k - offset, 0) // block_q
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * block_q, block_q), :]
+        do = do_ref[0, pl.ds(i * block_q, block_q), :]
+        lse = lse_ref[0, pl.ds(i * block_q, block_q), 0:1]      # [BQ, 1]
+        delta = delta_ref[0, pl.ds(i * block_q, block_q), 0:1]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            rows = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = kj * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(cols <= rows + offset, s, NEG_INF)
+        p = jnp.exp(s - lse)                                # [BQ, BK]
+        dv = dv + jax.lax.dot_general(p.astype(do.dtype), do,
+                                      (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        dk = dk + jax.lax.dot_general(ds.astype(q.dtype), q,
+                                      (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        return dk, dv
+
+    dk = jnp.zeros(k.shape, jnp.float32)
+    dv = jnp.zeros(v.shape, jnp.float32)
+    dk, dv = jax.lax.fori_loop(start_q, num_q, body, (dk, dv))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+# ------------------------------------------------------------------ dispatch
+def _pick_block(seq, target=512):
+    b = min(seq, target)
+    while seq % b:
+        b //= 2
+    return max(b, 1)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention(q, k, v, causal=True, sm_scale=None):
+    out, _ = _flash_fwd(q, k, v, causal, sm_scale)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, sm_scale):
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    bq, bk = _pick_block(Sq), _pick_block(Sk)
+    qf = q.reshape(B * H, Sq, D)
+    kf = k.reshape(B * H, Sk, D)
+    vf = v.reshape(B * H, Sk, D)
+
+    kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
+                               block_q=bq, block_k=bk, seq_k=Sk,
+                               offset=Sk - Sq)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(B * H, Sq // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, LANES), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, Sq, LANES), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(qf, kf, vf)
+    out = o.reshape(B, H, Sq, D)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, sm_scale, res, g):
+    q, k, v, out, lse = res
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    bq, bk = _pick_block(Sq), _pick_block(Sk)
+
+    qf = q.reshape(B * H, Sq, D)
+    kf = k.reshape(B * H, Sk, D)
+    vf = v.reshape(B * H, Sk, D)
+    dof = g.reshape(B * H, Sq, D)
+    # delta = rowsum(do * o): the softmax-jacobian correction term,
+    # lane-replicated like lse
+    delta = jnp.broadcast_to(
+        jnp.sum(dof.astype(jnp.float32) *
+                out.reshape(B * H, Sq, D).astype(jnp.float32),
+                axis=-1, keepdims=True),
+        (B * H, Sq, LANES))
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=bq, block_k=bk, seq_k=Sk, offset=Sk - Sq),
+        grid=(B * H, Sq // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, LANES), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, LANES), lambda b, i: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
+        interpret=_interpret(),
+    )(qf, kf, vf, dof, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=bq, block_k=bk, seq_q=Sq, offset=Sk - Sq),
+        grid=(B * H, Sk // bk),
+        in_specs=[
+            pl.BlockSpec((1, Sq, D), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, Sq, D), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, Sq, LANES), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, Sq, LANES), lambda b, j: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Sk, D), k.dtype),
+            jax.ShapeDtypeStruct((B * H, Sk, D), v.dtype),
+        ],
+        interpret=_interpret(),
+    )(qf, kf, vf, dof, lse, delta)
+
+    return (dq.reshape(B, H, Sq, D), dk.reshape(B, H, Sk, D),
+            dv.reshape(B, H, Sk, D))
+
+
+flash_attention.defvjp(lambda q, k, v, causal, sm_scale:
+                       _flash_fwd(q, k, v, causal, sm_scale),
+                       _flash_bwd)

@@ -233,23 +233,35 @@ class DeepSpeedEngine:
         betas = params.pop("betas", (0.9, 0.999))
         torch_adam = params.pop("torch_adam", False)
         params.pop("max_grad_norm", None)
+        # "fused": use the Pallas kernel path (ops/adam, ops/lamb) instead
+        # of the XLA-fused jnp update; both are bit-compatible.
+        use_fused = params.pop("fused", False)
 
         if name in (ADAM_OPTIMIZER, ADAMW_OPTIMIZER, ONEBIT_ADAM_OPTIMIZER):
             # Reference: both "adam" and "adamw" route to FusedAdam, which
             # defaults to adam_w_mode=True (ops/adam/fused_adam.py:16).
             adam_w_mode = params.pop("adam_w_mode", True)
             del torch_adam
-            return optim_lib.adam(b1=betas[0], b2=betas[1],
-                                  eps=params.get("eps", 1e-8),
-                                  weight_decay=params.get("weight_decay", 0.0),
-                                  adam_w_mode=adam_w_mode,
-                                  bias_correction=params.get("bias_correction", True))
+            kw = dict(b1=betas[0], b2=betas[1],
+                      eps=params.get("eps", 1e-8),
+                      weight_decay=params.get("weight_decay", 0.0),
+                      adam_w_mode=adam_w_mode,
+                      bias_correction=params.get("bias_correction", True))
+            if use_fused:
+                from deepspeed_tpu.ops.adam.fused_adam import fused_adam
+                return fused_adam(**kw)
+            return optim_lib.adam(**kw)
         if name in (LAMB_OPTIMIZER, ONEBIT_LAMB_OPTIMIZER):
-            return optim_lib.lamb(b1=betas[0], b2=betas[1],
-                                  eps=params.get("eps", 1e-6),
-                                  weight_decay=params.get("weight_decay", 0.0),
-                                  min_coeff=params.get("min_coeff", 0.01),
-                                  max_coeff=params.get("max_coeff", 10.0))
+            kw = dict(b1=betas[0], b2=betas[1],
+                      eps=params.get("eps", 1e-6),
+                      weight_decay=params.get("weight_decay", 0.0),
+                      min_coeff=params.get("min_coeff", 0.01),
+                      max_coeff=params.get("max_coeff", 10.0),
+                      bias_correction=params.get("bias_correction", True))
+            if use_fused:
+                from deepspeed_tpu.ops.lamb.fused_lamb import fused_lamb
+                return fused_lamb(**kw)
+            return optim_lib.lamb(**kw)
         if name == SGD_OPTIMIZER:
             return optim_lib.sgd(momentum=params.get("momentum", 0.0),
                                  weight_decay=params.get("weight_decay", 0.0),

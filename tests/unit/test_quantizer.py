@@ -1,0 +1,49 @@
+"""Quantizer op semantics (reference csrc/quantization parity intent)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.quantizer.quantizer import quantize
+
+
+def test_symmetric_roundtrip_error_bounded():
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 512))
+    y = quantize(x, num_bits=8, groups=4)
+    scale = np.abs(np.asarray(x)).reshape(4, -1).max(axis=1) / 127.0
+    err = np.abs(np.asarray(y - x)).reshape(4, -1).max(axis=1)
+    assert (err <= scale * 0.5 + 1e-7).all()
+
+
+def test_asymmetric_roundtrip_error_bounded():
+    x = jax.random.uniform(jax.random.PRNGKey(1), (2, 256), minval=3.0,
+                           maxval=9.0)
+    y = quantize(x, num_bits=8, groups=2, symmetric=False)
+    rng = np.asarray(x).reshape(2, -1)
+    scale = (rng.max(axis=1) - rng.min(axis=1)) / 255.0
+    err = np.abs(np.asarray(y - x)).reshape(2, -1).max(axis=1)
+    assert (err <= scale * 0.5 + 1e-7).all()
+
+
+def test_quantize_levels():
+    """4-bit symmetric → at most 16 distinct levels per group."""
+    x = jax.random.normal(jax.random.PRNGKey(2), (1, 1024))
+    y = np.asarray(quantize(x, num_bits=4, groups=1))
+    assert len(np.unique(np.round(y / (np.abs(y)[y != 0].min() + 1e-12), 3))) <= 64
+    assert len(np.unique(y)) <= 16
+
+
+def test_stochastic_rounding_unbiased():
+    x = jnp.full((1, 1024), 0.3)
+    ys = [np.asarray(quantize(x * 10, num_bits=4, groups=1,
+                              stochastic=True, seed=s)).mean()
+          for s in range(50)]
+    # mean of stochastic rounding approaches the true value
+    assert abs(np.mean(ys) - 3.0) < 0.15
+
+
+def test_zero_input_stable():
+    x = jnp.zeros((2, 256))
+    y = quantize(x, num_bits=8, groups=2)
+    assert np.allclose(np.asarray(y), 0.0)
