@@ -1,0 +1,156 @@
+"""Elastic training configuration math.
+
+Faithful port of deepspeed/elasticity/elasticity.py (candidate batch-size
+enumeration :63-175, ``compute_elastic_config`` :226). Pure arithmetic —
+ports verbatim to the TPU build, where "GPUs" become chips. Runtime
+elasticity (v0.1) is scheduling-time only in the reference too
+(SURVEY.md §5.3)."""
+
+import json
+
+ELASTICITY = "elasticity"
+ENABLED = "enabled"
+ENABLED_DEFAULT = False
+LATEST_ELASTICITY_VERSION = 0.1
+MINIMUM_DEEPSPEED_VERSION = "0.3.8"
+
+
+class ElasticityError(Exception):
+    pass
+
+
+class ElasticityConfigError(ElasticityError):
+    pass
+
+
+class ElasticityIncompatibleWorldSize(ElasticityError):
+    pass
+
+
+class ElasticityConfig:
+    """Reference elasticity/config.py semantics."""
+
+    def __init__(self, param_dict):
+        self.enabled = param_dict.get(ENABLED, ENABLED_DEFAULT)
+        if self.enabled:
+            if "max_train_batch_size" not in param_dict:
+                raise ElasticityConfigError(
+                    "max_train_batch_size is required when elasticity is "
+                    "enabled")
+            if "micro_batch_sizes" not in param_dict:
+                raise ElasticityConfigError(
+                    "micro_batch_sizes is required when elasticity is "
+                    "enabled")
+        self.max_acceptable_batch_size = param_dict.get(
+            "max_train_batch_size", 2000)
+        self.micro_batches = param_dict.get("micro_batch_sizes",
+                                            [2, 4, 6])
+        self.min_gpus = param_dict.get("min_gpus", 1)
+        self.max_gpus = param_dict.get("max_gpus", 10000)
+        self.min_time = param_dict.get("min_time", 0)
+        self.version = param_dict.get("version", LATEST_ELASTICITY_VERSION)
+        self.prefer_larger_batch_size = param_dict.get("prefer_larger_batch",
+                                                       True)
+        self.ignore_non_elastic_batch_info = param_dict.get(
+            "ignore_non_elastic_batch_info", False)
+
+
+def get_candidate_batch_sizes(base_list, max_acceptable_batch_size):
+    """All batch sizes <= max that are a base micro-batch times a highly
+    composite multiplier (reference :63)."""
+    candidate_batch_size = []
+    for base in base_list:
+        if base >= max_acceptable_batch_size:
+            candidate_batch_size.append(base)
+        else:
+            value = max_acceptable_batch_size // base
+            index = next((i for i, x in enumerate(HCN_LIST) if x > value),
+                         len(HCN_LIST)) - 1
+            candidate_batch_size.append(HCN_LIST[index] * base)
+    return list(set(candidate_batch_size))
+
+
+HCN_LIST = [1, 2, 4, 6, 12, 24, 36, 48, 60, 120, 180, 240, 360, 720, 840,
+            1260, 1680, 2520, 5040, 7560, 10080, 15120, 20160, 25200, 27720,
+            45360, 50400]
+
+
+def get_valid_gpus(batch_size, micro_batches, min_valid_gpus,
+                   max_valid_gpus):
+    """GPU counts that evenly divide batch/micro (reference :91)."""
+    valid_gpus = []
+    for micro_batch in micro_batches:
+        if batch_size % micro_batch == 0:
+            max_gpus = batch_size // micro_batch
+            if min_valid_gpus <= max_gpus <= max_valid_gpus:
+                valid_gpus.append(max_gpus)
+            for i in range(1, max_gpus // 2 + 1):
+                if max_gpus % i == 0 and min_valid_gpus <= i <= max_valid_gpus:
+                    valid_gpus.append(i)
+    return sorted(set(valid_gpus))
+
+
+def get_best_candidates(candidate_batch_sizes, micro_batches, min_gpus,
+                        max_gpus, prefer_larger):
+    """(final_batch_size, valid_gpus) maximising GPU coverage
+    (reference :114)."""
+    max_valid_gpus = 0
+    valid_gpus = None
+    final_batch_size = int(min(micro_batches))
+
+    for batch_size in candidate_batch_sizes:
+        current_valid_gpus = get_valid_gpus(batch_size, micro_batches,
+                                            min_gpus, max_gpus)
+        if (len(current_valid_gpus) > max_valid_gpus or
+                (len(current_valid_gpus) == max_valid_gpus and
+                 ((prefer_larger and batch_size > final_batch_size) or
+                  (not prefer_larger and batch_size < final_batch_size)))):
+            max_valid_gpus = len(current_valid_gpus)
+            valid_gpus = current_valid_gpus
+            final_batch_size = batch_size
+    return final_batch_size, valid_gpus
+
+
+def _get_compatible_gpus_v01(micro_batches, max_acceptable_batch_size,
+                             min_gpus=None, max_gpus=None,
+                             prefer_larger=True):
+    min_gpus = min_gpus or 1
+    max_gpus = max_gpus or max_acceptable_batch_size // min(micro_batches)
+    if not all(mb <= max_acceptable_batch_size for mb in micro_batches):
+        raise ValueError(
+            f"All micro batches must be <= {max_acceptable_batch_size}")
+    candidate_batch_sizes = get_candidate_batch_sizes(
+        micro_batches, max_acceptable_batch_size)
+    return get_best_candidates(candidate_batch_sizes, micro_batches,
+                               min_gpus, max_gpus, prefer_larger)
+
+
+def compute_elastic_config(ds_config, target_deepspeed_version=None,
+                           world_size=0):
+    """(final_batch_size, valid_gpus, micro_batch_size-for-world) —
+    reference :226."""
+    if isinstance(ds_config, str):
+        ds_config = json.loads(ds_config)
+    elastic_config_dict = ds_config.get(ELASTICITY, {})
+    elastic_config = ElasticityConfig(elastic_config_dict)
+
+    final_batch_size, valid_gpus = _get_compatible_gpus_v01(
+        micro_batches=elastic_config.micro_batches,
+        max_acceptable_batch_size=elastic_config.max_acceptable_batch_size,
+        min_gpus=elastic_config.min_gpus,
+        max_gpus=elastic_config.max_gpus,
+        prefer_larger=elastic_config.prefer_larger_batch_size)
+
+    if world_size > 0:
+        if world_size not in valid_gpus:
+            raise ElasticityIncompatibleWorldSize(
+                f"world size {world_size} not in valid set {valid_gpus}")
+        micro_batch_size = None
+        for mbsz in sorted(elastic_config.micro_batches, reverse=True):
+            if final_batch_size // world_size % mbsz == 0:
+                micro_batch_size = mbsz
+                break
+        assert micro_batch_size is not None
+        return final_batch_size, valid_gpus, micro_batch_size
+
+    return final_batch_size, valid_gpus

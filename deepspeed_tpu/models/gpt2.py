@@ -46,6 +46,8 @@ class GPT2Config:
     dropout: float = 0.0
     remat: bool = False
     use_flash: Optional[bool] = None   # None = auto (Pallas on TPU)
+    pp_stages: int = 1                 # pipeline stages for the block stack
+    pp_microbatches: int = 1           # GPipe microbatches when pp_stages>1
     dtype: jnp.dtype = jnp.float32     # activation compute dtype is set by
                                        # the engine via param cast; this is
                                        # only for explicitly built models
@@ -127,6 +129,17 @@ class Block(nn.Module):
         return x
 
 
+class _PipeBlock(nn.Module):
+    """Block adapted to the GPipe stage-body signature (single tensor
+    arg); the deterministic flag is baked in at construction."""
+    config: GPT2Config
+    deterministic: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        return Block(self.config, name="block")(x, self.deterministic)
+
+
 class GPT2LMHeadModel(nn.Module):
     """GPT-2 causal LM; returns mean next-token cross-entropy."""
     config: GPT2Config
@@ -151,11 +164,26 @@ class GPT2LMHeadModel(nn.Module):
         if cfg.dropout > 0:
             x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
 
-        block = Block
-        if cfg.remat:
-            block = nn.remat(Block, static_argnums=(2,))
-        for i in range(cfg.n_layer):
-            x = block(cfg, name=f"h_{i}")(x, deterministic)
+        if cfg.pp_stages > 1:
+            # pipelined middle: blocks stream over the mesh pipe axis
+            # (embedding/head stay outside, like the reference's first/last
+            # stage LayerSpecs — runtime/pipe/module.py)
+            from deepspeed_tpu.runtime.pipe.spmd import GPipe
+            assert cfg.n_layer % cfg.pp_stages == 0
+            x = GPipe(block_cls=_PipeBlock,
+                      block_kwargs={"config": cfg,
+                                    "deterministic": deterministic},
+                      num_stages=cfg.pp_stages,
+                      layers_per_stage=cfg.n_layer // cfg.pp_stages,
+                      num_microbatches=cfg.pp_microbatches,
+                      remat=cfg.remat,
+                      name="pipe")(x)
+        else:
+            block = Block
+            if cfg.remat:
+                block = nn.remat(Block, static_argnums=(2,))
+            for i in range(cfg.n_layer):
+                x = block(cfg, name=f"h_{i}")(x, deterministic)
         x = nn.LayerNorm(epsilon=1e-5, name="ln_f")(x)
 
         # tied LM head; fp32 logits for a stable softmax
@@ -190,6 +218,22 @@ def gpt2_tp_rules():
         (r"mlp/fc/kernel", P(None, "model")),
         (r"mlp/fc/bias", P("model",)),
         (r"mlp/proj/kernel", P("model", None)),
+    ]
+
+
+def gpt2_pp_rules():
+    """Sharding rules for the PIPELINED model (pp_stages > 1): stacked
+    stage params carry a leading [n_stages] dim, so TP specs shift right
+    one position behind the pipe axis. Order matters — these must precede
+    the plain TP rules (ModelParallelRules takes the first match)."""
+    return [
+        (r"pipe_loop.*attn/qkv/kernel", P("pipe", None, "model")),
+        (r"pipe_loop.*attn/qkv/bias", P("pipe", "model")),
+        (r"pipe_loop.*attn/proj/kernel", P("pipe", "model", None)),
+        (r"pipe_loop.*mlp/fc/kernel", P("pipe", None, "model")),
+        (r"pipe_loop.*mlp/fc/bias", P("pipe", "model")),
+        (r"pipe_loop.*mlp/proj/kernel", P("pipe", "model", None)),
+        (r"pipe_loop.*", P("pipe")),   # LN params etc: pipe-stacked only
     ]
 
 

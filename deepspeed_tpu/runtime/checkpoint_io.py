@@ -1,0 +1,108 @@
+"""Shard-aware checkpoint serialization.
+
+The reference persists per-rank partition files
+(``zero_pp_rank_X_mp_rank_XX_optim_states.pt`` — engine.py:2345) because
+each rank owns a slice of the flat fp32 partition. The jax analogue: every
+process saves only its ADDRESSABLE shards of each ``jax.Array`` (with the
+global index of each shard), and load reassembles from whichever files
+cover the global shape, then ``device_put``s onto the target shardings.
+Single-process saves degenerate to one file holding full arrays;
+dp-resharded loads (elastic resume, reference stage_1_and_2.py:2023) work
+because reassembly is index-based, not rank-based.
+"""
+
+import pickle
+from typing import Any, Callable, Dict, List, Tuple
+
+import jax
+import numpy as np
+
+
+def _index_to_key(index, shape) -> Tuple:
+    """Normalise a shard index (tuple of slices) to a hashable key."""
+    key = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        key.append((start, stop))
+    return tuple(key)
+
+
+def tree_local_shards(tree) -> Dict[str, dict]:
+    """{leaf_path: {"shape", "dtype", "shards": [(key, ndarray)]}} for the
+    shards addressable by THIS process (deduplicated by index)."""
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    out = {}
+    for path, leaf in flat:
+        pstr = jax.tree_util.keystr(path)
+        if not isinstance(leaf, jax.Array):
+            out[pstr] = {"shape": getattr(leaf, "shape", ()),
+                         "dtype": str(getattr(leaf, "dtype", "float32")),
+                         "shards": [((), np.asarray(leaf))]}
+            continue
+        shards = []
+        seen = set()
+        for shard in leaf.addressable_shards:
+            key = _index_to_key(shard.index, leaf.shape)
+            if key in seen:      # replicated copies: save once
+                continue
+            seen.add(key)
+            shards.append((key, np.asarray(shard.data)))
+        out[pstr] = {"shape": tuple(leaf.shape), "dtype": str(leaf.dtype),
+                     "shards": shards}
+    return out
+
+
+def save_tree(tree, path: str):
+    with open(path, "wb") as f:
+        pickle.dump(tree_local_shards(tree), f)
+
+
+def assemble(files_payloads: List[Dict[str, dict]]) -> Dict[str, np.ndarray]:
+    """Merge shard payloads (from one or more files) into full ndarrays."""
+    merged: Dict[str, np.ndarray] = {}
+    filled: Dict[str, np.ndarray] = {}
+    for payload in files_payloads:
+        for pstr, rec in payload.items():
+            shape = tuple(rec["shape"])
+            if pstr not in merged:
+                merged[pstr] = np.zeros(shape, dtype=rec["dtype"])
+                filled[pstr] = np.zeros(shape, dtype=bool) if shape else \
+                    np.zeros((), dtype=bool)
+            for key, data in rec["shards"]:
+                if key == ():
+                    merged[pstr] = np.asarray(data)
+                    filled[pstr] = np.ones_like(filled[pstr])
+                    continue
+                slices = tuple(slice(a, b) for a, b in key)
+                merged[pstr][slices] = data
+                filled[pstr][slices] = True
+    for pstr, mask in filled.items():
+        if not mask.all():
+            raise ValueError(
+                f"checkpoint incomplete: leaf {pstr} missing shards "
+                f"({mask.sum()}/{mask.size} elements covered)")
+    return merged
+
+
+def restore_tree(template, files_payloads: List[Dict[str, dict]],
+                 shardings=None):
+    """Rebuild a pytree shaped like *template* from shard payloads; put
+    leaves onto *shardings* (same-structure pytree) when given."""
+    merged = assemble(files_payloads)
+    flat_t = jax.tree_util.tree_flatten_with_path(template)
+    leaves = []
+    for path, leaf in flat_t[0]:
+        pstr = jax.tree_util.keystr(path)
+        if pstr not in merged:
+            raise KeyError(f"checkpoint missing leaf {pstr}")
+        leaves.append(merged[pstr])
+    tree = jax.tree_util.tree_unflatten(flat_t[1], leaves)
+    if shardings is not None:
+        tree = jax.device_put(tree, shardings)
+    return tree
+
+
+def load_payload(path: str) -> Dict[str, dict]:
+    with open(path, "rb") as f:
+        return pickle.load(f)

@@ -1,0 +1,63 @@
+"""Power-iteration curvature (eigenvalue) estimation.
+
+Rebuild of deepspeed/runtime/eigenvalue.py:7, which drives the MoQ
+quantization schedule (engine.step hook, engine.py:1891). The reference
+power-iterates on each layer-block's gradients via autograd retain_graph;
+here the same estimate is a Hessian-vector-product power iteration using
+``jax.jvp`` over ``jax.grad`` — functionally identical, and jit-compiled.
+"""
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+class Eigenvalue:
+    def __init__(self, verbose=False, max_iter=100, tol=1e-2,
+                 stability=1e-6, gas_boundary_resolution=1,
+                 layer_name="", layer_num=0):
+        self.verbose = verbose
+        self.max_iter = max_iter
+        self.tol = tol
+        self.stability = stability
+        self.gas_boundary_resolution = gas_boundary_resolution
+        self.layer_name = layer_name
+        self.layer_num = layer_num
+
+    def normalize(self, v):
+        norm = jnp.sqrt(sum(jnp.vdot(x, x) for x in jax.tree.leaves(v)))
+        norm = jnp.maximum(norm, self.stability)
+        return jax.tree.map(lambda x: x / norm, v)
+
+    def compute_eigenvalue(self, loss_fn: Callable, params, rng=None):
+        """Largest |eigenvalue| of the loss Hessian at params.
+
+        loss_fn(params) -> scalar. Returns a python float (the reference
+        returns per-block ratios consumed by the MoQ scheduler)."""
+        grad_fn = jax.grad(loss_fn)
+
+        def hvp(v):
+            return jax.jvp(grad_fn, (params,), (v,))[1]
+
+        key = rng if rng is not None else jax.random.PRNGKey(0)
+        leaves, treedef = jax.tree.flatten(params)
+        keys = jax.random.split(key, len(leaves))
+        v = treedef.unflatten([
+            jax.random.normal(k, x.shape, jnp.float32)
+            for k, x in zip(keys, leaves)])
+        v = self.normalize(v)
+
+        eig = 0.0
+        for _ in range(self.max_iter):
+            Hv = hvp(v)
+            new_eig = float(sum(jnp.vdot(a, b).real for a, b in zip(
+                jax.tree.leaves(v), jax.tree.leaves(Hv))))
+            v = self.normalize(Hv)
+            if abs(new_eig) < self.stability:
+                return 0.0
+            if eig != 0.0 and abs(new_eig - eig) / abs(new_eig) < self.tol:
+                eig = new_eig
+                break
+            eig = new_eig
+        return eig

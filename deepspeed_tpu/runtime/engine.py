@@ -162,6 +162,35 @@ class DeepSpeedEngine:
         # ---- lr schedule (reference _configure_lr_scheduler, :790) --------
         self.lr_scheduler, self._lr_fn, self._base_lr = self._configure_lr_scheduler()
 
+        # ---- aux trainers: PLD, curriculum, MoQ (reference engine.py
+        # :1571-1583 forward kwarg injection; :1816-1827 MoQ step hook) ----
+        self.progressive_layer_drop = None
+        if self.config.pld_enabled:
+            from deepspeed_tpu.runtime.progressive_layer_drop import \
+                ProgressiveLayerDrop
+            self.progressive_layer_drop = ProgressiveLayerDrop(
+                theta=self.config.pld_config.theta,
+                gamma=self.config.pld_config.gamma)
+        self.curriculum_scheduler = None
+        if self.config.curriculum_enabled:
+            from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler \
+                import CurriculumScheduler
+            self.curriculum_scheduler = CurriculumScheduler(
+                self.config.curriculum_config.params)
+        self.quantizer = None
+        if getattr(self.config, "quantize_training_enabled", False):
+            from deepspeed_tpu.runtime.quantize import Quantizer
+            qc = self.config.quantize_training_config
+            self.quantizer = Quantizer(
+                q_groups=qc.quantize_groups,
+                q_mixed_fp16=qc.fp16_mixed_quantize,
+                q_change_ratio=qc.quantize_change_ratio,
+                q_type=0 if qc.quantize_type == "symmetric" else 1,
+                q_rounding=1 if getattr(qc, "rounding", "nearest") ==
+                "stochastic" else 0,
+                q_start_bits=qc.start_bits, q_target_bits=qc.target_bits,
+                q_period=qc.quantize_period)
+
         # ---- parameters / state init --------------------------------------
         self._init_state(model_parameters, sample_batch)
 
@@ -356,12 +385,19 @@ class DeepSpeedEngine:
         return jax.tree.map(
             lambda _: NamedSharding(self.mesh, spec), batch)
 
-    def _compute_loss(self, params, batch, rng):
+    def _compute_loss(self, params, batch, rng, pld_theta=None):
         """Forward in compute dtype; returns scalar fp32 loss."""
         cparams = _cast_tree(params, self.compute_dtype)
         model_kwargs = {}
         if rng is not None:
-            model_kwargs["rngs"] = {"dropout": rng}
+            # "gating" feeds MoE RTS/noisy gating (moe/sharded_moe.py
+            # TopKGate); unused rng names are ignored by flax
+            model_kwargs["rngs"] = {"dropout": rng,
+                                    "gating": jax.random.fold_in(rng, 7)}
+        if self.progressive_layer_drop is not None and pld_theta is not None:
+            # reference engine.forward kwarg injection (engine.py:1571)
+            model_kwargs["progressive_layer_drop"] = True
+            model_kwargs["pld_theta"] = pld_theta
         if hasattr(self.module, "apply"):
             out = self.module.apply(
                 {"params": cparams} if not (isinstance(cparams, dict)
@@ -376,9 +412,9 @@ class DeepSpeedEngine:
         gas = self.gradient_accumulation_steps()
         cfg = self.config
 
-        def micro_step(state, batch, rng):
+        def micro_step(state, batch, rng, pld_theta):
             def scaled_loss(p):
-                loss = self._compute_loss(p, batch, rng)
+                loss = self._compute_loss(p, batch, rng, pld_theta)
                 return loss * state.scale.loss_scale / gas
 
             sloss, grads = jax.value_and_grad(scaled_loss)(state.params)
@@ -428,7 +464,7 @@ class DeepSpeedEngine:
         sh = self.state_shardings
         self._jit_micro = jax.jit(
             micro_step, donate_argnums=0,
-            in_shardings=(sh, None, None),
+            in_shardings=(sh, None, None, None),
             out_shardings=(sh, NamedSharding(self.mesh, P())))
         self._jit_apply = jax.jit(
             apply_step, donate_argnums=0,
@@ -448,15 +484,37 @@ class DeepSpeedEngine:
         key = jax.random.PRNGKey(self._seed)
         return jax.random.fold_in(key, self.micro_steps)
 
+    def _apply_curriculum(self, batch):
+        """Truncate sequence dims to the scheduled difficulty (reference
+        engine.py:1577-1583 injects curriculum_seqlen; here the engine
+        slices the batch — each plateau compiles once)."""
+        seqlen = self.curriculum_scheduler.update_difficulty(
+            self.global_steps + 1)
+
+        def trunc(x):
+            if hasattr(x, "ndim") and x.ndim >= 2 and x.shape[1] > seqlen:
+                return x[:, :seqlen]
+            return x
+        return jax.tree.map(trunc, batch)
+
     def forward(self, batch):
         """Compute loss for one micro-batch (and, fused, its gradients).
 
         Returns the unscaled loss as a jax scalar. The reference's separate
         autograd backward is folded in (see module docstring)."""
+        if self.curriculum_scheduler is not None:
+            batch = self._apply_curriculum(batch)
+        if self.progressive_layer_drop is not None:
+            self.progressive_layer_drop.update_state(self.global_steps)
+        theta = jnp.float32(
+            self.progressive_layer_drop.get_theta()
+            if self.progressive_layer_drop is not None else 1.0)
         with self.mesh:
             batch = jax.device_put(batch, self._batch_sharding(batch))
-            self.state, loss = self._jit_micro(self.state, batch, self._next_rng())
+            self.state, loss = self._jit_micro(
+                self.state, batch, self._next_rng(), theta)
         self._pending_loss = loss
+        self._last_batch = batch
         return loss
 
     def backward(self, loss=None, allreduce_gradients=True, release_loss=False):
@@ -478,7 +536,17 @@ class DeepSpeedEngine:
         self._last_grad_norm = grad_norm
         self.global_steps += 1
         self.global_samples += self.train_batch_size()
-        if bool(jax.device_get(overflow)):
+        overflowed = bool(jax.device_get(overflow))
+        if self.quantizer is not None:
+            # MoQ: progressive fake-quantization of the trained params
+            # (reference _take_model_step hook, engine.py:1816-1827 —
+            # skips on overflow so the bit schedule tracks applied steps)
+            quantized = self.quantizer.quantize(self.state.params,
+                                                overflow=overflowed)
+            if quantized is not self.state.params:
+                self.state = self.state._replace(
+                    params=jax.device_put(quantized, self.param_shardings))
+        if overflowed:
             # reference engine.py:1844-1854: scheduler does NOT advance on a
             # skipped step, keeping it in lock-step with the applied-lr index
             # (state.step, which also only advances on success).
@@ -550,19 +618,26 @@ class DeepSpeedEngine:
 
     def save_checkpoint(self, save_dir, tag=None, client_state=None,
                         save_latest=True):
+        """Shard-aware save: every process writes its addressable shards of
+        params + optimizer state to its zero_pp_rank file (reference
+        per-rank partition files, engine.py:2345); process 0 additionally
+        writes metadata (and full params when it can address them) to the
+        model-states file and the 'latest' tag (engine.py:2889)."""
+        from deepspeed_tpu.runtime import checkpoint_io
         import deepspeed_tpu.comm as dist
         if tag is None:
             tag = f"global_step{self.global_steps}"
         os.makedirs(os.path.join(save_dir, str(tag)), exist_ok=True)
 
-        host_state = jax.device_get(self.state)
-        # model-states + 'latest' are dp-shared files: only process 0 writes
-        # them (reference guards on dp_rank==0, engine.py:812-826); each
-        # process writes its own zero_pp_rank file below.
+        self._save_zero_checkpoint(save_dir, tag)
         if dist.get_rank() != 0:
-            self._save_zero_checkpoint(save_dir, tag, host_state)
             return True
-        model_np = jax.tree.map(np.asarray, host_state.params)
+
+        fully_addressable = all(
+            getattr(x, "is_fully_addressable", True)
+            for x in jax.tree.leaves(self.state.params))
+        model_np = (jax.tree.map(np.asarray, jax.device_get(self.state.params))
+                    if fully_addressable else None)
         sd = {
             "module": model_np,
             "global_steps": self.global_steps,
@@ -571,7 +646,8 @@ class DeepSpeedEngine:
             "micro_steps": self.micro_steps,
             "dp_world_size": self.dp_world_size,
             "mp_world_size": self.mp_world_size,
-            "loss_scale": float(np.asarray(host_state.scale.loss_scale)),
+            "loss_scale": float(np.asarray(
+                jax.device_get(self.state.scale.loss_scale))),
             "lr_scheduler": (self.lr_scheduler.state_dict()
                              if self.lr_scheduler else None),
             "ds_config": self.config._param_dict,
@@ -581,19 +657,22 @@ class DeepSpeedEngine:
         with open(self._get_ckpt_name(save_dir, tag), "wb") as f:
             pickle.dump(sd, f)
 
-        self._save_zero_checkpoint(save_dir, tag, host_state)
-
         if save_latest:
             with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
                 f.write(str(tag))
         log_dist(f"saved checkpoint {save_dir}/{tag}", ranks=[0])
         return True
 
-    def _save_zero_checkpoint(self, save_dir, tag, host_state):
+    def _save_zero_checkpoint(self, save_dir, tag):
+        from deepspeed_tpu.runtime import checkpoint_io
         zero_sd = {
-            "optimizer_state_dict": jax.tree.map(np.asarray, host_state.opt_state),
-            "scale_state": {k: np.asarray(v) for k, v in
-                            host_state.scale._asdict().items()},
+            "format": "shards-v1",
+            "optimizer_state_dict": checkpoint_io.tree_local_shards(
+                self.state.opt_state),
+            "param_shards": checkpoint_io.tree_local_shards(
+                self.state.params),
+            "scale_state": {k: np.asarray(jax.device_get(v)) for k, v in
+                            self.state.scale._asdict().items()},
             "zero_stage": self.zero_stage,
             "partition_count": self.dp_world_size,
         }
@@ -611,11 +690,24 @@ class DeepSpeedEngine:
             with open(latest) as f:
                 tag = f.read().strip()
 
+        from deepspeed_tpu.runtime import checkpoint_io
+        import glob as _glob
         path = self._get_ckpt_name(load_dir, tag)
         with open(path, "rb") as f:
             sd = pickle.load(f)
 
-        params = jax.device_put(sd["module"], self.param_shardings)
+        zero_paths = sorted(_glob.glob(os.path.join(
+            load_dir, str(tag), "zero_pp_rank_*" + OPTIM_FILE_SUFFIX)))
+        zero_payloads = [pickle.load(open(p, "rb")) for p in zero_paths]
+
+        if sd.get("module") is not None:
+            params = jax.device_put(sd["module"], self.param_shardings)
+        else:
+            # reassemble sharded params from the per-process files
+            params = checkpoint_io.restore_tree(
+                self.state.params,
+                [z["param_shards"] for z in zero_payloads],
+                self.param_shardings)
         new_state = self.state._replace(params=params)
 
         client_state = sd.get("client_state", {})
@@ -636,21 +728,26 @@ class DeepSpeedEngine:
                 self.lr_scheduler.load_state_dict(sd["lr_scheduler"])
 
             if load_optimizer_states:
-                zpath = self._get_zero_ckpt_name(load_dir, tag)
-                if not os.path.isfile(zpath):
+                if not zero_payloads:
                     logger.warning(
-                        f"optimizer-state file {zpath} missing; resuming "
-                        f"with FRESH optimizer state and loss scale")
-                if os.path.isfile(zpath):
-                    with open(zpath, "rb") as f:
-                        zsd = pickle.load(f)
-                    opt_state = jax.tree.map(
-                        jnp.asarray, zsd["optimizer_state_dict"])
-                    opt_state = jax.device_put(opt_state, self.opt_shardings)
+                        f"no zero_pp_rank files under {load_dir}/{tag}; "
+                        f"resuming with FRESH optimizer state and loss scale")
+                elif zero_payloads[0].get("format") != "shards-v1":
+                    # pre-shard-format checkpoint: raw pytree per file
+                    opt_state = jax.device_put(
+                        jax.tree.map(jnp.asarray,
+                                     zero_payloads[0]["optimizer_state_dict"]),
+                        self.opt_shardings)
+                    new_state = new_state._replace(opt_state=opt_state)
+                else:
+                    opt_state = checkpoint_io.restore_tree(
+                        self.state.opt_state,
+                        [z["optimizer_state_dict"] for z in zero_payloads],
+                        self.opt_shardings)
                     new_state = new_state._replace(opt_state=opt_state)
                     # full dynamic-scaler state so a resumed run is
                     # bit-identical to an uninterrupted one
-                    ss = zsd.get("scale_state")
+                    ss = zero_payloads[0].get("scale_state")
                     if ss is not None:
                         new_state = new_state._replace(
                             scale=LossScaleState(
@@ -661,3 +758,33 @@ class DeepSpeedEngine:
         self.state = new_state
         log_dist(f"loaded checkpoint {load_dir}/{tag}", ranks=[0])
         return path, client_state
+
+    # ------------------------------------------------- consolidated exports
+    def _consolidated_16bit_state_dict(self):
+        """Gathered bit16 copy of the params (reference
+        _zero3_consolidated_16bit_state_dict, engine.py:3025)."""
+        dtype = (jnp.bfloat16 if self.compute_dtype == jnp.float32
+                 else self.compute_dtype)
+        fully_addressable = all(
+            getattr(x, "is_fully_addressable", True)
+            for x in jax.tree.leaves(self.state.params))
+        if fully_addressable:
+            gathered = jax.device_get(self.state.params)
+        else:
+            # multi-host ZeRO-3: all-gather across processes first
+            from jax.experimental import multihost_utils
+            gathered = multihost_utils.process_allgather(self.state.params)
+        return jax.tree.map(
+            lambda x: np.asarray(x).astype(dtype)
+            if np.issubdtype(np.asarray(x).dtype, np.floating) else
+            np.asarray(x), gathered)
+
+    def save_16bit_model(self, save_dir, save_filename="pytorch_model.bin"):
+        """Reference engine.save_16bit_model (engine.py:3098): one
+        consolidated bit16 weight file for HF-style interchange."""
+        import deepspeed_tpu.comm as dist
+        os.makedirs(save_dir, exist_ok=True)
+        if dist.get_rank() == 0:
+            with open(os.path.join(save_dir, save_filename), "wb") as f:
+                pickle.dump(self._consolidated_16bit_state_dict(), f)
+        return True

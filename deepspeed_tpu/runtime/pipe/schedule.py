@@ -1,0 +1,248 @@
+"""Pipeline instruction schedules.
+
+Faithful port of deepspeed/runtime/pipe/schedule.py (``PipeSchedule`` :8,
+``InferenceSchedule`` :117, ``TrainSchedule`` :182 — the even/odd 1F1B-ish
+interleave over ``2*(micro_batches + stages - 1)`` steps). The instruction
+stream is pure Python and keeps the reference semantics exactly; the SPMD
+executor (pipe/spmd.py) compiles the equivalent dataflow into one jitted
+scan, while this object drives the host-loop (multi-controller) executor
+and the schedule unit tests (reference test_pipe_schedule.py).
+"""
+
+
+class PipeInstruction:
+    def __init__(self, **kwargs):
+        self.name = self.__class__.__name__
+        self.kwargs = kwargs
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+    def __repr__(self):
+        args = ", ".join(f"{k}={v}" for k, v in self.kwargs.items())
+        return f"{self.name}({args})"
+
+    def __eq__(self, other):
+        return (self.__class__ == other.__class__ and
+                self.kwargs == other.kwargs)
+
+
+class OptimizerStep(PipeInstruction):
+    pass
+
+
+class ReduceGrads(PipeInstruction):
+    pass
+
+
+class ReduceTiedGrads(PipeInstruction):
+    pass
+
+
+class BufferOpInstruction(PipeInstruction):
+    def __init__(self, buffer_id, **kwargs):
+        super().__init__(buffer_id=buffer_id, **kwargs)
+
+
+class LoadMicroBatch(BufferOpInstruction):
+    pass
+
+
+class ForwardPass(BufferOpInstruction):
+    pass
+
+
+class BackwardPass(BufferOpInstruction):
+    pass
+
+
+class SendActivation(BufferOpInstruction):
+    pass
+
+
+class RecvActivation(BufferOpInstruction):
+    pass
+
+
+class SendGrad(BufferOpInstruction):
+    pass
+
+
+class RecvGrad(BufferOpInstruction):
+    pass
+
+
+class PipeSchedule:
+    """Base iterator yielding lists of PipeInstruction per step
+    (reference :8)."""
+
+    def __init__(self, micro_batches, stages, stage_id):
+        self.micro_batches = micro_batches
+        self.stages = stages
+        self.stage_id = stage_id
+        self.prev_stage = self.stage_id - 1
+        self.next_stage = self.stage_id + 1
+
+    def steps(self):
+        raise NotImplementedError
+
+    def num_pipe_buffers(self):
+        return self.micro_batches
+
+    def _valid_micro_batch(self, micro_batch_id):
+        return 0 <= micro_batch_id < self.micro_batches
+
+    def _valid_stage(self, stage_id):
+        return 0 <= stage_id < self.stages
+
+    @property
+    def stage(self):
+        return self.stage_id
+
+    @property
+    def num_stages(self):
+        return self.stages
+
+    @property
+    def num_micro_batches(self):
+        return self.micro_batches
+
+    @property
+    def is_first_stage(self):
+        return self.stage_id == 0
+
+    @property
+    def is_last_stage(self):
+        return self.stage_id == self.stages - 1
+
+    def __iter__(self):
+        self.it = iter(self.steps())
+        return self.it
+
+
+class InferenceSchedule(PipeSchedule):
+    """Forward-only fill-drain (reference :117)."""
+
+    def steps(self):
+        total_steps = self.micro_batches + self.stages - 1
+        for step_id in range(total_steps):
+            cmds = []
+            micro_batch_id = step_id - self.stage_id
+
+            if self._valid_micro_batch(micro_batch_id):
+                if self.is_first_stage or self.is_last_stage:
+                    cmds.append(LoadMicroBatch(micro_batch_id))
+                if self._valid_stage(self.prev_stage) and \
+                        self._valid_micro_batch(micro_batch_id):
+                    cmds.append(RecvActivation(micro_batch_id))
+                cmds.append(ForwardPass(micro_batch_id))
+                if self._valid_stage(self.next_stage):
+                    cmds.append(SendActivation(micro_batch_id))
+            yield cmds
+
+    def num_pipe_buffers(self):
+        return 2
+
+
+class TrainSchedule(PipeSchedule):
+    """The reference's interleaved fwd/bwd train schedule (:182):
+    ``2*(micro_batches + stages - 1)`` steps; even steps alternate
+    forward/backward by stage parity; buffers bounded by warm-up depth."""
+
+    def steps(self):
+        prev_micro_batch_id = -1
+        total_steps = 2 * (self.micro_batches + self.stages - 1)
+        for step_id in range(total_steps):
+            micro_batch_id, is_forward = self._step_to_micro_batch(step_id)
+
+            cmds = []
+            # Exchange activations/grads with neighbours
+            if self._valid_micro_batch(prev_micro_batch_id) and \
+                    self._valid_stage(self.next_stage):
+                if is_forward:
+                    cmds.append(RecvGrad(prev_micro_batch_id))
+                else:
+                    cmds.append(SendActivation(prev_micro_batch_id))
+            if self._valid_micro_batch(micro_batch_id) and \
+                    self._valid_stage(self.prev_stage):
+                if is_forward:
+                    cmds.append(RecvActivation(micro_batch_id))
+                else:
+                    cmds.append(SendGrad(micro_batch_id))
+
+            # Computation
+            if self._valid_micro_batch(micro_batch_id):
+                if is_forward:
+                    if self.is_first_stage or self.is_last_stage:
+                        cmds.append(LoadMicroBatch(micro_batch_id))
+                    cmds.append(ForwardPass(micro_batch_id))
+                else:
+                    cmds.append(BackwardPass(micro_batch_id))
+
+            # Model step at the end of the batch
+            if step_id == total_steps - 1:
+                cmds.append(ReduceTiedGrads())
+                cmds.append(ReduceGrads())
+                cmds.append(OptimizerStep())
+
+            prev_micro_batch_id = micro_batch_id
+            yield cmds
+
+    def _step_to_micro_batch(self, step_id):
+        if _is_even(step_id) and _is_even(self.stage_id):
+            micro_batch_id = self._even_step_forward_id(step_id)
+            is_forward = True
+        elif _is_odd(step_id) and _is_odd(self.stage_id):
+            micro_batch_id = self._odd_step_forward_id(step_id)
+            is_forward = True
+        elif _is_even(step_id) and _is_odd(self.stage_id):
+            micro_batch_id = self._even_step_backward_id(step_id)
+            is_forward = False
+        elif _is_odd(step_id) and _is_even(self.stage_id):
+            micro_batch_id = self._odd_step_backward_id(step_id)
+            is_forward = False
+        else:
+            raise AssertionError("unreachable")
+        return micro_batch_id, is_forward
+
+    def _even_step_forward_id(self, step_id):
+        base = step_id // 2
+        return base - self.stage_id // 2
+
+    def _odd_step_forward_id(self, step_id):
+        base = (step_id - 1) // 2
+        return base - self.stage_id // 2
+
+    def _even_step_backward_id(self, step_id):
+        base = step_id // 2
+        return base - self.stages + (self.stage_id + 1) // 2
+
+    def _odd_step_backward_id(self, step_id):
+        base = ((step_id - 1) // 2) - self.stages + 1
+        return base + self.stage_id // 2
+
+    def num_pipe_buffers(self):
+        buffers = min(self.stages - self.stage_id, self.micro_batches)
+        return max(2, buffers)
+
+
+class DataParallelSchedule(PipeSchedule):
+    """Pure DP schedule (reference tail of schedule.py)."""
+
+    def steps(self):
+        for step_id in range(self.micro_batches):
+            cmds = [LoadMicroBatch(buffer_id=0), ForwardPass(buffer_id=0),
+                    BackwardPass(buffer_id=0)]
+            if step_id == self.micro_batches - 1:
+                cmds.extend([ReduceGrads(), OptimizerStep()])
+            yield cmds
+
+    def num_pipe_buffers(self):
+        return 1
+
+
+def _is_even(x):
+    return x % 2 == 0
+
+
+def _is_odd(x):
+    return x % 2 != 0

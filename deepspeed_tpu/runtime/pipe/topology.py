@@ -1,0 +1,183 @@
+"""Cartesian process topology — rank grids with named axes.
+
+Faithful port of the pure math in deepspeed/runtime/pipe/topology.py
+(``ProcessTopology`` :12, ``PipeDataParallelTopology`` :235,
+``PipeModelDataParallelTopology`` :246, ``PipelineParallelGrid`` :252).
+This layer has no torch/NCCL content — it is coordinate bookkeeping the
+TPU build keeps verbatim: the axes map 1:1 onto jax.sharding.Mesh axes and
+the test suite (reference test_topology.py) ports unchanged.
+"""
+
+import itertools
+from collections import namedtuple
+
+
+class ProcessTopology:
+    """Maps n-dimensional Cartesian coordinates to linear ranks (row-major,
+    first axis slowest — reference topology.py:12)."""
+
+    def __init__(self, axes, dims):
+        self.axes = list(axes)
+        self.dims = list(dims)
+        self.ProcessCoord = namedtuple("ProcessCoord", self.axes)
+        self.mapping = {}
+        for coord in itertools.product(*[range(d) for d in self.dims]):
+            rank = 0
+            for idx, c in enumerate(coord):
+                rank = rank * self.dims[idx] + c
+            self.mapping[self.ProcessCoord(*coord)] = rank
+
+    def get_rank(self, **coord_kwargs):
+        key = self.ProcessCoord(**coord_kwargs)
+        assert key in self.mapping, f"coord {coord_kwargs} not in topology"
+        return self.mapping[key]
+
+    def get_axis_names(self):
+        return self.axes
+
+    def get_rank_repr(self, rank, omit_axes=("data", "pipe"), inner_sep="_",
+                      outer_sep="-"):
+        """String like 'model_00' used in checkpoint names
+        (reference :86)."""
+        omit_axes = list(omit_axes)
+        axes = [a for a in self.axes if a not in omit_axes]
+        names = []
+        for ax in axes:
+            ax_rank = getattr(self.get_coord(rank=rank), ax)
+            names.append(f"{ax}{inner_sep}{ax_rank:02d}")
+        return outer_sep.join(names)
+
+    def get_dim(self, axis):
+        if axis not in self.axes:
+            return 0
+        return self.dims[self.axes.index(axis)]
+
+    def get_coord(self, rank):
+        for coord, r in self.mapping.items():
+            if r == rank:
+                return coord
+        raise ValueError(f"rank {rank} not in topology")
+
+    def get_axis_comm_lists(self, axis):
+        """Lists of ranks that differ only along *axis* (the groups a
+        collective over that axis spans — reference :120)."""
+        if axis not in self.axes:
+            return []
+        other_axes = [a for a in self.axes if a != axis]
+        lists = []
+        for coord in itertools.product(
+                *[range(self.get_dim(a)) for a in other_axes]):
+            other = dict(zip(other_axes, coord))
+            ranks = [self.get_rank(**{axis: i}, **other)
+                     for i in range(self.get_dim(axis))]
+            lists.append(ranks)
+        return lists
+
+    def filter_match(self, **filter_kwargs):
+        """Ranks whose coords match all filters (reference :151)."""
+        def matches(coord):
+            return all(getattr(coord, k) == v for k, v in filter_kwargs.items())
+        return [rank for coord, rank in self.mapping.items() if matches(coord)]
+
+    def get_axis_list(self, axis, idx):
+        """Ranks with coord[axis] == idx, sorted (reference :171)."""
+        return sorted(rank for coord, rank in self.mapping.items()
+                      if getattr(coord, axis) == idx)
+
+    def world_size(self):
+        n = 1
+        for d in self.dims:
+            n *= d
+        return n
+
+    def __str__(self):
+        return str(self.mapping)
+
+
+class PipeDataParallelTopology(ProcessTopology):
+    """axes=(pipe, data) — hybrid pipeline+data (reference :235)."""
+
+    def __init__(self, num_pp, num_dp):
+        super().__init__(axes=["pipe", "data"], dims=[num_pp, num_dp])
+
+
+class PipeModelDataParallelTopology(ProcessTopology):
+    """axes=(pipe, data, model) — 3D parallelism (reference :246)."""
+
+    def __init__(self, num_pp, num_mp, num_dp):
+        super().__init__(axes=["pipe", "data", "model"],
+                         dims=[num_pp, num_dp, num_mp])
+
+
+class PipelineParallelGrid:
+    """Axis-rank accessors over a topology (reference :252). The torch
+    process-group creation is gone — a collective over axis A is an XLA
+    collective bound to mesh axis A — but the rank bookkeeping (stage_id,
+    p2p neighbours, checkpoint naming) is kept verbatim."""
+
+    def __init__(self, topology=None, process_group=None, global_rank=0,
+                 world_size=None):
+        if topology is None:
+            assert world_size is not None
+            topology = PipeDataParallelTopology(1, world_size)
+        self._topo = topology
+        self.global_rank = global_rank
+        self.world_size = topology.world_size()
+
+        self.data_parallel_size = max(topology.get_dim("data"), 1)
+        self.pipe_parallel_size = max(topology.get_dim("pipe"), 1)
+        self.model_parallel_size = max(topology.get_dim("model"), 1)
+        self.slice_parallel_size = self.model_parallel_size
+        assert self.world_size == (
+            self.data_parallel_size * self.pipe_parallel_size *
+            self.model_parallel_size)
+
+        coord = topology.get_coord(global_rank)
+        self.stage_id = getattr(coord, "pipe", 0)
+        self.data_parallel_id = getattr(coord, "data", 0)
+        self.model_parallel_id = getattr(coord, "model", 0)
+        self.slice_parallel_id = self.model_parallel_id
+
+    def get_stage_id(self):
+        return self.stage_id
+
+    def get_data_parallel_id(self):
+        return self.data_parallel_id
+
+    def get_pipe_parallel_rank(self):
+        return self.stage_id
+
+    def get_pipe_parallel_world_size(self):
+        return self.pipe_parallel_size
+
+    def get_data_parallel_rank(self):
+        return self.data_parallel_id
+
+    def get_data_parallel_world_size(self):
+        return self.data_parallel_size
+
+    def get_global_rank(self):
+        return self.global_rank
+
+    def get_model_parallel_rank(self):
+        return self.model_parallel_id
+
+    def get_model_parallel_world_size(self):
+        return self.model_parallel_size
+
+    def stage_to_global(self, stage_id, **kwargs):
+        me = self._topo.get_coord(self.global_rank)
+        transform = me._replace(pipe=stage_id, **kwargs)._asdict()
+        return self._topo.get_rank(**transform)
+
+    def is_first_stage(self):
+        return self.stage_id == 0
+
+    def is_last_stage(self):
+        return self.stage_id == self.pipe_parallel_size - 1
+
+    def p2p_prev(self):
+        return (self.stage_id - 1) % self.pipe_parallel_size
+
+    def p2p_next(self):
+        return (self.stage_id + 1) % self.pipe_parallel_size

@@ -1,0 +1,74 @@
+"""Quantize-aware training (MoQ).
+
+Rebuild of deepspeed/runtime/quantize.py (``Quantizer`` :12): progressive
+bit-reduction during training, optionally guided by the eigenvalue
+estimate; engine hooks it at the gradient boundary (_take_model_step,
+engine.py:1816-1827). The quantization kernel is
+ops/quantizer/quantizer.py; this class owns the SCHEDULE (period, start
+bits, target bits, mixed fp16/quantized groups) — pure host logic."""
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.quantizer.quantizer import quantize as quantize_kernel
+
+
+class Quantizer:
+    def __init__(self, q_groups=1, q_mixed_fp16=False, q_change_ratio=0.001,
+                 q_type=0, q_rounding=0, q_verbose=False, q_eigenvalue=False,
+                 use_quantizer_kernel=True, layer_num=0,
+                 q_start_bits=16, q_target_bits=8, q_period=1000):
+        self.q_groups = q_groups
+        self.q_mixed_fp16 = q_mixed_fp16
+        self.q_change_ratio = q_change_ratio
+        self.q_type = q_type            # 0 symmetric, 1 asymmetric
+        self.q_rounding = q_rounding    # 0 nearest, 1 stochastic
+        self.q_verbose = q_verbose
+        self.use_eigenvalue = q_eigenvalue
+        self.use_quantizer_kernel = use_quantizer_kernel
+        self.layer_num = layer_num
+        self.q_start_bits = q_start_bits
+        self.q_target_bits = q_target_bits
+        self.q_period = q_period
+        self.qsteps = 0
+        self.quantize_real_ratio = 1.0
+
+    def any_precision_switch(self):
+        if self.q_start_bits == self.q_target_bits:
+            return False
+        return (self.qsteps % self.q_period) == 0
+
+    def current_bits(self):
+        """Progressive schedule: one bit per period toward the target
+        (reference runtime/quantize.py decrements q_start_bits each
+        period)."""
+        reductions = self.qsteps // self.q_period
+        return max(self.q_target_bits, self.q_start_bits - reductions)
+
+    def quantize(self, parameter_group, overflow=False, eigenvalue_enabled=False,
+                 block_eigenvalue=None):
+        """Fake-quantize a pytree of params in place of the reference's
+        in-place tensor mutation; returns the new pytree."""
+        if overflow and not eigenvalue_enabled:
+            return parameter_group
+        self.qsteps += 1
+        bits = self.current_bits()
+        if bits >= 16:
+            return parameter_group
+
+        def q(x):
+            if x.ndim < 1 or x.size % self.q_groups:
+                return x
+            ratio = self.quantize_real_ratio
+            qx = quantize_kernel(
+                x, num_bits=bits, groups=self.q_groups,
+                symmetric=(self.q_type == 0),
+                stochastic=(self.q_rounding == 1))
+            if self.q_mixed_fp16 and ratio < 1.0:
+                return ratio * x + (1.0 - ratio) * qx
+            return qx
+
+        if self.q_mixed_fp16:
+            self.quantize_real_ratio = max(
+                0.0, self.quantize_real_ratio - self.q_change_ratio)
+        return jax.tree.map(q, parameter_group)

@@ -1,0 +1,71 @@
+"""Offline ZeRO-checkpoint → consolidated fp32 state-dict converter.
+
+Rebuild of deepspeed/utils/zero_to_fp32.py (entry points :126/:156/:258/
+:331/:380/:396): reconstruct a full fp32 param dict from the per-process
+``zero_pp_rank_*_optim_states.pt`` shard files, without an engine or
+devices. Usable as a library or CLI:
+
+    python -m deepspeed_tpu.utils.zero_to_fp32 <checkpoint_dir> <output>
+
+The shard files carry (index → ndarray) fragments of the fp32 master
+params (runtime/checkpoint_io.py), so reconstruction is index-based and
+dp-world-agnostic — the elastic-resume property of the reference's
+_restore_from_elastic_fp32_weights (stage_1_and_2.py:2023).
+"""
+
+import argparse
+import glob
+import os
+import pickle
+
+from deepspeed_tpu.runtime.checkpoint_io import assemble
+
+
+def get_latest_tag(checkpoint_dir):
+    latest = os.path.join(checkpoint_dir, "latest")
+    if os.path.isfile(latest):
+        with open(latest) as f:
+            return f.read().strip()
+    tags = sorted(d for d in os.listdir(checkpoint_dir)
+                  if os.path.isdir(os.path.join(checkpoint_dir, d)))
+    assert tags, f"no checkpoint tags under {checkpoint_dir}"
+    return tags[-1]
+
+
+def get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag=None):
+    """Reference :396 — returns {param_path: np.ndarray fp32}."""
+    if tag is None:
+        tag = get_latest_tag(checkpoint_dir)
+    ckpt_dir = os.path.join(checkpoint_dir, str(tag))
+    zero_files = sorted(glob.glob(
+        os.path.join(ckpt_dir, "zero_pp_rank_*_optim_states.pt")))
+    assert zero_files, f"no zero_pp_rank files in {ckpt_dir}"
+    payloads = []
+    for path in zero_files:
+        with open(path, "rb") as f:
+            payloads.append(pickle.load(f)["param_shards"])
+    return assemble(payloads)
+
+
+def convert_zero_checkpoint_to_fp32_state_dict(checkpoint_dir, output_file,
+                                               tag=None):
+    """Reference :380 — write the consolidated dict to *output_file*."""
+    state_dict = get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag)
+    with open(output_file, "wb") as f:
+        pickle.dump(state_dict, f)
+    print(f"saved {len(state_dict)} tensors to {output_file}")
+    return state_dict
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("checkpoint_dir")
+    parser.add_argument("output_file")
+    parser.add_argument("-t", "--tag", default=None)
+    args = parser.parse_args()
+    convert_zero_checkpoint_to_fp32_state_dict(
+        args.checkpoint_dir, args.output_file, tag=args.tag)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,151 @@
+"""Aux subsystem tests: curriculum (reference test_curriculum.py), PLD,
+eigenvalue, elasticity (test_elastic.py), activation checkpointing
+(test_activation_checkpointing.py), MoQ, flops profiler."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.elasticity.elasticity import (
+    ElasticityIncompatibleWorldSize, compute_elastic_config)
+from deepspeed_tpu.profiling.flops_profiler.profiler import (
+    analyze_fn, get_model_profile)
+from deepspeed_tpu.runtime.activation_checkpointing import checkpointing
+from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import (
+    CurriculumScheduler)
+from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+from deepspeed_tpu.runtime.progressive_layer_drop import ProgressiveLayerDrop
+from deepspeed_tpu.runtime.quantize import Quantizer
+
+
+def test_curriculum_fixed_linear():
+    sched = CurriculumScheduler({
+        "min_difficulty": 8, "max_difficulty": 64,
+        "schedule_type": "fixed_linear",
+        "schedule_config": {"total_curriculum_step": 100,
+                            "difficulty_step": 8}})
+    assert sched.update_difficulty(0) == 8
+    mid = sched.update_difficulty(50)
+    assert 8 < mid < 64 and mid % 8 == 0
+    assert sched.update_difficulty(100) == 64
+    assert sched.update_difficulty(1000) == 64
+
+
+def test_curriculum_fixed_root_monotone():
+    sched = CurriculumScheduler({
+        "min_difficulty": 8, "max_difficulty": 128,
+        "schedule_type": "fixed_root",
+        "schedule_config": {"total_curriculum_step": 1000,
+                            "difficulty_step": 8, "root_degree": 2}})
+    vals = [sched.get_difficulty(s) for s in range(0, 1001, 100)]
+    assert all(b >= a for a, b in zip(vals, vals[1:]))
+    assert vals[-1] == 128
+
+
+def test_curriculum_fixed_discrete():
+    sched = CurriculumScheduler({
+        "min_difficulty": 2, "max_difficulty": 10,
+        "schedule_type": "fixed_discrete",
+        "schedule_config": {"difficulty": [2, 4, 10],
+                            "max_step": [5, 10]}})
+    assert sched.get_difficulty(3) == 2
+    assert sched.get_difficulty(7) == 4
+    assert sched.get_difficulty(20) == 10
+
+
+def test_pld_theta_decays():
+    pld = ProgressiveLayerDrop(theta=0.5, gamma=0.01)
+    assert pld.get_theta() == 1.0
+    pld.update_state(100)
+    t100 = pld.get_theta()
+    pld.update_state(1000)
+    t1000 = pld.get_theta()
+    assert 0.5 <= t1000 < t100 < 1.0
+
+
+def test_eigenvalue_quadratic():
+    """For loss = 0.5 x^T diag(d) x the top eigenvalue is max(d)."""
+    d = jnp.array([1.0, 5.0, 3.0, 0.5])
+
+    def loss(x):
+        return 0.5 * jnp.sum(d * x * x)
+
+    eig = Eigenvalue(max_iter=200, tol=1e-4)
+    x0 = jnp.ones((4,))
+    val = eig.compute_eigenvalue(loss, x0)
+    assert abs(val - 5.0) < 0.05
+
+
+def test_elasticity_math():
+    ds_config = {"elasticity": {
+        "enabled": True, "max_train_batch_size": 10000,
+        "micro_batch_sizes": [8, 12, 16, 17], "min_gpus": 32,
+        "max_gpus": 1500}}
+    batch, gpus = compute_elastic_config(ds_config)
+    assert batch <= 10000 * 17  # sane
+    for g in gpus:
+        assert 32 <= g <= 1500
+        assert any(batch % (mb * g) == 0
+                   for mb in [8, 12, 16, 17])
+    # specific world size returns micro batch
+    b2, g2, micro = compute_elastic_config(ds_config, world_size=gpus[0])
+    assert micro in [8, 12, 16, 17]
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        compute_elastic_config(ds_config, world_size=1511)
+
+
+def test_activation_checkpointing_matches():
+    def fn(x):
+        for _ in range(3):
+            x = jnp.tanh(x @ jnp.eye(x.shape[-1]))
+        return x
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 8))
+    ref = fn(x)
+    out = checkpointing.checkpoint(fn, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref))
+
+    # grads equal too
+    g1 = jax.grad(lambda x: jnp.sum(fn(x) ** 2))(x)
+    g2 = jax.grad(lambda x: jnp.sum(checkpointing.checkpoint(fn, x) ** 2))(x)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), atol=1e-6)
+
+
+def test_moq_progressive_bits():
+    q = Quantizer(q_groups=1, q_start_bits=16, q_target_bits=8, q_period=2)
+    params = {"w": jax.random.normal(jax.random.PRNGKey(0), (4, 64))}
+    out = params
+    for step in range(17):
+        out = q.quantize(out)
+    assert q.current_bits() <= 8
+    # quantized values differ from originals but stay close
+    diff = np.abs(np.asarray(out["w"] - params["w"])).max()
+    assert 0 < diff < 0.5
+
+
+def test_flops_profiler_counts_matmul():
+    def fn(a, b):
+        return a @ b
+
+    a = jnp.ones((64, 128))
+    b = jnp.ones((128, 256))
+    costs = analyze_fn(fn, a, b)
+    flops = costs.get("flops", 0)
+    assert flops >= 2 * 64 * 128 * 256 * 0.9  # ~2MNK
+
+
+def test_get_model_profile_flax():
+    class M(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(32)(x)
+
+    m = M()
+    x = jnp.ones((4, 16))
+    params = m.init(jax.random.PRNGKey(0), x)
+    flops, macs, nparams = get_model_profile(
+        m, params=params, batch=x, as_string=False, print_profile=False)
+    assert nparams == 16 * 32 + 32
+    assert flops > 0

@@ -1,0 +1,95 @@
+"""Engine integration of PLD, curriculum learning and MoQ (reference
+engine.forward kwarg injection engine.py:1571-1583, MoQ step hook
+:1816-1827)."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu
+
+
+class PLDModel(nn.Module):
+    """Consumes the injected pld kwargs (reference PLD models take theta)."""
+    hidden: int = 32
+
+    @nn.compact
+    def __call__(self, batch, progressive_layer_drop=False, pld_theta=1.0):
+        x, y = batch
+        h = nn.Dense(self.hidden)(x)
+        # stochastic depth scaled by theta: here deterministically scale
+        # the residual branch (keeps the test deterministic)
+        h = h + pld_theta * nn.Dense(self.hidden)(nn.relu(h))
+        return jnp.mean((h - y) ** 2)
+
+
+def _batch(bs=8, hidden=32, seqlen=None, seed=0):
+    rng = np.random.default_rng(seed)
+    if seqlen is None:
+        return (rng.standard_normal((bs, hidden)).astype(np.float32),
+                rng.standard_normal((bs, hidden)).astype(np.float32))
+    return (rng.standard_normal((bs, seqlen, hidden)).astype(np.float32),
+            rng.standard_normal((bs, seqlen, hidden)).astype(np.float32))
+
+
+def test_pld_engine():
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=PLDModel(),
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                "progressive_layer_drop": {"enabled": True, "theta": 0.5,
+                                           "gamma": 0.01}},
+        sample_batch=_batch())
+    assert engine.progressive_layer_drop is not None
+    losses = [float(engine.train_batch(batch=_batch())) for _ in range(5)]
+    assert losses[-1] < losses[0]
+    assert engine.progressive_layer_drop.get_theta() < 1.0
+
+
+class SeqModel(nn.Module):
+    hidden: int = 32
+
+    @nn.compact
+    def __call__(self, batch):
+        x, y = batch
+        h = nn.Dense(self.hidden)(x)
+        return jnp.mean((h - y) ** 2)
+
+
+def test_curriculum_engine_truncates():
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SeqModel(),
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                "curriculum_learning": {
+                    "enabled": True, "min_difficulty": 4,
+                    "max_difficulty": 16,
+                    "schedule_type": "fixed_linear",
+                    "schedule_config": {"total_curriculum_step": 4,
+                                        "difficulty_step": 4}}},
+        sample_batch=_batch(seqlen=4))
+    assert engine.curriculum_scheduler is not None
+    for _ in range(6):
+        loss = engine.train_batch(batch=_batch(seqlen=16))
+        assert np.isfinite(float(loss))
+    # after total_curriculum_step the full seqlen is used
+    assert engine.curriculum_scheduler.get_current_difficulty() == 16
+
+
+def test_moq_engine():
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SeqModel(),
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                "quantize_training": {
+                    "enabled": True,
+                    "quantize_bits": {"start_bits": 12, "target_bits": 8},
+                    "quantize_schedule": {"quantize_period": 1},
+                    "quantize_groups": 1}},
+        sample_batch=_batch())
+    assert engine.quantizer is not None
+    for _ in range(3):
+        loss = engine.train_batch(batch=_batch())
+        assert np.isfinite(float(loss))
+    assert engine.quantizer.qsteps == 3
