@@ -59,6 +59,22 @@ def initialize(args=None,
     return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
 
 
+def init_inference(model, mp_size=1, mpu=None, checkpoint=None, dtype=None,
+                   injection_policy=None, replace_method="auto",
+                   quantization_setting=None,
+                   replace_with_kernel_inject=False, **kwargs):
+    """Create an inference engine (reference: deepspeed.init_inference,
+    deepspeed/__init__.py:220)."""
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    return InferenceEngine(model, mp_size=mp_size, mpu=mpu,
+                           checkpoint=checkpoint, dtype=dtype,
+                           injection_dict=injection_policy,
+                           replace_method=replace_method,
+                           quantization_setting=quantization_setting,
+                           replace_with_kernel_inject=replace_with_kernel_inject,
+                           **kwargs)
+
+
 def add_config_arguments(parser):
     """Reference: deepspeed.add_config_arguments (deepspeed/__init__.py:204)."""
     group = parser.add_argument_group("DeepSpeed", "DeepSpeed configurations")

@@ -1,0 +1,76 @@
+"""``ds_report`` — environment / op compatibility report.
+
+Rebuild of deepspeed/env_report.py (op compatibility table + version
+info). Reports jax/TPU state and native-op build status instead of
+torch/CUDA."""
+
+import importlib
+import shutil
+import subprocess
+
+GREEN = "\033[92m"
+RED = "\033[91m"
+YELLOW = "\033[93m"
+END = "\033[0m"
+OKAY = f"{GREEN}[OKAY]{END}"
+NO = f"{RED}[NO]{END}"
+
+
+def op_report():
+    from deepspeed_tpu.ops.op_builder.builder import ALL_OPS
+    max_dots = 23
+    print("-" * 64)
+    print("DeepSpeed-TPU native op report")
+    print("-" * 64)
+    print("op name" + "." * (max_dots - len("op name")) +
+          " compatible | built")
+    print("-" * 64)
+    for name, builder_cls in ALL_OPS.items():
+        b = builder_cls()
+        compatible = OKAY if b.is_compatible() else NO
+        built = OKAY if (b.lib_path().exists() and
+                         not b.needs_build()) else NO
+        print(name + "." * (max_dots - len(name)) +
+              f" {compatible}  | {built}")
+    # Pallas kernels are always "built" (JIT at trace time)
+    for kname in ("flash_attention", "fused_layer_norm", "fused_bias_gelu",
+                  "fused_softmax", "fused_adam", "fused_lamb", "quantizer"):
+        print(kname + "." * (max_dots - len(kname)) +
+              f" {OKAY}  | {OKAY} (pallas)")
+
+
+def debug_report():
+    import jax
+    print("-" * 64)
+    print("DeepSpeed-TPU general environment info:")
+    print("-" * 64)
+    rows = [
+        ("jax version", jax.__version__),
+        ("default backend", jax.default_backend()),
+        ("device count", jax.device_count()),
+        ("devices", ", ".join(str(d) for d in jax.devices()[:8])),
+        ("g++", shutil.which("g++") or "MISSING"),
+    ]
+    try:
+        import flax
+        rows.append(("flax version", flax.__version__))
+    except ImportError:
+        rows.append(("flax version", "MISSING"))
+    import deepspeed_tpu
+    rows.append(("deepspeed_tpu version",
+                 getattr(deepspeed_tpu, "__version__", "0.1")))
+    for name, value in rows:
+        print(f"{name} {'.' * (30 - len(name))} {value}")
+
+
+def main():
+    op_report()
+    debug_report()
+
+
+def cli_main():
+    main()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,191 @@
+"""The ``deepspeed`` CLI runner — multi-host job launcher.
+
+Rebuild of deepspeed/launcher/runner.py (hostfile parsing
+``fetch_hostfile`` :154, ``--include/--exclude`` filters
+``parse_resource_filter`` :195, main :314). The reference spawns per-GPU
+worker processes via pdsh/mpirun and passes a base64 world info; on TPU
+pods each HOST runs ONE process (jax handles its local chips), so the
+launcher resolves the host list the same way and then either:
+
+* single-host: exec the script directly (reference single-node path);
+* multi-host: print/execute per-host commands with
+  ``JAX_COORDINATOR_ADDRESS``/``JAX_PROCESS_COUNT``/``JAX_PROCESS_ID``
+  env (consumed by comm.init_distributed → jax.distributed.initialize),
+  over ssh when ``--launcher ssh`` (pdsh analogue).
+"""
+
+import argparse
+import base64
+import json
+import os
+import subprocess
+import sys
+from collections import OrderedDict
+
+from deepspeed_tpu.utils.logging import logger
+
+DLTS_HOSTFILE = "/job/hostfile"
+EXPORT_ENVS = ["NCCL", "PYTHON", "JAX", "XLA", "TPU", "PATH", "LD_LIBRARY"]
+DEEPSPEED_ENVIRONMENT_NAME = ".deepspeed_env"
+PDSH_MAX_FAN_OUT = 1024
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser(
+        description="deepspeed-tpu launcher",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("-H", "--hostfile", type=str, default=DLTS_HOSTFILE,
+                        help="Hostfile path: lines of '<host> slots=<n>'")
+    parser.add_argument("-i", "--include", type=str, default="",
+                        help='Inclusion filter, e.g. "worker-0@worker-1:0,2"')
+    parser.add_argument("-e", "--exclude", type=str, default="",
+                        help='Exclusion filter, e.g. "worker-1:0"')
+    parser.add_argument("--num_nodes", type=int, default=-1)
+    parser.add_argument("--num_gpus", "--num_chips", type=int, default=-1,
+                        dest="num_gpus")
+    parser.add_argument("--master_port", type=int, default=29500)
+    parser.add_argument("--master_addr", type=str, default="")
+    parser.add_argument("--launcher", type=str, default="local",
+                        choices=["local", "ssh", "print"],
+                        help="local: run here; ssh: pdsh-style remote "
+                             "launch; print: emit the per-host commands")
+    parser.add_argument("--force_multi", action="store_true")
+    parser.add_argument("user_script", type=str)
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args=args)
+
+
+def fetch_hostfile(hostfile_path):
+    """Parse '<hostname> slots=<n>' lines (reference :154)."""
+    if not os.path.isfile(hostfile_path):
+        logger.warning(f"Unable to find hostfile {hostfile_path}, "
+                       f"proceeding with a single local machine")
+        return None
+    resource_pool = OrderedDict()
+    with open(hostfile_path) as f:
+        for line in f:
+            line = line.strip()
+            if line == "" or line.startswith("#"):
+                continue
+            try:
+                hostname, slots = line.split()
+                _, slot_count = slots.split("=")
+                slot_count = int(slot_count)
+            except ValueError as err:
+                raise ValueError(
+                    f"Hostfile is not formatted correctly: {line}") from err
+            if hostname in resource_pool:
+                raise ValueError(f"Hostfile contains duplicate hosts: "
+                                 f"{hostname}")
+            resource_pool[hostname] = slot_count
+    return resource_pool
+
+
+def parse_resource_filter(host_info, include_str="", exclude_str=""):
+    """'@'-separated host[:slot,slot] filters (reference :195)."""
+
+    def parse_node_config(config):
+        if ":" in config:
+            hostname, slots = config.split(":")
+            return hostname, [int(s) for s in slots.split(",")]
+        return config, None
+
+    if include_str and exclude_str:
+        raise ValueError("include_str and exclude_str are mutually exclusive")
+
+    if include_str:
+        filtered = OrderedDict()
+        for config in include_str.split("@"):
+            hostname, slots = parse_node_config(config)
+            if hostname not in host_info:
+                raise ValueError(f"Hostname '{hostname}' not found in "
+                                 f"hostfile")
+            filtered[hostname] = (slots if slots is not None
+                                  else host_info[hostname])
+            if slots is not None:
+                for s in slots:
+                    if s >= host_info[hostname] if isinstance(
+                            host_info[hostname], int) else False:
+                        raise ValueError(f"No slot '{s}' on '{hostname}'")
+        return filtered
+
+    if exclude_str:
+        filtered = OrderedDict(
+            (h, list(range(c)) if isinstance(c, int) else c)
+            for h, c in host_info.items())
+        for config in exclude_str.split("@"):
+            hostname, slots = parse_node_config(config)
+            if hostname not in filtered:
+                raise ValueError(f"Hostname '{hostname}' not found in "
+                                 f"hostfile")
+            if slots is None:
+                del filtered[hostname]
+            else:
+                filtered[hostname] = [s for s in filtered[hostname]
+                                      if s not in slots]
+        return OrderedDict((h, len(v) if isinstance(v, list) else v)
+                           for h, v in filtered.items())
+
+    return host_info
+
+
+def encode_world_info(resource_pool):
+    """base64 world info env var (reference :260)."""
+    world_info = {h: (list(range(c)) if isinstance(c, int) else c)
+                  for h, c in resource_pool.items()}
+    return base64.urlsafe_b64encode(
+        json.dumps(world_info).encode()).decode()
+
+
+def main(args=None):
+    args = parse_args(args)
+    resource_pool = fetch_hostfile(args.hostfile)
+
+    if args.include or args.exclude:
+        assert resource_pool is not None, \
+            "--include/--exclude require a hostfile"
+        resource_pool = parse_resource_filter(resource_pool, args.include,
+                                              args.exclude)
+    if args.num_nodes > 0 and resource_pool is not None:
+        resource_pool = OrderedDict(
+            list(resource_pool.items())[:args.num_nodes])
+
+    multi_node = (resource_pool is not None and len(resource_pool) > 1) or \
+        args.force_multi
+
+    if not multi_node:
+        cmd = [sys.executable, args.user_script] + args.user_args
+        logger.info(f"cmd = {' '.join(cmd)}")
+        result = subprocess.Popen(cmd, env=os.environ.copy())
+        result.wait()
+        sys.exit(result.returncode)
+
+    hosts = list(resource_pool.keys())
+    master = args.master_addr or hosts[0]
+    env_base = {
+        "JAX_COORDINATOR_ADDRESS": f"{master}:{args.master_port}",
+        "JAX_PROCESS_COUNT": str(len(hosts)),
+        "DS_WORLD_INFO": encode_world_info(resource_pool),
+    }
+    procs = []
+    for idx, host in enumerate(hosts):
+        env = dict(env_base, JAX_PROCESS_ID=str(idx))
+        envs = " ".join(f"{k}={v}" for k, v in env.items())
+        remote = (f"{envs} {sys.executable} {args.user_script} "
+                  f"{' '.join(args.user_args)}")
+        if args.launcher == "print":
+            print(f"[{host}] {remote}")
+        elif args.launcher == "ssh":
+            procs.append(subprocess.Popen(["ssh", host, remote]))
+        else:
+            raise ValueError(
+                "multi-node with --launcher local; use ssh or print")
+    rc = 0
+    for p in procs:
+        p.wait()
+        rc = rc or p.returncode
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,158 @@
+"""BERT family — the BASELINE config #3 model ("BERT-large pretrain —
+csrc/transformer fused kernel + FusedLamb + sparse_attn").
+
+The reference trains BERT through DeepSpeedExamples' bing_bert scripts
+with the fused DeepSpeedTransformerLayer injected (tests vendor the HF
+implementation in tests/unit/modeling.py). Here the encoder layer IS the
+fused layer (ops/transformer/transformer.py), stacked with embeddings and
+an MLM head. Batch convention: dict with ``input_ids`` [B, S],
+``attention_mask`` optional, ``labels`` optional (-100 = ignore; default
+is masked-LM on input positions where labels given).
+"""
+
+import dataclasses
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.ops.transformer.transformer import (
+    DeepSpeedTransformerConfig, DeepSpeedTransformerLayer,
+    transformer_tp_rules)
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout_prob: float = 0.0
+    attention_probs_dropout_prob: float = 0.0
+    layer_norm_eps: float = 1e-12
+    pre_layer_norm: bool = False       # classic BERT is post-LN
+    remat: bool = False
+
+    @property
+    def padded_vocab(self):
+        return ((self.vocab_size + 127) // 128) * 128
+
+
+PRESETS = {
+    "bert-base": BertConfig(),
+    "bert-large": BertConfig(hidden_size=1024, num_hidden_layers=24,
+                             num_attention_heads=16, intermediate_size=4096),
+    "tiny": BertConfig(vocab_size=512, hidden_size=64, num_hidden_layers=2,
+                       num_attention_heads=4, intermediate_size=256,
+                       max_position_embeddings=128),
+}
+
+
+class BertLayer(nn.Module):
+    """Thin named wrapper so injection policies can match it."""
+    hidden_size: int
+    num_heads: int
+    intermediate_size: int
+    pre_layer_norm: bool = False
+    dropout: float = 0.0
+    attn_dropout: float = 0.0
+    layer_norm_eps: float = 1e-12
+
+    @nn.compact
+    def __call__(self, x, mask=None, deterministic=True):
+        cfg = DeepSpeedTransformerConfig(
+            hidden_size=self.hidden_size,
+            heads=self.num_heads,
+            intermediate_size=self.intermediate_size,
+            pre_layer_norm=self.pre_layer_norm,
+            hidden_dropout_ratio=self.dropout,
+            attn_dropout_ratio=self.attn_dropout,
+            layer_norm_eps=self.layer_norm_eps)
+        return DeepSpeedTransformerLayer(cfg, name="layer")(
+            x, mask, deterministic)
+
+
+class BertForPreTraining(nn.Module):
+    """Embeddings + fused encoder stack + tied MLM head; returns the MLM
+    cross-entropy (next-sentence head omitted — modern practice and the
+    perf-relevant path)."""
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, batch, deterministic: Optional[bool] = None):
+        cfg = self.config
+        if isinstance(batch, (tuple, list)):
+            input_ids, labels = batch[0], (batch[1] if len(batch) > 1
+                                           else None)
+            mask = None
+        else:
+            input_ids = batch["input_ids"]
+            labels = batch.get("labels")
+            mask = batch.get("attention_mask")
+        if deterministic is None:
+            deterministic = not self.has_rng("dropout")
+        B, S = input_ids.shape
+
+        wte = self.param("word_embeddings", nn.initializers.normal(0.02),
+                         (cfg.padded_vocab, cfg.hidden_size))
+        wpe = self.param("position_embeddings", nn.initializers.normal(0.02),
+                         (cfg.max_position_embeddings, cfg.hidden_size))
+        tte = self.param("token_type_embeddings",
+                         nn.initializers.normal(0.02),
+                         (cfg.type_vocab_size, cfg.hidden_size))
+        x = wte[input_ids] + wpe[None, :S] + tte[0][None, None]
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="emb_ln")(x)
+        if cfg.hidden_dropout_prob > 0:
+            x = nn.Dropout(cfg.hidden_dropout_prob)(x, deterministic)
+
+        layer_cls = BertLayer
+        if cfg.remat:
+            layer_cls = nn.remat(BertLayer, static_argnums=(3,))
+        for i in range(cfg.num_hidden_layers):
+            x = layer_cls(hidden_size=cfg.hidden_size,
+                          num_heads=cfg.num_attention_heads,
+                          intermediate_size=cfg.intermediate_size,
+                          pre_layer_norm=cfg.pre_layer_norm,
+                          dropout=cfg.hidden_dropout_prob,
+                          attn_dropout=cfg.attention_probs_dropout_prob,
+                          layer_norm_eps=cfg.layer_norm_eps,
+                          name=f"layer_{i}")(x, mask, deterministic)
+
+        # MLM transform + tied decoder (BertLMPredictionHead)
+        h = nn.Dense(cfg.hidden_size, name="mlm_dense")(x)
+        h = nn.gelu(h, approximate=True)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="mlm_ln")(h)
+        logits = jnp.einsum("bsh,vh->bsv", h, wte,
+                            preferred_element_type=jnp.float32)
+        logits = logits + self.param("mlm_bias", nn.initializers.zeros,
+                                     (cfg.padded_vocab,))
+
+        if labels is None:
+            return logits
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        safe_labels = jnp.maximum(labels, 0)
+        ll = jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
+        valid = (labels >= 0).astype(jnp.float32)
+        return -(ll * valid).sum() / jnp.maximum(valid.sum(), 1.0)
+
+
+def bert_tp_rules():
+    rules = [(r"word_embeddings$",
+              __import__("jax").sharding.PartitionSpec("model", None))]
+    return rules + transformer_tp_rules()
+
+
+def synthetic_mlm_batch(batch_size, seq_len, vocab_size, mask_prob=0.15,
+                        seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, vocab_size, (batch_size, seq_len), dtype=np.int32)
+    labels = np.full_like(ids, -100)
+    mask = rng.random((batch_size, seq_len)) < mask_prob
+    labels[mask] = ids[mask]
+    ids[mask] = 103  # [MASK]
+    return {"input_ids": jnp.asarray(ids), "labels": jnp.asarray(labels)}

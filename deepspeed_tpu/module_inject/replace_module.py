@@ -1,0 +1,137 @@
+"""Module injection — swap model layers for TPU-optimised equivalents.
+
+Rebuild of deepspeed/module_inject/replace_module.py
+(``replace_transformer_layer`` :123, generic walker ``replace_module``
+:651, ``ReplaceWithTensorSlicing`` :41) and replace_policy.py. The
+reference mutates an eager torch module tree, swapping HF layer instances
+for fused-CUDA modules or tensor-sliced linears. Flax modules are
+immutable dataclasses, so injection is a CONFIG transform: policies map a
+module class to (replacement class, kwargs transform), and
+``replace_module`` rebuilds the module tree with replacements applied.
+Tensor slicing is not a module swap at all on TPU — it is the
+ModelParallelRules PartitionSpec table (zero/partition.py), which the
+policies provide via ``tp_rules()``.
+"""
+
+import dataclasses
+from typing import Callable, Dict, Optional, Type
+
+import flax.linen as nn
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class ReplacePolicy:
+    """Base policy (reference replace_policy.py DSPolicy)."""
+    source_class: Optional[Type] = None
+
+    def match(self, module) -> bool:
+        return self.source_class is not None and \
+            isinstance(module, self.source_class)
+
+    def replacement(self, module):
+        raise NotImplementedError
+
+    def tp_rules(self):
+        """PartitionSpec rules implementing the reference's tensor-slicing
+        injection (ReplaceWithTensorSlicing / LinearAllreduce)."""
+        return []
+
+
+class GPT2BlockPolicy(ReplacePolicy):
+    """Policy for this package's GPT-2 blocks: already Pallas-backed, so
+    replacement is identity; provides the megatron TP rules
+    (reference HFGPT2LayerPolicy)."""
+
+    def __init__(self):
+        from deepspeed_tpu.models import gpt2
+        self.source_class = gpt2.Block
+
+    def replacement(self, module):
+        return module
+
+    def tp_rules(self):
+        from deepspeed_tpu.models.gpt2 import gpt2_tp_rules
+        return gpt2_tp_rules()
+
+
+class BertLayerPolicy(ReplacePolicy):
+    """Reference HFBertLayerPolicy: swap an encoder layer for the fused
+    DeepSpeedTransformerLayer (ops/transformer/transformer.py)."""
+
+    def __init__(self):
+        try:
+            from deepspeed_tpu.models import bert
+            self.source_class = bert.BertLayer
+        except Exception:  # model family not present
+            self.source_class = None
+
+    def replacement(self, module):
+        from deepspeed_tpu.ops.transformer.transformer import (
+            DeepSpeedTransformerConfig, DeepSpeedTransformerLayer)
+        cfg = DeepSpeedTransformerConfig(
+            hidden_size=module.hidden_size,
+            heads=module.num_heads,
+            intermediate_size=getattr(module, "intermediate_size",
+                                      4 * module.hidden_size),
+            pre_layer_norm=getattr(module, "pre_layer_norm", False))
+        return DeepSpeedTransformerLayer(cfg)
+
+    def tp_rules(self):
+        from deepspeed_tpu.models.bert import bert_tp_rules
+        return bert_tp_rules()
+
+
+GENERIC_POLICIES = [GPT2BlockPolicy, BertLayerPolicy]
+
+
+def replace_module(model: nn.Module, policies=None) -> nn.Module:
+    """Rebuild *model* with policy replacements applied (reference :651).
+
+    Flax modules are frozen dataclasses; submodules declared as fields are
+    replaced via dataclasses.replace. Compact-style models (submodules
+    created inside __call__) can't be walked — they're already built on
+    this package's ops, which is what injection would install anyway."""
+    policies = [p() if isinstance(p, type) else p
+                for p in (policies or GENERIC_POLICIES)]
+
+    def transform(mod):
+        for pol in policies:
+            if pol.match(mod):
+                return pol.replacement(mod)
+        if dataclasses.is_dataclass(mod):
+            updates = {}
+            for f in dataclasses.fields(mod):
+                try:
+                    v = getattr(mod, f.name)
+                except AttributeError:
+                    continue
+                if isinstance(v, nn.Module):
+                    new_v = transform(v)
+                    if new_v is not v:
+                        updates[f.name] = new_v
+            if updates:
+                return dataclasses.replace(mod, **updates)
+        return mod
+
+    return transform(model)
+
+
+def replace_transformer_layer(orig_layer_impl, model, policy=None,
+                              micro_batch_size=-1, config=None, seed=-1,
+                              max_seq_length=512, **kwargs):
+    """API-parity wrapper (reference :123)."""
+    return replace_module(model, policies=[policy] if policy else None)
+
+
+def tensor_slicing_rules(policies=None):
+    """Collect the TP PartitionSpec rules from all policies — the
+    declarative form of ReplaceWithTensorSlicing (reference :41)."""
+    rules = []
+    for p in (policies or GENERIC_POLICIES):
+        pol = p() if isinstance(p, type) else p
+        try:
+            rules.extend(pol.tp_rules())
+        except Exception as e:
+            logger.warning(f"policy {p}: tp_rules unavailable ({e})")
+    return rules

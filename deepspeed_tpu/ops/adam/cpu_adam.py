@@ -1,0 +1,84 @@
+"""DeepSpeedCPUAdam — host-memory Adam for ZeRO-Offload.
+
+Rebuild of ops/adam/cpu_adam.py:13 over the AVX C++ kernel
+(csrc/cpu_adam.cpp, reference csrc/adam/cpu_adam.cpp). Operates on numpy
+fp32 buffers that live in host RAM (the offloaded optimizer partition);
+the swap layer (runtime/swap_tensor/) moves them against device HBM.
+"""
+
+import itertools
+
+import numpy as np
+
+from deepspeed_tpu.ops.op_builder.builder import CPUAdamBuilder
+
+_ids = itertools.count()
+
+
+def _ptr(a: np.ndarray):
+    import ctypes
+    assert a.dtype == np.float32 and a.flags["C_CONTIGUOUS"]
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+class DeepSpeedCPUAdam:
+    """step() fuses the whole Adam update in one native call per tensor.
+
+    Matches the reference wrapper surface: construct with param buffers
+    (numpy fp32), call ``step(grads)``; state lives host-side."""
+
+    def __init__(self, params, lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
+                 weight_decay=0.0, adamw_mode=True, bias_correction=True,
+                 fp32_optimizer_states=True):
+        self.lib = CPUAdamBuilder().load()
+        self.opt_id = next(_ids)
+        self.lr = lr
+        self.bias_correction = bias_correction
+        self.lib.ds_adam_create(self.opt_id, betas[0], betas[1], eps,
+                                weight_decay, 1 if adamw_mode else 0)
+        self.params = [np.ascontiguousarray(p, dtype=np.float32)
+                       for p in params]
+        self.exp_avg = [np.zeros_like(p) for p in self.params]
+        self.exp_avg_sq = [np.zeros_like(p) for p in self.params]
+        self.step_count = 0
+
+    def step(self, grads, lr=None):
+        """grads: list of numpy fp32 arrays matching params."""
+        self.step_count += 1
+        lr = self.lr if lr is None else lr
+        for p, g, m, v in zip(self.params, grads, self.exp_avg,
+                              self.exp_avg_sq):
+            g = np.ascontiguousarray(g, dtype=np.float32)
+            rc = self.lib.ds_adam_step(self.opt_id, self.step_count, lr,
+                                       _ptr(p), _ptr(g), _ptr(m), _ptr(v),
+                                       p.size)
+            assert rc == 0, f"ds_adam_step failed ({rc})"
+        return self.params
+
+    def __del__(self):
+        try:
+            self.lib.ds_adam_destroy(self.opt_id)
+        except Exception:
+            pass
+
+
+class DeepSpeedCPUAdagrad:
+    """ops/adagrad/cpu_adagrad.py equivalent over ds_adagrad_step."""
+
+    def __init__(self, params, lr=1e-2, eps=1e-10, weight_decay=0.0):
+        self.lib = CPUAdamBuilder().load()
+        self.lr = lr
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.params = [np.ascontiguousarray(p, dtype=np.float32)
+                       for p in params]
+        self.exp_avg_sq = [np.zeros_like(p) for p in self.params]
+
+    def step(self, grads, lr=None):
+        lr = self.lr if lr is None else lr
+        for p, g, v in zip(self.params, grads, self.exp_avg_sq):
+            g = np.ascontiguousarray(g, dtype=np.float32)
+            rc = self.lib.ds_adagrad_step(lr, self.eps, self.weight_decay,
+                                          _ptr(p), _ptr(g), _ptr(v), p.size)
+            assert rc == 0
+        return self.params

@@ -1,0 +1,68 @@
+"""Python surface of the async IO engine.
+
+Mirrors the reference binding (csrc/aio/py_ds_aio.cpp:12 ``aio_handle``
+with block_size/queue_depth/single_submit/overlap_events/thread_count;
+sync/async pread/pwrite) over the C ABI in csrc/aio.cpp.
+"""
+
+import numpy as np
+
+from deepspeed_tpu.ops.op_builder.builder import AsyncIOBuilder
+
+
+def _buf(a: np.ndarray):
+    import ctypes
+    assert a.flags["C_CONTIGUOUS"]
+    return a.ctypes.data_as(ctypes.c_char_p)
+
+
+class AsyncIOHandle:
+    def __init__(self, block_size=1 << 20, queue_depth=8,
+                 single_submit=False, overlap_events=True, thread_count=1):
+        self.lib = AsyncIOBuilder().load()
+        self.handle = self.lib.aio_handle_create(
+            block_size, queue_depth, int(single_submit), int(overlap_events),
+            thread_count)
+        assert self.handle > 0, "aio handle creation failed"
+        self._block_size = block_size
+        self._thread_count = thread_count
+
+    # reference getters (deepspeed_py_aio_handle.cpp)
+    def get_block_size(self):
+        return self._block_size
+
+    def get_thread_count(self):
+        return self._thread_count
+
+    def sync_pread(self, buffer: np.ndarray, path: str, offset=0):
+        n = self.lib.aio_sync_pread(self.handle, _buf(buffer),
+                                    path.encode(), buffer.nbytes, offset)
+        assert n >= 0, f"pread failed ({n})"
+        return n
+
+    def sync_pwrite(self, buffer: np.ndarray, path: str, offset=0):
+        n = self.lib.aio_sync_pwrite(self.handle, _buf(buffer),
+                                     path.encode(), buffer.nbytes, offset)
+        assert n == buffer.nbytes, f"pwrite failed ({n})"
+        return n
+
+    def async_pread(self, buffer: np.ndarray, path: str, offset=0):
+        req = self.lib.aio_async_pread(self.handle, _buf(buffer),
+                                       path.encode(), buffer.nbytes, offset)
+        assert req > 0, f"async pread submit failed ({req})"
+        return req
+
+    def async_pwrite(self, buffer: np.ndarray, path: str, offset=0):
+        req = self.lib.aio_async_pwrite(self.handle, _buf(buffer),
+                                        path.encode(), buffer.nbytes, offset)
+        assert req > 0, f"async pwrite submit failed ({req})"
+        return req
+
+    def wait(self, request_id):
+        return self.lib.aio_wait(self.handle, request_id)
+
+    def __del__(self):
+        try:
+            self.lib.aio_handle_destroy(self.handle)
+        except Exception:
+            pass

@@ -39,13 +39,14 @@ def _lamb_pass1_kernel(s_ref, p_ref, g_ref, m_ref, v_ref,
     mo_ref[:] = m
     vo_ref[:] = v
     # norm partial sums accumulate across the sequential TPU grid into one
-    # (1, 1) output block (resident across iterations)
+    # (1, 1) output block (resident across iterations); stores must be 2D
+    # slices — scalar stores to VMEM are rejected by Mosaic
     @pl.when(pl.program_id(0) == 0)
     def _():
-        wn_ref[0, 0] = 0.0
-        un_ref[0, 0] = 0.0
-    wn_ref[0, 0] += jnp.sum(p * p)
-    un_ref[0, 0] += jnp.sum(u * u)
+        wn_ref[:, :] = jnp.zeros((1, 1), jnp.float32)
+        un_ref[:, :] = jnp.zeros((1, 1), jnp.float32)
+    wn_ref[:, :] += jnp.sum(p * p).reshape(1, 1)
+    un_ref[:, :] += jnp.sum(u * u).reshape(1, 1)
 
 
 def fused_lamb_update(p, g, m, v, lr, bc1, bc2, *, b1=0.9, b2=0.999,

@@ -1,0 +1,264 @@
+"""Block-sparse attention Pallas kernels.
+
+TPU-native replacement for the reference's triton block-sparse stack
+(ops/sparse_attention/matmul.py ``_kernel`` :13 — SDD/DSD matmuls,
+softmax.py, and the csrc/sparse_attention/utils.cpp LUT builder). The
+layout [H, nq, nk] gates a flash-style online-softmax sweep: the kv loop
+visits every block but the whole block body is predicated on
+``layout[qi, j]``, so Mosaic skips the MXU work for absent blocks — the
+TPU analogue of triton's LUT-driven launch. Memory stays O(seq) (no dense
+[S, S] scores), which is where the reference's 10-16× longer-sequence
+claim comes from (BASELINE.md sparse attention rows).
+
+Backward reuses the same predication with the transposed layout for
+dk/dv. All kernels run in interpret mode off-TPU (CPU tests).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+LANES = 8
+
+
+def _interpret():
+    return jax.default_backend() != "tpu"
+
+
+def _fwd_kernel(layout_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                sm_scale, causal, block, seq):
+    qi = pl.program_id(1)
+    q = q_ref[0]
+    num_kv = seq // block
+
+    def body(j, carry):
+        acc, m, l = carry
+
+        def attend(carry):
+            acc, m, l = carry
+            k = k_ref[0, pl.ds(j * block, block), :]
+            v = v_ref[0, pl.ds(j * block, block), :]
+            s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32) \
+                * sm_scale
+            if causal:
+                rows = qi * block + jax.lax.broadcasted_iota(
+                    jnp.int32, (block, block), 0)
+                cols = j * block + jax.lax.broadcasted_iota(
+                    jnp.int32, (block, block), 1)
+                s = jnp.where(cols <= rows, s, NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(s, axis=1))
+            p = jnp.exp(s - m_new[:, None])
+            alpha = jnp.exp(m - m_new)
+            l_new = l * alpha + jnp.sum(p, axis=1)
+            acc = acc * alpha[:, None] + jax.lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return acc, m_new, l_new
+
+        return jax.lax.cond(layout_ref[0, qi, j] != 0, attend,
+                            lambda c: c, carry)
+
+    d = q.shape[-1]
+    acc = jnp.zeros((block, d), jnp.float32)
+    m = jnp.full((block,), NEG_INF, jnp.float32)
+    l = jnp.zeros((block,), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, num_kv, body, (acc, m, l))
+
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    empty = l == 0.0  # rows with no attended block at all → zero output
+    o_ref[0] = jnp.where(empty[:, None], 0.0, o_ref[0]).astype(o_ref.dtype)
+    lse_ref[0] = jnp.broadcast_to(
+        (m + jnp.log(l_safe))[:, None], (block, LANES))
+
+
+def _dq_kernel(layout_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+               dq_ref, *, sm_scale, causal, block, seq):
+    qi = pl.program_id(1)
+    q = q_ref[0]
+    do = do_ref[0]
+    lse = lse_ref[0, :, 0:1]
+    delta = delta_ref[0, :, 0:1]
+    num_kv = seq // block
+
+    def body(j, dq):
+        def attend(dq):
+            k = k_ref[0, pl.ds(j * block, block), :]
+            v = v_ref[0, pl.ds(j * block, block), :]
+            s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32) \
+                * sm_scale
+            if causal:
+                rows = qi * block + jax.lax.broadcasted_iota(
+                    jnp.int32, (block, block), 0)
+                cols = j * block + jax.lax.broadcasted_iota(
+                    jnp.int32, (block, block), 1)
+                s = jnp.where(cols <= rows, s, NEG_INF)
+            p = jnp.exp(s - lse)
+            dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            ds = p * (dp - delta) * sm_scale
+            return dq + jax.lax.dot_general(
+                ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+        return jax.lax.cond(layout_ref[0, qi, j] != 0, attend,
+                            lambda d: d, dq)
+
+    dq = jnp.zeros(q.shape, jnp.float32)
+    dq = jax.lax.fori_loop(0, num_kv, body, dq)
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(layout_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, *, sm_scale, causal, block, seq):
+    kj = pl.program_id(1)
+    k = k_ref[0]
+    v = v_ref[0]
+    num_q = seq // block
+
+    def body(i, carry):
+        def attend(carry):
+            dk, dv = carry
+            q = q_ref[0, pl.ds(i * block, block), :]
+            do = do_ref[0, pl.ds(i * block, block), :]
+            lse = lse_ref[0, pl.ds(i * block, block), 0:1]
+            delta = delta_ref[0, pl.ds(i * block, block), 0:1]
+            s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32) \
+                * sm_scale
+            if causal:
+                rows = i * block + jax.lax.broadcasted_iota(
+                    jnp.int32, (block, block), 0)
+                cols = kj * block + jax.lax.broadcasted_iota(
+                    jnp.int32, (block, block), 1)
+                s = jnp.where(cols <= rows, s, NEG_INF)
+            p = jnp.exp(s - lse)
+            dv = dv + jax.lax.dot_general(
+                p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            ds = p * (dp - delta) * sm_scale
+            dk = dk + jax.lax.dot_general(
+                ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return dk, dv
+
+        # transposed gating: kv block kj is touched by q block i
+        return jax.lax.cond(layout_ref[0, i, kj] != 0, attend,
+                            lambda c: c, carry)
+
+    dk = jnp.zeros(k.shape, jnp.float32)
+    dv = jnp.zeros(v.shape, jnp.float32)
+    dk, dv = jax.lax.fori_loop(0, num_q, body, (dk, dv))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def block_sparse_attention(q, k, v, layout, block=None, causal=False,
+                           sm_scale=None):
+    """Attention restricted to the block layout.
+
+    q,k,v: [B, H, S, D]; layout: [H, S//block, S//block] int."""
+    out, _ = _bs_fwd(q, k, v, layout, block, causal, sm_scale)
+    return out
+
+
+def _specs(H, block, nq, D, S):
+    lay = pl.BlockSpec((1, nq, nq), lambda b, i: (b % H, 0, 0))
+    qb = pl.BlockSpec((1, block, D), lambda b, i: (b, i, 0))
+    full = pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0))
+    stat = pl.BlockSpec((1, block, LANES), lambda b, i: (b, i, 0))
+    statf = pl.BlockSpec((1, S, LANES), lambda b, i: (b, 0, 0))
+    return lay, qb, full, stat, statf
+
+
+def _bs_fwd(q, k, v, layout, block, causal, sm_scale):
+    B, H, S, D = q.shape
+    if block is None:
+        block = S // layout.shape[-1]
+    assert layout.shape[-1] * block == S, (layout.shape, block, S)
+    if sm_scale is None:
+        sm_scale = D ** -0.5
+    nq = S // block
+    qf = q.reshape(B * H, S, D)
+    kf = k.reshape(B * H, S, D)
+    vf = v.reshape(B * H, S, D)
+    layout = jnp.asarray(layout, jnp.int32)
+
+    lay, qb, full, stat, _ = _specs(H, block, nq, D, S)
+    o, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
+                          block=block, seq=S),
+        grid=(B * H, nq),
+        in_specs=[lay, qb, full, full],
+        out_specs=[qb, stat],
+        out_shape=[jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+                   jax.ShapeDtypeStruct((B * H, S, LANES), jnp.float32)],
+        interpret=_interpret(),
+    )(layout, qf, kf, vf)
+    return o.reshape(B, H, S, D), (q, k, v, layout, o.reshape(B, H, S, D),
+                                   lse)
+
+
+def _bs_bwd(block, causal, sm_scale, res, g):
+    q, k, v, layout, out, lse = res
+    B, H, S, D = q.shape
+    if block is None:
+        block = S // layout.shape[-1]
+    if sm_scale is None:
+        sm_scale = D ** -0.5
+    nq = S // block
+    qf = q.reshape(B * H, S, D)
+    kf = k.reshape(B * H, S, D)
+    vf = v.reshape(B * H, S, D)
+    dof = g.reshape(B * H, S, D)
+    delta = jnp.broadcast_to(
+        jnp.sum(dof.astype(jnp.float32) *
+                out.reshape(B * H, S, D).astype(jnp.float32),
+                axis=-1, keepdims=True), (B * H, S, LANES))
+
+    lay, qb, full, stat, statf = _specs(H, block, nq, D, S)
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, sm_scale=sm_scale, causal=causal,
+                          block=block, seq=S),
+        grid=(B * H, nq),
+        in_specs=[lay, qb, full, full, qb, stat, stat],
+        out_specs=qb,
+        out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+        interpret=_interpret(),
+    )(layout, qf, kf, vf, dof, lse, delta)
+
+    kb = pl.BlockSpec((1, block, D), lambda b, j: (b, j, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, sm_scale=sm_scale, causal=causal,
+                          block=block, seq=S),
+        grid=(B * H, nq),
+        in_specs=[lay, full, kb, kb, full, statf, statf],
+        out_specs=[kb, kb],
+        out_shape=[jax.ShapeDtypeStruct((B * H, S, D), k.dtype),
+                   jax.ShapeDtypeStruct((B * H, S, D), v.dtype)],
+        interpret=_interpret(),
+    )(layout, qf, kf, vf, dof, lse, delta)
+
+    return (dq.reshape(B, H, S, D), dk.reshape(B, H, S, D),
+            dv.reshape(B, H, S, D), None)
+
+
+block_sparse_attention.defvjp(
+    lambda q, k, v, layout, block, causal, sm_scale:
+    _bs_fwd(q, k, v, layout, block, causal, sm_scale),
+    _bs_bwd)
+
+
+def layout_to_dense_mask(layout, block, seq):
+    """Expand a block layout to an element mask [H, S, S] (the oracle)."""
+    lay = np.asarray(layout)
+    return np.kron(lay, np.ones((block, block), dtype=bool))

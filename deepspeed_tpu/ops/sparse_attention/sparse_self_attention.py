@@ -1,0 +1,72 @@
+"""SparseSelfAttention module.
+
+Rebuild of deepspeed/ops/sparse_attention/sparse_self_attention.py:13
+(and BertSparseSelfAttention): applies block-sparse attention under a
+SparsityConfig. Layouts are built once per (config, seq_len) and cached —
+the analogue of the reference's master-layout caching
+(sparse_self_attention.py:42).
+"""
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.sparse_attention.kernels import block_sparse_attention
+from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
+    FixedSparsityConfig, SparsityConfig)
+
+_layout_cache = {}
+
+
+def get_layout(sparsity_config: SparsityConfig, seq_len: int):
+    key = (id(sparsity_config), seq_len)
+    if key not in _layout_cache:
+        _layout_cache[key] = sparsity_config.make_layout(seq_len)
+    return _layout_cache[key]
+
+
+class SparseSelfAttention(nn.Module):
+    """q,k,v [B, H, S, D] → context [B, H, S, D] under the sparse layout
+    (reference forward, sparse_self_attention.py:117)."""
+    sparsity_config: SparsityConfig = None
+    key_padding_mask_mode: str = "add"
+    attn_mask_mode: str = "mul"
+    max_seq_length: int = 2048
+
+    def _config(self):
+        return self.sparsity_config or FixedSparsityConfig(num_heads=4)
+
+    @nn.compact
+    def __call__(self, query, key, value, rpe=None, key_padding_mask=None,
+                 attn_mask=None):
+        assert query.dtype == key.dtype == value.dtype
+        S = query.shape[2]
+        cfg = self._config()
+        layout = get_layout(cfg, S)
+        causal = getattr(cfg, "attention", "bidirectional") == "unidirectional"
+        return block_sparse_attention(
+            query, key, value, jnp.asarray(layout), cfg.block, causal,
+            None)
+
+
+class BertSparseSelfAttention(nn.Module):
+    """Reference bert_sparse_self_attention.py: BERT-shaped wrapper."""
+    hidden_size: int
+    num_attention_heads: int
+    sparsity_config: SparsityConfig = None
+
+    @nn.compact
+    def __call__(self, hidden_states, attention_mask=None):
+        B, S, H = hidden_states.shape
+        nh = self.num_attention_heads
+        hd = H // nh
+        qkv = nn.Dense(3 * H, name="qkv")(hidden_states)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
+        k = k.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
+        v = v.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
+        ctx = SparseSelfAttention(
+            sparsity_config=self.sparsity_config or
+            FixedSparsityConfig(num_heads=nh), name="sparse_attn")(q, k, v)
+        return ctx.transpose(0, 2, 1, 3).reshape(B, S, H)

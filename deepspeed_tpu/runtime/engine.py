@@ -139,6 +139,11 @@ class DeepSpeedEngine:
 
         self.zero_stage = self.config.zero_optimization_stage
         self.mp_rules = mp_rules or ModelParallelRules()
+        # ZeRO-Offload: optimizer state leaves HBM for host RAM / NVMe
+        # (reference cpu_offload stage_1_and_2.py:1003, stage3 swapping)
+        self._offload_device = self.config.zero_config.offload_optimizer.device
+        self._offload = self._offload_device not in (None, "none")
+        self._offload_opt = None
 
         # ---- precision ----------------------------------------------------
         if self.config.fp16_enabled:
@@ -266,7 +271,23 @@ class DeepSpeedEngine:
         # of the XLA-fused jnp update; both are bit-compatible.
         use_fused = params.pop("fused", False)
 
-        if name in (ADAM_OPTIMIZER, ADAMW_OPTIMIZER, ONEBIT_ADAM_OPTIMIZER):
+        if name == ONEBIT_ADAM_OPTIMIZER:
+            from deepspeed_tpu.runtime.fp16.onebit.adam import onebit_adam
+            return onebit_adam(
+                b1=betas[0], b2=betas[1], eps=params.get("eps", 1e-8),
+                weight_decay=params.get("weight_decay", 0.0),
+                freeze_step=params.get("freeze_step", 100),
+                adam_w_mode=params.pop("adam_w_mode", True),
+                bias_correction=params.get("bias_correction", True))
+        if name == ONEBIT_LAMB_OPTIMIZER:
+            from deepspeed_tpu.runtime.fp16.onebit.lamb import onebit_lamb
+            return onebit_lamb(
+                b1=betas[0], b2=betas[1], eps=params.get("eps", 1e-6),
+                weight_decay=params.get("weight_decay", 0.0),
+                freeze_step=params.get("freeze_step", 100),
+                min_coeff=params.get("min_coeff", 0.01),
+                max_coeff=params.get("max_coeff", 10.0))
+        if name in (ADAM_OPTIMIZER, ADAMW_OPTIMIZER):
             # Reference: both "adam" and "adamw" route to FusedAdam, which
             # defaults to adam_w_mode=True (ops/adam/fused_adam.py:16).
             adam_w_mode = params.pop("adam_w_mode", True)
@@ -280,7 +301,7 @@ class DeepSpeedEngine:
                 from deepspeed_tpu.ops.adam.fused_adam import fused_adam
                 return fused_adam(**kw)
             return optim_lib.adam(**kw)
-        if name in (LAMB_OPTIMIZER, ONEBIT_LAMB_OPTIMIZER):
+        if name == LAMB_OPTIMIZER:
             kw = dict(b1=betas[0], b2=betas[1],
                       eps=params.get("eps", 1e-6),
                       weight_decay=params.get("weight_decay", 0.0),
@@ -312,6 +333,22 @@ class DeepSpeedEngine:
         return None, (lambda step: base_lr), base_lr
 
     # ------------------------------------------------------------------- state
+
+    def _make_offload_optimizer(self):
+        from deepspeed_tpu.runtime.zero.offload import OffloadedOptimizer
+        op = dict(self.config.optimizer_params or {})
+        nvme_path = None
+        if self._offload_device == "nvme":
+            nvme_path = (self.config.zero_config.offload_optimizer
+                         .nvme_path or "/tmp")
+        return OffloadedOptimizer(
+            self.state.params, lr=self._base_lr,
+            betas=op.get("betas", (0.9, 0.999)),
+            eps=op.get("eps", 1e-8),
+            weight_decay=op.get("weight_decay", 0.0),
+            adam_w_mode=op.get("adam_w_mode", True),
+            nvme_path=nvme_path)
+
     def _init_state(self, model_parameters, sample_batch):
         if model_parameters is not None:
             params = model_parameters
@@ -333,7 +370,11 @@ class DeepSpeedEngine:
         # persistence threshold only gates stage-3 param sharding (the
         # ds_persist analogue); optimizer/grad shards have no fetch cost so
         # they always shard when divisible.
-        opt_shape = jax.eval_shape(self.optimizer.init, params)
+        if self._offload:
+            # optimizer state lives host-side: nothing on the device
+            opt_shape = ()
+        else:
+            opt_shape = jax.eval_shape(self.optimizer.init, params)
         self.opt_shardings = build_opt_shardings(
             opt_shape, self.mesh, self.zero_stage, self.mp_rules,
             min_shard_numel=0)
@@ -362,7 +403,7 @@ class DeepSpeedEngine:
             return TrainState(
                 step=jnp.zeros([], jnp.int32),
                 params=p,
-                opt_state=self.optimizer.init(p),
+                opt_state=() if self._offload else self.optimizer.init(p),
                 acc_grads=jax.tree.map(lambda x: jnp.zeros_like(x, jnp.float32), p),
                 scale=make_scale_state(
                     self._init_scale,
@@ -372,6 +413,9 @@ class DeepSpeedEngine:
             params = jax.device_put(params, self.param_shardings)
             self.state = jax.jit(
                 make_state, out_shardings=self.state_shardings)(params)
+
+        if self._offload:
+            self._offload_opt = self._make_offload_optimizer()
 
         self._build_step_fns()
         self._pending_loss = None
@@ -423,19 +467,33 @@ class DeepSpeedEngine:
             loss = sloss * gas / state.scale.loss_scale
             return state._replace(acc_grads=acc), loss
 
-        def apply_step(state):
+        def grad_prologue(state):
+            """Shared epilogue-of-accumulation: unscale, overflow check,
+            norm + clip, scale-state update, acc reset. Returns
+            (state-with-reset-acc-and-new-scale, grads, grad_norm,
+            overflow)."""
             inv_scale = 1.0 / state.scale.loss_scale
             grads = jax.tree.map(lambda g: g * inv_scale, state.acc_grads)
-
             finite = jnp.array(True)
             if cfg.fp16_enabled:
                 finite = jnp.all(jnp.stack(
                     [jnp.isfinite(g).all() for g in jax.tree.leaves(grads)]))
-
             grad_norm = optim_lib.global_norm(grads)
             if cfg.gradient_clipping > 0:
-                grads, _ = optim_lib.clip_by_global_norm(grads, cfg.gradient_clipping)
+                grads, _ = optim_lib.clip_by_global_norm(
+                    grads, cfg.gradient_clipping)
+            new_scale = update_scale(
+                state.scale, ~finite,
+                dynamic=self._dynamic_scale,
+                scale_window=cfg.fp16.loss_scale_window,
+                min_scale=cfg.fp16.min_loss_scale,
+                delayed_shift=cfg.fp16.hysteresis)
+            zeros = jax.tree.map(jnp.zeros_like, state.acc_grads)
+            state = state._replace(acc_grads=zeros, scale=new_scale)
+            return state, grads, grad_norm, finite
 
+        def apply_step(state):
+            state, grads, grad_norm, finite = grad_prologue(state)
             lr = self._lr_fn_traced(state.step)
 
             def do_update(operand):
@@ -451,21 +509,24 @@ class DeepSpeedEngine:
                 return st
 
             state = jax.lax.cond(finite, do_update, skip_update, (state, grads))
-            new_scale = update_scale(
-                state.scale, ~finite,
-                dynamic=self._dynamic_scale,
-                scale_window=cfg.fp16.loss_scale_window,
-                min_scale=cfg.fp16.min_loss_scale,
-                delayed_shift=cfg.fp16.hysteresis)
-            zeros = jax.tree.map(jnp.zeros_like, state.acc_grads)
-            return state._replace(acc_grads=zeros, scale=new_scale), \
-                grad_norm, ~finite
+            return state, grad_norm, ~finite
+
+        def offload_pre_step(state):
+            """Device half of the offloaded step: the shared prologue —
+            grads go to the host CPU-Adam; params unchanged."""
+            state, grads, grad_norm, finite = grad_prologue(state)
+            return state, grads, grad_norm, ~finite
 
         sh = self.state_shardings
         self._jit_micro = jax.jit(
             micro_step, donate_argnums=0,
             in_shardings=(sh, None, None, None),
             out_shardings=(sh, NamedSharding(self.mesh, P())))
+        scalar = NamedSharding(self.mesh, P())
+        self._jit_offload_pre = jax.jit(
+            offload_pre_step, donate_argnums=0,
+            in_shardings=(sh,),
+            out_shardings=(sh, self.grad_shardings, scalar, scalar))
         self._jit_apply = jax.jit(
             apply_step, donate_argnums=0,
             in_shardings=(sh,),
@@ -527,12 +588,28 @@ class DeepSpeedEngine:
     def is_gradient_accumulation_boundary(self):
         return (self.micro_steps % self.gradient_accumulation_steps()) == 0
 
+    def _offload_step(self):
+        """Host half of the offloaded step: shard-local CPU-Adam."""
+        self.state, grads, grad_norm, overflow = self._jit_offload_pre(
+            self.state)
+        if not bool(jax.device_get(overflow)):
+            lr = float(self._lr_fn(max(
+                0, self.global_steps - self.skipped_steps)))
+            new_params = self._offload_opt.step(
+                grads, lr, self.state.params, self.param_shardings)
+            self.state = self.state._replace(
+                params=new_params, step=self.state.step + 1)
+        return grad_norm, overflow
+
     def step(self, lr_kwargs=None):
         """Optimizer step at the gradient-accumulation boundary
         (reference engine.step, engine.py:1862)."""
         if not self.is_gradient_accumulation_boundary():
             return
-        self.state, grad_norm, overflow = self._jit_apply(self.state)
+        if self._offload:
+            grad_norm, overflow = self._offload_step()
+        else:
+            self.state, grad_norm, overflow = self._jit_apply(self.state)
         self._last_grad_norm = grad_norm
         self.global_steps += 1
         self.global_samples += self.train_batch_size()
@@ -669,6 +746,8 @@ class DeepSpeedEngine:
             "format": "shards-v1",
             "optimizer_state_dict": checkpoint_io.tree_local_shards(
                 self.state.opt_state),
+            "offload_optimizer_state": (self._offload_opt.state_dict()
+                                        if self._offload_opt else None),
             "param_shards": checkpoint_io.tree_local_shards(
                 self.state.params),
             "scale_state": {k: np.asarray(jax.device_get(v)) for k, v in
@@ -732,6 +811,20 @@ class DeepSpeedEngine:
                     logger.warning(
                         f"no zero_pp_rank files under {load_dir}/{tag}; "
                         f"resuming with FRESH optimizer state and loss scale")
+                elif self._offload:
+                    # host-optimizer moments are SHARD-LOCAL: restore only
+                    # from THIS process's own zero file; another rank's
+                    # moments belong to different param slices
+                    own = self._get_zero_ckpt_name(load_dir, tag)
+                    if os.path.isfile(own):
+                        with open(own, "rb") as f:
+                            self._pending_offload_sd = pickle.load(f).get(
+                                "offload_optimizer_state")
+                    else:
+                        logger.warning(
+                            f"offload moments for this rank missing "
+                            f"({own}); resuming with FRESH moments")
+                        self._pending_offload_sd = None
                 elif zero_payloads[0].get("format") != "shards-v1":
                     # pre-shard-format checkpoint: raw pytree per file
                     opt_state = jax.device_put(
@@ -745,17 +838,26 @@ class DeepSpeedEngine:
                         [z["optimizer_state_dict"] for z in zero_payloads],
                         self.opt_shardings)
                     new_state = new_state._replace(opt_state=opt_state)
-                    # full dynamic-scaler state so a resumed run is
-                    # bit-identical to an uninterrupted one
-                    ss = zero_payloads[0].get("scale_state")
-                    if ss is not None:
-                        new_state = new_state._replace(
-                            scale=LossScaleState(
-                                loss_scale=jnp.float32(ss["loss_scale"]),
-                                good_steps=jnp.int32(ss["good_steps"]),
-                                hysteresis=jnp.int32(ss["hysteresis"])))
+                # full dynamic-scaler state so a resumed run is
+                # bit-identical to an uninterrupted one (all formats)
+                ss = (zero_payloads[0].get("scale_state")
+                      if zero_payloads else None)
+                if ss is not None:
+                    new_state = new_state._replace(
+                        scale=LossScaleState(
+                            loss_scale=jnp.float32(ss["loss_scale"]),
+                            good_steps=jnp.int32(ss["good_steps"]),
+                            hysteresis=jnp.int32(ss["hysteresis"])))
 
         self.state = new_state
+        if self._offload:
+            # rebuild host masters from the freshly loaded params, then
+            # restore the host optimizer moments
+            self._offload_opt = self._make_offload_optimizer()
+            sd_off = getattr(self, "_pending_offload_sd", None)
+            if sd_off is not None:
+                self._offload_opt.load_state_dict(sd_off)
+                self._pending_offload_sd = None
         log_dist(f"loaded checkpoint {load_dir}/{tag}", ranks=[0])
         return path, client_state
 

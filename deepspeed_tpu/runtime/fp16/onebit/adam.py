@@ -1,0 +1,106 @@
+"""1-bit Adam — error-compensated sign-compressed momentum.
+
+Rebuild of deepspeed/runtime/fp16/onebit/adam.py:14 (+ the compressed
+allreduce backends comm/nccl.py:47, comm/mpi.py:170). Algorithm semantics
+are identical: a ``freeze_step`` warmup of exact Adam, then the variance
+term freezes and the momentum is communicated 1-bit (sign + per-tensor
+scale) with worker-side error feedback.
+
+TPU-native note: the reference compresses because its inter-node fabric is
+slow Ethernet; XLA's grad psum over ICI doesn't expose a hook to compress
+in-flight (and ICI rarely needs it — SURVEY.md §2.4). What this optimizer
+preserves is the ALGORITHM: post-freeze updates use the same
+sign(momentum+error)·scale quantity every rank would agree on after the
+compressed allreduce, with the same error-feedback recursion — so loss
+curves match the reference's, and the compression hook is a single
+function (``_compress``) a DCN-scale deployment can move into a
+shard_map collective.
+"""
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime import optim as optim_lib
+
+
+class OnebitAdamState(NamedTuple):
+    step: jnp.ndarray
+    mu: Any
+    nu: Any
+    error: Any       # worker error feedback (comm/nccl.py worker_error)
+
+
+def _compress(x, error):
+    """Error-compensated 1-bit compression (compressed_allreduce,
+    comm/nccl.py:47): sign bits + one fp scale; the residual feeds back."""
+    corrected = x + error
+    scale = jnp.mean(jnp.abs(corrected))
+    compressed = jnp.sign(corrected) * scale
+    new_error = corrected - compressed
+    return compressed, new_error
+
+
+def onebit_adam(b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.0,
+                freeze_step=100, adam_w_mode=True, bias_correction=True):
+    """Optimizer pair (reference OnebitAdam :14)."""
+
+    def init(params):
+        zeros = lambda: jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        return OnebitAdamState(step=jnp.zeros([], jnp.int32),
+                               mu=zeros(), nu=zeros(), error=zeros())
+
+    def update(grads, state, params, lr):
+        step = state.step + 1
+        if bias_correction:
+            bc1 = 1.0 - b1 ** step.astype(jnp.float32)
+            bc2 = 1.0 - b2 ** step.astype(jnp.float32)
+        else:
+            bc1 = bc2 = jnp.float32(1.0)
+        warm = step <= freeze_step
+
+        def leaf_update(g, m, v, e, p):
+            m_new = b1 * m + (1.0 - b1) * g
+            # warmup: exact Adam, variance updates, no compression
+            v_warm = b2 * v + (1.0 - b2) * g * g
+            upd_warm = -lr * (m_new / bc1) / (jnp.sqrt(v_warm / bc2) + eps)
+            # post-freeze: compressed momentum, frozen variance
+            m_comp, e_new = _compress(m_new, e)
+            upd_frozen = -lr * (m_comp / bc1) / (jnp.sqrt(v / bc2) + eps)
+
+            m_out = jnp.where(warm, m_new, m_comp)  # ranks stay in sync
+            v_out = jnp.where(warm, v_warm, v)
+            e_out = jnp.where(warm, e, e_new)
+            upd = jnp.where(warm, upd_warm, upd_frozen)
+            if adam_w_mode and weight_decay > 0.0:
+                upd = upd - lr * weight_decay * p
+            return upd, m_out, v_out, e_out
+
+        flat_g, treedef = jax.tree.flatten(grads)
+        flat_m = treedef.flatten_up_to(state.mu)
+        flat_v = treedef.flatten_up_to(state.nu)
+        flat_e = treedef.flatten_up_to(state.error)
+        flat_p = treedef.flatten_up_to(params)
+        out = [leaf_update(g, m, v, e, p) for g, m, v, e, p in
+               zip(flat_g, flat_m, flat_v, flat_e, flat_p)]
+        updates = treedef.unflatten([o[0] for o in out])
+        new_state = OnebitAdamState(
+            step=step,
+            mu=treedef.unflatten([o[1] for o in out]),
+            nu=treedef.unflatten([o[2] for o in out]),
+            error=treedef.unflatten([o[3] for o in out]))
+        return updates, new_state
+
+    return optim_lib.Optimizer(init, update)
+
+
+class OnebitAdam:
+    """API-parity shell (reference OnebitAdam ctor surface)."""
+
+    def __new__(cls, params=None, lr=1e-3, freeze_step=100,
+                betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
+                cuda_aware=False, comm_backend_name="xla", **_):
+        return onebit_adam(b1=betas[0], b2=betas[1], eps=eps,
+                           weight_decay=weight_decay, freeze_step=freeze_step)

@@ -1,0 +1,84 @@
+"""1-bit LAMB (reference deepspeed/runtime/fp16/onebit/lamb.py).
+
+Same structure as onebit/adam.py: freeze_step warmup of exact LAMB, then
+sign-compressed momentum with error feedback and a frozen variance; the
+per-tensor trust ratio (scaled_lr = lr * clamp(||w||/||u||)) is computed
+from the compressed update, matching the reference's fused lamb path. See
+onebit/adam.py for the TPU comm note.
+"""
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime import optim as optim_lib
+from deepspeed_tpu.runtime.fp16.onebit.adam import _compress
+
+
+class OnebitLambState(NamedTuple):
+    step: jnp.ndarray
+    mu: Any
+    nu: Any
+    error: Any
+
+
+def onebit_lamb(b1=0.9, b2=0.999, eps=1e-6, weight_decay=0.0,
+                freeze_step=100, min_coeff=0.01, max_coeff=10.0,
+                bias_correction=True):
+    def init(params):
+        zeros = lambda: jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        return OnebitLambState(step=jnp.zeros([], jnp.int32),
+                               mu=zeros(), nu=zeros(), error=zeros())
+
+    def update(grads, state, params, lr):
+        step = state.step + 1
+        if bias_correction:
+            bc1 = 1.0 - b1 ** step.astype(jnp.float32)
+            bc2 = 1.0 - b2 ** step.astype(jnp.float32)
+        else:
+            bc1 = bc2 = jnp.float32(1.0)
+        warm = step <= freeze_step
+
+        def leaf_update(g, m, v, e, p):
+            m_new = b1 * m + (1.0 - b1) * g
+            v_warm = b2 * v + (1.0 - b2) * g * g
+            m_comp, e_new = _compress(m_new, e)
+
+            m_eff = jnp.where(warm, m_new, m_comp)
+            v_eff = jnp.where(warm, v_warm, v)
+            u = (m_eff / bc1) / (jnp.sqrt(v_eff / bc2) + eps)
+            if weight_decay > 0.0:
+                u = u + weight_decay * p
+            w_norm = jnp.linalg.norm(p.astype(jnp.float32).reshape(-1))
+            u_norm = jnp.linalg.norm(u.astype(jnp.float32).reshape(-1))
+            ratio = jnp.where((w_norm > 0) & (u_norm > 0),
+                              jnp.clip(w_norm / u_norm, min_coeff, max_coeff),
+                              jnp.float32(1.0))
+            upd = -lr * ratio * u
+            return (upd, m_eff, v_eff, jnp.where(warm, e, e_new))
+
+        flat_g, treedef = jax.tree.flatten(grads)
+        flat = zip(flat_g, treedef.flatten_up_to(state.mu),
+                   treedef.flatten_up_to(state.nu),
+                   treedef.flatten_up_to(state.error),
+                   treedef.flatten_up_to(params))
+        out = [leaf_update(*args) for args in flat]
+        return (treedef.unflatten([o[0] for o in out]),
+                OnebitLambState(
+                    step=step,
+                    mu=treedef.unflatten([o[1] for o in out]),
+                    nu=treedef.unflatten([o[2] for o in out]),
+                    error=treedef.unflatten([o[3] for o in out])))
+
+    return optim_lib.Optimizer(init, update)
+
+
+class OnebitLamb:
+    def __new__(cls, params=None, lr=1e-3, freeze_step=100,
+                betas=(0.9, 0.999), eps=1e-6, weight_decay=0.0,
+                min_coeff=0.01, max_coeff=10.0, **_):
+        return onebit_lamb(b1=betas[0], b2=betas[1], eps=eps,
+                           weight_decay=weight_decay, freeze_step=freeze_step,
+                           min_coeff=min_coeff, max_coeff=max_coeff)

@@ -118,8 +118,7 @@ class PipelineModule:
                  loss_fn: Optional[Callable] = None,
                  partition_method="parameters",
                  activation_checkpoint_interval=0, seed_layers=False):
-        self.specs = [spec if isinstance(spec, LayerSpec) else spec
-                      for spec in layers]
+        self.specs = list(layers)
         if topology is not None:
             self.num_stages = topology.get_dim("pipe")
         else:
@@ -141,10 +140,13 @@ class PipelineModule:
             return [max(int(self._param_estimate(s)), 1) for s in self.specs]
         if method.startswith("type:"):
             pat = re.compile(method[5:], re.IGNORECASE)
-            return [1 if (isinstance(s, LayerSpec) and
-                          pat.search(s.typename.__name__)) or
-                         pat.search(type(s).__name__) else 0
-                    for s in self.specs]
+
+            def matches(s):
+                if isinstance(s, LayerSpec):
+                    return bool(pat.search(s.typename.__name__))
+                return bool(pat.search(type(s).__name__))
+
+            return [1 if matches(s) else 0 for s in self.specs]
         raise NotImplementedError(f"partition_method {self.partition_method}")
 
     @staticmethod

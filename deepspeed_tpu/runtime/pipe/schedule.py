@@ -221,7 +221,7 @@ class TrainSchedule(PipeSchedule):
         return base + self.stage_id // 2
 
     def num_pipe_buffers(self):
-        buffers = min(self.stages - self.stage_id, self.micro_batches)
+        buffers = min(self.stages - self.stage_id + 1, self.micro_batches)
         return max(2, buffers)
 
 

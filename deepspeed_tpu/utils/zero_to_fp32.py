@@ -26,8 +26,15 @@ def get_latest_tag(checkpoint_dir):
     if os.path.isfile(latest):
         with open(latest) as f:
             return f.read().strip()
-    tags = sorted(d for d in os.listdir(checkpoint_dir)
-                  if os.path.isdir(os.path.join(checkpoint_dir, d)))
+    import re as _re
+
+    def natural(t):  # global_step10 > global_step9
+        return [int(x) if x.isdigit() else x
+                for x in _re.split(r"(\d+)", t)]
+
+    tags = sorted((d for d in os.listdir(checkpoint_dir)
+                   if os.path.isdir(os.path.join(checkpoint_dir, d))),
+                  key=natural)
     assert tags, f"no checkpoint tags under {checkpoint_dir}"
     return tags[-1]
 

@@ -1,0 +1,71 @@
+"""Launcher host parsing (reference tests/unit/test_run.py)."""
+
+import pytest
+
+from deepspeed_tpu.launcher.runner import (encode_world_info, fetch_hostfile,
+                                           parse_resource_filter)
+
+
+def _hostfile(tmp_path, text):
+    p = tmp_path / "hostfile"
+    p.write_text(text)
+    return str(p)
+
+
+def test_fetch_hostfile(tmp_path):
+    path = _hostfile(tmp_path, """
+worker-0 slots=4
+worker-1 slots=8
+# comment
+""")
+    pool = fetch_hostfile(path)
+    assert pool == {"worker-0": 4, "worker-1": 8}
+
+
+def test_fetch_hostfile_bad_format(tmp_path):
+    path = _hostfile(tmp_path, "worker-0 slots4\n")
+    with pytest.raises(ValueError):
+        fetch_hostfile(path)
+
+
+def test_fetch_hostfile_duplicate(tmp_path):
+    path = _hostfile(tmp_path, "w slots=2\nw slots=2\n")
+    with pytest.raises(ValueError, match="duplicate"):
+        fetch_hostfile(path)
+
+
+def test_missing_hostfile_returns_none(tmp_path):
+    assert fetch_hostfile(str(tmp_path / "nope")) is None
+
+
+def test_include_filter():
+    pool = {"worker-0": 4, "worker-1": 4}
+    out = parse_resource_filter(pool, include_str="worker-1")
+    assert list(out.keys()) == ["worker-1"]
+    out = parse_resource_filter(pool, include_str="worker-0:0,2")
+    assert out["worker-0"] == [0, 2]
+
+
+def test_exclude_filter():
+    pool = {"worker-0": 4, "worker-1": 4}
+    out = parse_resource_filter(pool, exclude_str="worker-1")
+    assert list(out.keys()) == ["worker-0"]
+
+
+def test_include_exclude_exclusive():
+    with pytest.raises(ValueError):
+        parse_resource_filter({"w": 1}, include_str="w", exclude_str="w")
+
+
+def test_unknown_host_raises():
+    with pytest.raises(ValueError):
+        parse_resource_filter({"w": 1}, include_str="nope")
+
+
+def test_world_info_roundtrip():
+    import base64
+    import json
+    pool = {"a": 2, "b": 4}
+    enc = encode_world_info(pool)
+    dec = json.loads(base64.urlsafe_b64decode(enc))
+    assert dec == {"a": [0, 1], "b": [0, 1, 2, 3]}

@@ -1,0 +1,85 @@
+"""1-bit Adam/LAMB (reference tests/unit/test_onebit.py): warmup equals
+exact Adam; post-freeze compression keeps training while the error
+feedback bounds the residual."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.simple import SimpleModel, sample_batch
+from deepspeed_tpu.runtime import optim as optim_lib
+from deepspeed_tpu.runtime.fp16.onebit.adam import _compress, onebit_adam
+from deepspeed_tpu.runtime.fp16.onebit.lamb import onebit_lamb
+
+
+def test_compress_error_feedback():
+    x = jax.random.normal(jax.random.PRNGKey(0), (1000,))
+    e = jnp.zeros_like(x)
+    c, e_new = _compress(x, e)
+    # 1-bit: two distinct magnitudes (±scale)
+    assert len(np.unique(np.abs(np.asarray(c)))) == 1
+    # residual identity: x + e = c + e_new
+    np.testing.assert_allclose(np.asarray(x + e), np.asarray(c + e_new),
+                               atol=1e-6)
+
+
+def test_onebit_adam_warmup_equals_adam():
+    params = {"w": jax.random.normal(jax.random.PRNGKey(1), (64, 8))}
+    grads = {"w": jax.random.normal(jax.random.PRNGKey(2), (64, 8))}
+    ob = onebit_adam(freeze_step=10)
+    ref = optim_lib.adam()
+    so, sr = ob.init(params), ref.init(params)
+    po = pr = params
+    for _ in range(5):  # still within warmup
+        uo, so = ob.update(grads, so, po, jnp.float32(1e-2))
+        ur, sr = ref.update(grads, sr, pr, jnp.float32(1e-2))
+        po = jax.tree.map(jnp.add, po, uo)
+        pr = jax.tree.map(jnp.add, pr, ur)
+    np.testing.assert_allclose(np.asarray(po["w"]), np.asarray(pr["w"]),
+                               rtol=1e-5, atol=1e-7)
+
+
+def test_onebit_adam_post_freeze_compresses():
+    params = {"w": jax.random.normal(jax.random.PRNGKey(3), (128,))}
+    grads = {"w": jax.random.normal(jax.random.PRNGKey(4), (128,))}
+    ob = onebit_adam(freeze_step=2)
+    s = ob.init(params)
+    p = params
+    for i in range(5):
+        u, s = ob.update(grads, s, p, jnp.float32(1e-2))
+        p = jax.tree.map(jnp.add, p, u)
+    # post-freeze momentum is sign-compressed: one magnitude
+    mags = np.unique(np.round(np.abs(np.asarray(s.mu["w"])), 8))
+    assert len(mags) == 1
+    # error buffer is active
+    assert float(jnp.abs(s.error["w"]).sum()) > 0
+
+
+@pytest.mark.parametrize("opt_type,freeze", [("OneBitAdam", 3),
+                                             ("OneBitLamb", 3)])
+def test_onebit_engine_trains_through_freeze(opt_type, freeze):
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=64, nlayers=2),
+        config={"train_batch_size": 8,
+                "optimizer": {"type": opt_type,
+                              "params": {"lr": 1e-2, "freeze_step": freeze}},
+                "zero_optimization": {"stage": 1}},
+        sample_batch=sample_batch(8, 64))
+    rng = np.random.default_rng(0)
+    batch = (rng.standard_normal((8, 64)).astype(np.float32),
+             rng.standard_normal((8, 64)).astype(np.float32))
+    losses = [float(engine.train_batch(batch=batch)) for _ in range(8)]
+    assert losses[-1] < losses[0], losses
+    assert np.isfinite(losses).all()
+
+
+def test_onebit_lamb_trust_ratio_bounded():
+    params = {"w": jax.random.normal(jax.random.PRNGKey(5), (64,)) * 10}
+    grads = {"w": jax.random.normal(jax.random.PRNGKey(6), (64,)) * 1e-4}
+    ob = onebit_lamb(freeze_step=1, min_coeff=0.01, max_coeff=10.0)
+    s = ob.init(params)
+    u, s = ob.update(grads, s, params, jnp.float32(1e-2))
+    # |update| <= lr * max_coeff * |u| — sanity: finite and bounded
+    assert np.isfinite(np.asarray(u["w"])).all()
