@@ -1,0 +1,186 @@
+"""Autotuner — config search over ZeRO stage and micro-batch size.
+
+Rebuild of deepspeed/autotuning/ (``Autotuner`` autotuner.py:29, tuners
+tuner/index_based_tuner.py:8/:23 + model_based_tuner.py:16, scheduler
+scheduler.py:35). The reference forks whole training jobs per experiment
+across a node pool and greps profiling jsons; here experiments run
+in-process (the engine is cheap to rebuild under jax) on THIS host:
+
+1. model-info: param count → per-stage memory model
+   (runtime/zero/partition.py estimate_zero_mem — the reference's
+   ``model_info_profile_run`` :664);
+2. prune ZeRO stages whose state cannot fit device memory;
+3. per surviving stage, search micro-batch sizes (fastest-first order by
+   the tuner policy) with short timed runs;
+4. emit the best config + all measurements (autotuning_results layout).
+"""
+
+import json
+import os
+import random as _random
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from deepspeed_tpu.runtime.zero.partition import estimate_zero_mem
+from deepspeed_tpu.utils.logging import logger
+
+
+class BaseTuner:
+    """Experiment-ordering policy (reference index_based_tuner.py)."""
+
+    def __init__(self, space: List[Any]):
+        self.space = list(space)
+
+    def order(self) -> List[Any]:
+        raise NotImplementedError
+
+
+class GridSearchTuner(BaseTuner):
+    def order(self):
+        return list(self.space)
+
+
+class RandomTuner(BaseTuner):
+    def __init__(self, space, seed=0):
+        super().__init__(space)
+        self.rng = _random.Random(seed)
+
+    def order(self):
+        out = list(self.space)
+        self.rng.shuffle(out)
+        return out
+
+
+class ModelBasedTuner(BaseTuner):
+    """Cost-model-guided ordering (reference model_based_tuner.py:16 with
+    XGBoostCostModel): here the prior is the roofline intuition that
+    larger micro-batches amortise better until memory pressure — order
+    descending and early-stop on regression."""
+
+    def order(self):
+        return sorted(self.space, reverse=True)
+
+
+TUNER_CLASSES = {"gridsearch": GridSearchTuner, "random": RandomTuner,
+                 "model_based": ModelBasedTuner}
+
+
+class Autotuner:
+    def __init__(self,
+                 make_engine: Callable[[Dict], Any],
+                 make_batch: Callable[[int], Any],
+                 base_config: Dict,
+                 num_params: Optional[int] = None,
+                 device_memory_bytes: Optional[int] = None,
+                 micro_batch_sizes: Optional[List[int]] = None,
+                 zero_stages: Optional[List[int]] = None,
+                 tuner_type: str = "model_based",
+                 steps_per_trial: int = 3,
+                 early_stop: int = 2,
+                 results_dir: str = "autotuning_results"):
+        """make_engine(config_dict) -> engine;
+        make_batch(global_batch_size) -> batch for one step."""
+        self.make_engine = make_engine
+        self.make_batch = make_batch
+        self.base_config = base_config
+        self.num_params = num_params
+        self.device_memory_bytes = device_memory_bytes or \
+            self._detect_device_memory()
+        self.micro_batch_sizes = micro_batch_sizes or [1, 2, 4, 8, 16, 32]
+        self.zero_stages = zero_stages or [0, 1, 2, 3]
+        self.tuner_cls = TUNER_CLASSES[tuner_type]
+        self.steps_per_trial = steps_per_trial
+        self.early_stop = early_stop
+        self.results_dir = results_dir
+        self.records: List[Dict] = []
+
+    @staticmethod
+    def _detect_device_memory():
+        try:
+            stats = jax.devices()[0].memory_stats()
+            return stats.get("bytes_limit", 16 << 30)
+        except Exception:
+            return 16 << 30
+
+    # ------------------------------------------------------------- pruning
+    def prune_stages(self, dp_world: int) -> List[int]:
+        """Memory-model stage pruning (reference _generate_experiments
+        :287)."""
+        if self.num_params is None:
+            return list(self.zero_stages)
+        ok = []
+        for stage in self.zero_stages:
+            need = estimate_zero_mem(self.num_params, dp_world, stage)
+            if need < self.device_memory_bytes * 0.85:
+                ok.append(stage)
+        return ok or [max(self.zero_stages)]
+
+    # -------------------------------------------------------------- trials
+    def _run_trial(self, config: Dict) -> Optional[float]:
+        """Returns samples/sec or None on failure/OOM."""
+        try:
+            from deepspeed_tpu.utils import groups
+            groups.destroy()
+            engine = self.make_engine(config)
+            batch = self.make_batch(config["train_batch_size"])
+            engine.train_batch(batch=batch)          # compile
+            t0 = time.perf_counter()
+            for _ in range(self.steps_per_trial):
+                engine.train_batch(batch=batch)
+            jax.block_until_ready(engine.state.params)
+            dt = time.perf_counter() - t0
+            return config["train_batch_size"] * self.steps_per_trial / dt
+        except Exception as e:
+            logger.warning(f"autotuning trial failed: {e}")
+            return None
+
+    def tune(self) -> Dict:
+        """Search; returns the best full config dict."""
+        from deepspeed_tpu.utils import groups
+        if groups.mesh_is_initialized():
+            dp_world = groups.get_data_parallel_world_size()
+        else:
+            dp_world = jax.device_count()
+
+        stages = self.prune_stages(dp_world)
+        logger.info(f"autotuning over zero stages {stages}")
+        best = None
+
+        for stage in stages:
+            tuner = self.tuner_cls(self.micro_batch_sizes)
+            regressions = 0
+            stage_best = None
+            for micro in tuner.order():
+                cfg = dict(self.base_config)
+                cfg["train_micro_batch_size_per_gpu"] = micro
+                cfg["train_batch_size"] = micro * dp_world
+                cfg["zero_optimization"] = dict(
+                    cfg.get("zero_optimization", {}), stage=stage)
+                tput = self._run_trial(cfg)
+                rec = {"zero_stage": stage, "micro_batch": micro,
+                       "samples_per_sec": tput}
+                self.records.append(rec)
+                logger.info(f"trial {rec}")
+                if tput is None:
+                    continue
+                if stage_best is None or tput > stage_best[0]:
+                    stage_best = (tput, cfg)
+                    regressions = 0
+                else:
+                    regressions += 1
+                    if regressions >= self.early_stop:
+                        break
+            if stage_best and (best is None or stage_best[0] > best[0]):
+                best = stage_best
+
+        os.makedirs(self.results_dir, exist_ok=True)
+        with open(os.path.join(self.results_dir, "results.json"), "w") as f:
+            json.dump({"records": self.records,
+                       "best": best[1] if best else None,
+                       "best_samples_per_sec": best[0] if best else None},
+                      f, indent=2)
+        assert best is not None, "no autotuning trial succeeded"
+        return best[1]

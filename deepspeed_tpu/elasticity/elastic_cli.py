@@ -1,0 +1,30 @@
+"""``ds_elastic`` CLI (reference bin/ds_elastic): preview elastic
+batch-size / chip-count compatibility for a config."""
+
+import argparse
+import json
+
+from deepspeed_tpu.elasticity.elasticity import compute_elastic_config
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-c", "--config", required=True,
+                        help="DeepSpeed config json with an elasticity block")
+    parser.add_argument("-w", "--world-size", type=int, default=0)
+    args = parser.parse_args()
+    with open(args.config) as f:
+        ds_config = json.load(f)
+    if args.world_size:
+        batch, gpus, micro = compute_elastic_config(
+            ds_config, world_size=args.world_size)
+        print(f"world size {args.world_size}: train_batch_size={batch}, "
+              f"micro_batch={micro}")
+    else:
+        batch, gpus = compute_elastic_config(ds_config)
+        print(f"train_batch_size={batch}")
+        print(f"valid chip counts: {gpus}")
+
+
+if __name__ == "__main__":
+    main()

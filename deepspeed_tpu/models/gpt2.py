@@ -48,6 +48,10 @@ class GPT2Config:
     use_flash: Optional[bool] = None   # None = auto (Pallas on TPU)
     pp_stages: int = 1                 # pipeline stages for the block stack
     pp_microbatches: int = 1           # GPipe microbatches when pp_stages>1
+    # sequence/context parallelism: "ring:<axis>" or "ulysses:<axis>"
+    # shards the SEQUENCE over the named mesh axis (SURVEY.md §5.7 — the
+    # modern long-context equivalent of the reference's sparse attention)
+    attention_mode: str = "auto"
     dtype: jnp.dtype = jnp.float32     # activation compute dtype is set by
                                        # the engine via param cast; this is
                                        # only for explicitly built models
@@ -89,7 +93,16 @@ class CausalSelfAttention(nn.Module):
         q = q.reshape(B, S, H, D).transpose(0, 2, 1, 3)
         k = k.reshape(B, S, H, D).transpose(0, 2, 1, 3)
         v = v.reshape(B, S, H, D).transpose(0, 2, 1, 3)
-        out = attention(q, k, v, causal=True, use_flash=cfg.use_flash)
+        if cfg.attention_mode.startswith(("ring:", "ulysses:")):
+            from deepspeed_tpu.ops.transformer.ring import (
+                ring_attention, ulysses_attention)
+            from deepspeed_tpu.utils import groups
+            kind, axis = cfg.attention_mode.split(":")
+            fn = ring_attention if kind == "ring" else ulysses_attention
+            out = fn(q, k, v, groups.get_mesh(), axis, causal=True,
+                     use_flash=cfg.use_flash)
+        else:
+            out = attention(q, k, v, causal=True, use_flash=cfg.use_flash)
         out = out.transpose(0, 2, 1, 3).reshape(B, S, E)
         out = nn.Dense(E, name="proj",
                        kernel_init=nn.initializers.normal(

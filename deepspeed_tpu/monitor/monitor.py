@@ -1,0 +1,69 @@
+"""Training telemetry — TensorBoard + CSV writers.
+
+Rebuild of the reference's rank-0 TensorBoard wiring
+(engine.get_summary_writer engine.py:510; scalar writes :1686/:1911-1939/
+_write_tensorboard :2011). A CSV fallback keeps telemetry alive on hosts
+without the tensorboard package.
+"""
+
+import csv
+import os
+from typing import Optional
+
+
+class TensorBoardMonitor:
+    def __init__(self, output_path="runs/", job_name="DeepSpeedJobName"):
+        from torch.utils.tensorboard import SummaryWriter
+        os.makedirs(output_path, exist_ok=True)
+        self.writer = SummaryWriter(log_dir=os.path.join(output_path,
+                                                         job_name))
+
+    def write_scalar(self, name, value, step):
+        self.writer.add_scalar(name, value, step)
+
+    def flush(self):
+        self.writer.flush()
+
+
+class CSVMonitor:
+    def __init__(self, output_path="runs/", job_name="DeepSpeedJobName"):
+        os.makedirs(output_path, exist_ok=True)
+        self.path = os.path.join(output_path, f"{job_name}.csv")
+        self._file = open(self.path, "a", newline="")
+        self._writer = csv.writer(self._file)
+        if self._file.tell() == 0:
+            self._writer.writerow(["step", "name", "value"])
+
+    def write_scalar(self, name, value, step):
+        self._writer.writerow([step, name, float(value)])
+
+    def flush(self):
+        self._file.flush()
+
+
+class MonitorMaster:
+    """Fans scalars out to every enabled backend (rank 0 only)."""
+
+    def __init__(self, tensorboard_config=None, rank=0):
+        self.monitors = []
+        self.enabled = rank == 0
+        if not self.enabled:
+            return
+        if tensorboard_config is not None and tensorboard_config.enabled:
+            path = tensorboard_config.output_path or "runs/"
+            job = tensorboard_config.job_name or "DeepSpeedJobName"
+            try:
+                self.monitors.append(TensorBoardMonitor(path, job))
+            except Exception:
+                self.monitors.append(CSVMonitor(path, job))
+
+    def write_events(self, event_list, flush=True):
+        """event_list: [(name, value, step), ...] — reference signature."""
+        if not self.enabled:
+            return
+        for name, value, step in event_list:
+            for m in self.monitors:
+                m.write_scalar(name, value, step)
+        if flush:
+            for m in self.monitors:
+                m.flush()

@@ -1,0 +1,160 @@
+"""Sequence / context parallelism: ring attention and Ulysses.
+
+The reference snapshot's long-sequence story is block-sparse attention
+only (SURVEY.md §5.7 — ring attention and DeepSpeed-Ulysses arrive in
+later versions); this module builds both as first-class TPU citizens so
+the framework covers the scale the lineage grows into:
+
+* :func:`ring_attention` — the sequence dim is sharded over a mesh axis;
+  K/V chunks rotate around the ring via ``lax.ppermute`` (ICI
+  neighbour-to-neighbour, bandwidth-optimal) while each device's Q stays
+  resident. Per-chunk partial results merge by the online-softmax rule
+  using each chunk's log-sum-exp, so the math is EXACTLY full attention.
+  Causal runs skip chunks entirely above the diagonal via their -inf lse.
+* :func:`ulysses_attention` — DeepSpeed-Ulysses: ``all_to_all`` swaps the
+  sharded dim from sequence to heads, full-sequence flash attention runs
+  per head group, and a second all-to-all swaps back. Requires
+  num_heads % axis_size == 0.
+
+Both are pure collectives + the Pallas flash kernel, differentiable end to
+end (ppermute/all_to_all transpose to themselves under AD).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.ops.transformer import flash
+from deepspeed_tpu.ops.transformer.attention import mha_reference
+
+
+def _attend_with_lse(q, k, v, causal, sm_scale, use_flash):
+    """(out, lse) — lse is [B, H, Sq] fp32."""
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    if use_flash:
+        out, (_, _, _, _, lse) = flash._flash_fwd(q, k, v, causal, sm_scale)
+        B, H, S, _ = q.shape
+        return out, lse[:, :, 0].reshape(B, H, S)
+    # jnp fallback (CPU tests): replicate the flash math
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * sm_scale
+    if causal:
+        sq, sk = q.shape[2], k.shape[2]
+        cm = (jnp.arange(sk)[None, :] <=
+              jnp.arange(sq)[:, None] + (sk - sq))
+        logits = jnp.where(cm[None, None], logits, flash.NEG_INF)
+    m = jnp.max(logits, axis=-1)
+    p = jnp.exp(logits - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    out = jnp.einsum("bhqk,bhkd->bhqd", (p / l_safe[..., None]).astype(
+        v.dtype), v)
+    return out, m + jnp.log(l_safe)
+
+
+def _merge(o1, lse1, o2, lse2):
+    """Online-softmax merge of two partial attention results."""
+    m = jnp.maximum(lse1, lse2)
+    w1 = jnp.exp(lse1 - m)
+    w2 = jnp.exp(lse2 - m)
+    denom = w1 + w2
+    denom = jnp.where(denom == 0.0, 1.0, denom)
+    out = (o1.astype(jnp.float32) * w1[..., None] +
+           o2.astype(jnp.float32) * w2[..., None]) / denom[..., None]
+    return out.astype(o1.dtype), m + jnp.log(denom)
+
+
+def _ring_attention_local(q, k, v, axis_name, causal, sm_scale, use_flash):
+    """Per-device body (inside shard_map): q,k,v are the LOCAL seq chunk
+    [B, H, S_local, D]."""
+    axis_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def step(s, carry):
+        o_acc, lse_acc, kc, vc = carry
+        src = (my_idx - s) % axis_size           # owner of current kv chunk
+
+        # chunk relation under causal: src < me → full, == me → causal
+        # diagonal, src > me → skipped (lse = -inf zeroes its weight)
+        o_s, lse_s = _attend_with_lse(q, kc, vc, False, sm_scale, use_flash)
+        if causal:
+            o_diag, lse_diag = _attend_with_lse(q, kc, vc, True, sm_scale,
+                                                use_flash)
+            is_diag = src == my_idx
+            skip = src > my_idx
+            o_s = jnp.where(is_diag, o_diag, o_s)
+            lse_s = jnp.where(is_diag, lse_diag, lse_s)
+            lse_s = jnp.where(skip, flash.NEG_INF, lse_s)
+
+        o_acc, lse_acc = _merge(o_acc, lse_acc, o_s, lse_s)
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        return o_acc, lse_acc, kc, vc
+
+    B, H, S, D = q.shape
+    o0 = jnp.zeros((B, H, S, D), q.dtype)
+    lse0 = jnp.full((B, H, S), flash.NEG_INF, jnp.float32)
+    o, lse, _, _ = jax.lax.fori_loop(0, axis_size, step, (o0, lse0, k, v))
+    return o
+
+
+def ring_attention(q, k, v, mesh: Mesh, axis_name: str, causal=True,
+                   sm_scale=None, use_flash=None):
+    """Exact attention over a sequence sharded on ``axis_name``.
+
+    q,k,v: GLOBAL [B, H, S, D] arrays (sharded or not — shard_map splits
+    the seq dim over the axis). Returns the global [B, H, S, D] output
+    with the same sharding."""
+    if use_flash is None:
+        use_flash = jax.default_backend() == "tpu"
+    spec = P(None, None, axis_name, None)
+    fn = functools.partial(_ring_attention_local, axis_name=axis_name,
+                           causal=causal, sm_scale=sm_scale,
+                           use_flash=use_flash)
+    return jax.shard_map(
+        lambda q, k, v: fn(q, k, v),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)(q, k, v)
+
+
+def _ulysses_local(q, k, v, axis_name, causal, sm_scale, use_flash):
+    """Inside shard_map: [B, H, S_local, D] per device; all-to-all to
+    [B, H_local, S, D], attend, all-to-all back."""
+    # split heads across the axis, gather sequence
+    def a2a_fwd(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    def a2a_bwd(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    qh, kh, vh = a2a_fwd(q), a2a_fwd(k), a2a_fwd(v)
+    if use_flash:
+        out = flash.flash_attention(qh, kh, vh, causal, sm_scale)
+    else:
+        out = mha_reference(qh, kh, vh, causal=causal, sm_scale=sm_scale)
+    return a2a_bwd(out)
+
+
+def ulysses_attention(q, k, v, mesh: Mesh, axis_name: str, causal=True,
+                      sm_scale=None, use_flash=None):
+    """DeepSpeed-Ulysses sequence parallelism: all-to-all seq↔heads."""
+    if use_flash is None:
+        use_flash = jax.default_backend() == "tpu"
+    H = q.shape[1]
+    axis_size = mesh.shape[axis_name]
+    assert H % axis_size == 0, (
+        f"ulysses needs heads ({H}) divisible by axis size ({axis_size})")
+    spec = P(None, None, axis_name, None)
+    fn = functools.partial(_ulysses_local, axis_name=axis_name,
+                           causal=causal, sm_scale=sm_scale,
+                           use_flash=use_flash)
+    return jax.shard_map(
+        lambda q, k, v: fn(q, k, v),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)(q, k, v)

@@ -96,6 +96,7 @@ class DeepSpeedEngine:
                  loss_fn=None,
                  sample_batch=None,
                  mp_rules=None,
+                 batch_spec=None,
                  dont_change_device=False,
                  seed=42):
         import deepspeed_tpu.comm as dist
@@ -109,6 +110,10 @@ class DeepSpeedEngine:
         self.training_data = training_data
         self.collate_fn = collate_fn
         self.mpu = mpu
+        # batch PartitionSpec override — sequence-parallel runs shard the
+        # SEQ dim of the batch over a mesh axis instead of the batch dim
+        # (ops/transformer/ring.py)
+        self._batch_spec = batch_spec
         self.global_steps = 0
         self.global_samples = 0
         self.micro_steps = 0
@@ -203,6 +208,12 @@ class DeepSpeedEngine:
         self.training_dataloader = None
         if training_data is not None:
             self.training_dataloader = self.deepspeed_io(training_data)
+
+        # ---- monitor (reference tensorboard wiring, engine.py:510) --------
+        from deepspeed_tpu.monitor.monitor import MonitorMaster
+        import deepspeed_tpu.comm as _dist
+        self.monitor = MonitorMaster(self.config.tensorboard,
+                                     rank=_dist.get_rank())
 
         # ---- timers -------------------------------------------------------
         self.timers = SynchronizedWallClockTimer()
@@ -423,6 +434,9 @@ class DeepSpeedEngine:
 
     # -------------------------------------------------------- compiled steps
     def _batch_sharding(self, batch):
+        if self._batch_spec is not None:
+            return jax.tree.map(
+                lambda _: NamedSharding(self.mesh, self._batch_spec), batch)
         dp_axes = tuple(a for a in groups.data_parallel_axes()
                         if self.mesh.shape[a] > 1)
         spec = P(dp_axes) if dp_axes else P()
@@ -653,6 +667,15 @@ class DeepSpeedEngine:
         if self.global_steps % self.steps_per_print() == 0:
             log_dist(f"step={self.global_steps} loss={float(mean_loss):.6f} "
                      f"lr={self.get_lr()[0]:.3e}", ranks=[0])
+        if self.monitor.enabled and self.monitor.monitors:
+            # reference scalar names (engine.py:1686/:1911)
+            self.monitor.write_events([
+                ("Train/Samples/train_loss", float(mean_loss),
+                 self.global_samples),
+                ("Train/Samples/lr", self.get_lr()[0], self.global_samples),
+                ("Train/Samples/loss_scale", self.loss_scale,
+                 self.global_samples),
+            ])
         return mean_loss
 
     def eval_batch(self, batch):

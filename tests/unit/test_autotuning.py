@@ -1,0 +1,83 @@
+"""Autotuner (reference test_autotuning.py intent) + monitor."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.autotuning.autotuner import (Autotuner, GridSearchTuner,
+                                                ModelBasedTuner, RandomTuner)
+from deepspeed_tpu.models.simple import SimpleModel, sample_batch
+
+
+def test_tuner_orderings():
+    assert GridSearchTuner([1, 2, 4]).order() == [1, 2, 4]
+    assert ModelBasedTuner([1, 4, 2]).order() == [4, 2, 1]
+    assert sorted(RandomTuner([1, 2, 4]).order()) == [1, 2, 4]
+
+
+def test_stage_pruning():
+    at = Autotuner(make_engine=None, make_batch=None, base_config={},
+                   num_params=10_000_000_000,     # 10B params
+                   device_memory_bytes=16 << 30)  # 16 GB
+    stages = at.prune_stages(dp_world=8)
+    # 10B params can't fit stage 0/1 in 16GB; stage 3 must survive
+    assert 0 not in stages and 3 in stages
+
+
+def test_autotune_end_to_end(tmp_path):
+    def make_engine(cfg):
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=SimpleModel(hidden_dim=64, nlayers=2), config=cfg,
+            sample_batch=sample_batch(cfg["train_batch_size"], 64))
+        return engine
+
+    def make_batch(bs):
+        rng = np.random.default_rng(0)
+        return (rng.standard_normal((bs, 64)).astype(np.float32),
+                rng.standard_normal((bs, 64)).astype(np.float32))
+
+    at = Autotuner(
+        make_engine, make_batch,
+        base_config={"optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                     "steps_per_print": 10 ** 9},
+        micro_batch_sizes=[1, 2], zero_stages=[0, 1],
+        steps_per_trial=2, results_dir=str(tmp_path / "results"))
+    best = at.tune()
+    assert best["train_micro_batch_size_per_gpu"] in (1, 2)
+    assert best["zero_optimization"]["stage"] in (0, 1)
+    with open(tmp_path / "results" / "results.json") as f:
+        results = json.load(f)
+    assert results["best_samples_per_sec"] > 0
+    assert len(results["records"]) >= 2
+
+
+def test_monitor_csv(tmp_path):
+    from deepspeed_tpu.monitor.monitor import CSVMonitor, MonitorMaster
+    mon = CSVMonitor(str(tmp_path), "job")
+    mon.write_scalar("loss", 1.5, 1)
+    mon.write_scalar("loss", 1.2, 2)
+    mon.flush()
+    lines = open(mon.path).read().strip().splitlines()
+    assert len(lines) == 3  # header + 2
+
+
+def test_engine_tensorboard_integration(tmp_path):
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=64, nlayers=1),
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                "tensorboard": {"enabled": True,
+                                "output_path": str(tmp_path / "tb"),
+                                "job_name": "t"}},
+        sample_batch=sample_batch(8, 64))
+    rng = np.random.default_rng(0)
+    batch = (rng.standard_normal((8, 64)).astype(np.float32),
+             rng.standard_normal((8, 64)).astype(np.float32))
+    engine.train_batch(batch=batch)
+    assert engine.monitor.monitors  # a backend is attached
+    # events flushed to disk (tb event file or csv)
+    files = [str(p) for p in (tmp_path / "tb").rglob("*")]
+    assert any(os.path.isfile(f) for f in files)
