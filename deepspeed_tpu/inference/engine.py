@@ -55,11 +55,13 @@ class InferenceEngine:
         with self.mesh:
             self.params = jax.device_put(params, shardings)
 
+        self._user_apply = apply_fn
         self._apply = apply_fn or (
             lambda p, batch: self.module.apply(
                 p if isinstance(p, dict) and "params" in p else {"params": p},
                 batch))
         self._jit_forward = jax.jit(self._apply)
+        self._gen_cache = {}  # (temperature, eos) -> compiled decode loop
         log_dist(f"InferenceEngine ready: mp={mp_size} "
                  f"dtype={self.dtype.__name__}", ranks=[0])
 
@@ -77,22 +79,126 @@ class InferenceEngine:
 
     __call__ = forward
 
+    def _call_params(self):
+        """Parameter names of the wrapped module's __call__."""
+        import inspect
+        try:
+            return inspect.signature(type(self.module).__call__).parameters
+        except (TypeError, ValueError):
+            return {}
+
+    def _supports_kv_cache(self) -> bool:
+        """True when the wrapped module takes the ``decode`` kwarg (the
+        flax cache-collection protocol models/gpt2.py implements)."""
+        return "decode" in self._call_params()
+
     def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
-                 logits_fn=None, rng=None, eos_token_id=None):
+                 logits_fn=None, rng=None, eos_token_id=None,
+                 use_cache=None):
         """Greedy / sampled decoding (reference forward :301 loop).
 
-        ``logits_fn(params, ids) -> [B, S, V]`` defaults to the module
-        apply on a dict batch (GPT2LMHeadModel convention needs
-        ``labels=None`` → logits path is model-specific, so LM models
-        should pass logits_fn)."""
-        logits_fn = logits_fn or (
-            lambda p, ids: self._apply(p, {"input_ids": ids}))
+        Default path is KV-cache decoding (the `softmax_context_*` surface
+        of csrc/transformer/inference/csrc/pt_binding.cpp:829): one prefill
+        pass writes the prompt's K/V into the model's flax "cache"
+        collection, then each generated token is ONE single-token forward —
+        per-token cost independent of how many tokens were generated.
+        Models without cache support (no ``decode`` kwarg, or a custom
+        ``logits_fn``) fall back to full-sequence recompute per token."""
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        if use_cache is None:
+            # a user apply_fn wraps module.apply in unknown ways (extra
+            # collections/rngs), so the bare-apply cache path can't be used
+            use_cache = (logits_fn is None and self._user_apply is None
+                         and self._supports_kv_cache())
+        if use_cache:
+            return self._generate_cached(input_ids, max_new_tokens,
+                                         temperature, rng, eos_token_id)
+        return self._generate_recompute(input_ids, max_new_tokens,
+                                        temperature, logits_fn, rng,
+                                        eos_token_id)
+
+    def _wrap(self, p):
+        return p if isinstance(p, dict) and "params" in p else {"params": p}
+
+    def _sample(self, last, rng, temperature):
+        if temperature > 0:
+            return jax.random.categorical(rng, last / temperature, axis=-1
+                                          ).astype(jnp.int32)
+        return jnp.argmax(last, axis=-1).astype(jnp.int32)
+
+    def _generate_cached(self, input_ids, max_new_tokens, temperature, rng,
+                         eos_token_id):
+        S = input_ids.shape[1]
+        cfg = getattr(self.module, "config", None)
+        max_pos = getattr(cfg, "n_positions", None)
+        if max_pos is not None and S + max_new_tokens > max_pos:
+            # dynamic_update_slice CLAMPS out-of-range indices, so an
+            # overfull cache would silently overwrite the last slot
+            raise ValueError(
+                f"prompt ({S}) + max_new_tokens ({max_new_tokens}) exceeds "
+                f"the model's n_positions ({max_pos})")
+        loop = self._gen_cache.get((temperature, eos_token_id))
+        if loop is None:
+            loop = self._build_cached_loop(temperature, eos_token_id)
+            self._gen_cache[(temperature, eos_token_id)] = loop
+        with self.mesh:
+            new = loop(self.params, input_ids, rng, max_new_tokens)
+        return jnp.concatenate([input_ids, new], axis=1)
+
+    def _build_cached_loop(self, temperature, eos_token_id):
+        """One compiled decode loop: prefill + (max_new-1)-step scan.
+        jit caches on (shapes, max_new), so repeat generate() calls with
+        the same shapes skip compilation entirely."""
+        import functools
+        module = self.module
+
+        @functools.partial(jax.jit, static_argnums=(3,))
+        def run(params, input_ids, rng, max_new_tokens):
+            wrapped = self._wrap(params)
+            logits, variables = module.apply(
+                wrapped, {"input_ids": input_ids}, decode=True,
+                mutable=["cache"])
+            rng, sub = jax.random.split(rng)
+            first = self._sample(logits[:, -1], sub, temperature)
+
+            def step(carry, _):
+                tok, cache, rng, done = carry
+                logits, variables = module.apply(
+                    {**wrapped, "cache": cache},
+                    {"input_ids": tok[:, None]}, decode=True,
+                    mutable=["cache"])
+                rng, sub = jax.random.split(rng)
+                nxt = self._sample(logits[:, -1], sub, temperature)
+                if eos_token_id is not None:
+                    done = done | (tok == eos_token_id)
+                    nxt = jnp.where(done, eos_token_id, nxt)
+                return (nxt, variables["cache"], rng, done), nxt
+
+            if max_new_tokens == 1:
+                return first[:, None]
+            done = jnp.zeros((input_ids.shape[0],), bool)
+            _, rest = jax.lax.scan(step, (first, variables["cache"], rng,
+                                          done),
+                                   None, length=max_new_tokens - 1)
+            return jnp.concatenate([first[:, None], rest.T], axis=1)
+
+        return run
+
+    def _generate_recompute(self, input_ids, max_new_tokens, temperature,
+                            logits_fn, rng, eos_token_id):
+        if logits_fn is None:
+            if self._user_apply is None and \
+                    "return_logits" in self._call_params():
+                logits_fn = lambda p, ids: self.module.apply(  # noqa: E731
+                    self._wrap(p), {"input_ids": ids}, return_logits=True)
+            else:
+                logits_fn = lambda p, ids: self._apply(  # noqa: E731
+                    p, {"input_ids": ids})
         B, S = input_ids.shape
         total = S + max_new_tokens
         ids = jnp.zeros((B, total), jnp.int32)
         ids = ids.at[:, :S].set(input_ids)
-        if rng is None:
-            rng = jax.random.PRNGKey(0)
 
         def step(carry, t):
             ids, rng = carry
@@ -101,11 +207,12 @@ class InferenceEngine:
             last = jnp.take_along_axis(
                 logits, (t - 1)[None, None, None].repeat(B, 0), axis=1)[:, 0]
             rng, sub = jax.random.split(rng)
-            if temperature > 0:
-                nxt = jax.random.categorical(sub, last / temperature, axis=-1)
-            else:
-                nxt = jnp.argmax(last, axis=-1)
-            ids = ids.at[:, t].set(nxt.astype(jnp.int32))
+            nxt = self._sample(last, sub, temperature)
+            if eos_token_id is not None:
+                prev_done = (t > S) & (ids[:, jnp.maximum(t - 1, 0)]
+                                       == eos_token_id)
+                nxt = jnp.where(prev_done, eos_token_id, nxt)
+            ids = ids.at[:, t].set(nxt)
             return (ids, rng), None
 
         with self.mesh:

@@ -83,7 +83,7 @@ class CausalSelfAttention(nn.Module):
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, x, deterministic=True):
+    def __call__(self, x, deterministic=True, decode=False):
         cfg = self.config
         B, S, E = x.shape
         H, D = cfg.n_head, E // cfg.n_head
@@ -93,7 +93,41 @@ class CausalSelfAttention(nn.Module):
         q = q.reshape(B, S, H, D).transpose(0, 2, 1, 3)
         k = k.reshape(B, S, H, D).transpose(0, 2, 1, 3)
         v = v.reshape(B, S, H, D).transpose(0, 2, 1, 3)
-        if cfg.attention_mode.startswith(("ring:", "ulysses:")):
+        if decode:
+            # KV-cache path (reference: softmax_context_* KV-cache attention,
+            # csrc/transformer/inference/csrc/pt_binding.cpp:829; the cache
+            # itself replaces the global workspace of inference context.h).
+            # First call = prefill (cache vars absent): allocate [B,H,T,D]
+            # caches, write the prompt's K/V, run normal causal flash.
+            # Later calls = one-token steps: append at cache_index, run the
+            # decode kernel over the live prefix.
+            from deepspeed_tpu.ops.transformer.decode import decode_attention
+            is_step = self.has_variable("cache", "cached_key")
+            T = cfg.n_positions
+            ck = self.variable("cache", "cached_key", jnp.zeros,
+                               (B, H, T, D), k.dtype)
+            cv = self.variable("cache", "cached_value", jnp.zeros,
+                               (B, H, T, D), v.dtype)
+            ci = self.variable("cache", "cache_index",
+                               lambda: jnp.zeros((), jnp.int32))
+            if not is_step:
+                ck.value = jax.lax.dynamic_update_slice(ck.value, k,
+                                                        (0, 0, 0, 0))
+                cv.value = jax.lax.dynamic_update_slice(cv.value, v,
+                                                        (0, 0, 0, 0))
+                ci.value = jnp.asarray(S, jnp.int32)
+                out = attention(q, k, v, causal=True, use_flash=cfg.use_flash)
+            else:
+                assert S == 1, f"decode steps take one token, got {S}"
+                idx = ci.value
+                ck.value = jax.lax.dynamic_update_slice(ck.value, k,
+                                                        (0, 0, idx, 0))
+                cv.value = jax.lax.dynamic_update_slice(cv.value, v,
+                                                        (0, 0, idx, 0))
+                ci.value = idx + 1
+                out = decode_attention(q, ck.value, cv.value, idx + 1,
+                                       use_flash=cfg.use_flash)
+        elif cfg.attention_mode.startswith(("ring:", "ulysses:")):
             from deepspeed_tpu.ops.transformer.ring import (
                 ring_attention, ulysses_attention)
             from deepspeed_tpu.utils import groups
@@ -134,9 +168,9 @@ class Block(nn.Module):
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, x, deterministic=True):
+    def __call__(self, x, deterministic=True, decode=False):
         x = x + CausalSelfAttention(self.config, name="attn")(
-            nn.LayerNorm(epsilon=1e-5, name="ln_1")(x), deterministic)
+            nn.LayerNorm(epsilon=1e-5, name="ln_1")(x), deterministic, decode)
         x = x + MLP(self.config, name="mlp")(
             nn.LayerNorm(epsilon=1e-5, name="ln_2")(x), deterministic)
         return x
@@ -158,7 +192,8 @@ class GPT2LMHeadModel(nn.Module):
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, batch, deterministic: Optional[bool] = None):
+    def __call__(self, batch, deterministic: Optional[bool] = None,
+                 decode: bool = False, return_logits: bool = False):
         cfg = self.config
         if isinstance(batch, (tuple, list)):
             input_ids, labels = batch[0], (batch[1] if len(batch) > 1 else None)
@@ -173,7 +208,24 @@ class GPT2LMHeadModel(nn.Module):
                          (cfg.padded_vocab, cfg.n_embd))
         wpe = self.param("wpe", nn.initializers.normal(0.01),
                          (cfg.n_positions, cfg.n_embd))
-        x = wte[input_ids] + wpe[None, :S].astype(wte.dtype)
+        if decode:
+            assert cfg.pp_stages == 1, "KV-cache decode incompatible with pp"
+            assert not cfg.attention_mode.startswith(("ring:", "ulysses:")), \
+                "KV-cache decode incompatible with sequence parallelism"
+            return_logits = True
+            is_step = self.has_variable("cache", "pos_index")
+            pi = self.variable("cache", "pos_index",
+                               lambda: jnp.zeros((), jnp.int32))
+            if not is_step:
+                pos_emb = wpe[None, :S]
+                pi.value = jnp.asarray(S, jnp.int32)
+            else:
+                pos_emb = jax.lax.dynamic_slice(
+                    wpe, (pi.value, 0), (S, cfg.n_embd))[None]
+                pi.value = pi.value + S
+            x = wte[input_ids] + pos_emb.astype(wte.dtype)
+        else:
+            x = wte[input_ids] + wpe[None, :S].astype(wte.dtype)
         if cfg.dropout > 0:
             x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
 
@@ -194,14 +246,16 @@ class GPT2LMHeadModel(nn.Module):
         else:
             block = Block
             if cfg.remat:
-                block = nn.remat(Block, static_argnums=(2,))
+                block = nn.remat(Block, static_argnums=(2, 3))
             for i in range(cfg.n_layer):
-                x = block(cfg, name=f"h_{i}")(x, deterministic)
+                x = block(cfg, name=f"h_{i}")(x, deterministic, decode)
         x = nn.LayerNorm(epsilon=1e-5, name="ln_f")(x)
 
         # tied LM head; fp32 logits for a stable softmax
         logits = jnp.einsum("bse,ve->bsv", x, wte,
                             preferred_element_type=jnp.float32)
+        if return_logits:
+            return logits
 
         if labels is None:
             shift_labels = input_ids[:, 1:]

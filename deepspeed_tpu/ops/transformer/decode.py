@@ -1,0 +1,127 @@
+"""Decode attention over a KV cache — the generative-inference hot op.
+
+TPU-native equivalent of the reference's fused KV-cache attention
+(`softmax_context_*` in csrc/transformer/inference/csrc/pt_binding.cpp:829
+and the attention core of csrc/transformer/inference/csrc/softmax.cu): one
+query token per sequence attends to a linear KV cache of valid length
+``cache_len``. The reference hand-manages a global KV workspace
+(inference/includes/context.h); here the cache is a pair of [B, H, T, D]
+jax arrays owned by the model's flax "cache" collection, and this kernel
+only reads them.
+
+Design notes (TPU):
+* grid over B*H; the single query row is replicated to an (8, D) tile so
+  the score GEMM is MXU/VPU tile-aligned (one wasted factor of 8 on a
+  bandwidth-bound op — the kernel streams K/V once, which is the actual
+  cost at decode time).
+* ``cache_len`` arrives in SMEM; the kv loop runs ``cdiv(len, block_k)``
+  iterations, so per-token work scales with the *live* cache length, not
+  the allocated cache size.
+* off-TPU the mathematically identical masked jnp path runs (also the
+  parity oracle in tests/unit/test_inference.py).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from deepspeed_tpu.ops.transformer.attention import mha_reference
+
+try:  # pltpu imports on TPU-enabled jaxlibs; interpret mode needs no TPU
+    from jax.experimental.pallas import tpu as pltpu
+    _SMEM = pltpu.SMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _SMEM = None
+
+NEG_INF = -1e30
+QROWS = 8  # sublane tile height; the 1 live query row is replicated into it
+
+
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, sm_scale,
+                   block_k):
+    length = len_ref[0]
+    q = q_ref[0]  # [QROWS, D]
+
+    def body(j, carry):
+        acc, m, l = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :]
+        v = v_ref[0, pl.ds(j * block_k, block_k), :]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        cols = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (QROWS, block_k), 1)
+        s = jnp.where(cols < length, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=1)
+        acc = acc * alpha[:, None] + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc, m_new, l_new
+
+    d = q.shape[-1]
+    acc = jnp.zeros((QROWS, d), jnp.float32)
+    m = jnp.full((QROWS,), NEG_INF, jnp.float32)
+    l = jnp.zeros((QROWS,), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, pl.cdiv(length, block_k), body,
+                                  (acc, m, l))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+
+
+def _pick_block(seq, target=512):
+    b = min(seq, target)
+    while seq % b:
+        b //= 2
+    return max(b, 1)
+
+
+@functools.lru_cache(maxsize=None)
+def _flash_available():
+    return jax.default_backend() == "tpu" and pltpu is not None
+
+
+def decode_attention(q, k_cache, v_cache, cache_len, *, sm_scale=None,
+                     use_flash=None):
+    """softmax(q·K[:len]ᵀ)·V[:len] for one decode step.
+
+    q: [B, H, 1, D]; k_cache/v_cache: [B, H, T, D] (T = allocated cache);
+    cache_len: scalar int32, number of valid cache positions (the current
+    token's K/V must already be written). Returns [B, H, 1, D].
+    """
+    B, H, Sq, D = q.shape
+    assert Sq == 1, f"decode_attention takes one query token, got {Sq}"
+    T = k_cache.shape[2]
+    if sm_scale is None:
+        sm_scale = D ** -0.5
+    if use_flash is None:
+        use_flash = _flash_available()
+    if not use_flash:
+        mask = (jnp.arange(T) < cache_len)[None, None, None, :]
+        return mha_reference(q, k_cache, v_cache, causal=False,
+                             sm_scale=sm_scale, mask=mask)
+
+    block_k = _pick_block(T)
+    qf = jnp.broadcast_to(q.reshape(B * H, 1, D), (B * H, QROWS, D))
+    kf = k_cache.reshape(B * H, T, D)
+    vf = v_cache.reshape(B * H, T, D)
+    len_arr = jnp.asarray(cache_len, jnp.int32).reshape(1)
+
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, sm_scale=sm_scale, block_k=block_k),
+        grid=(B * H,),
+        in_specs=[
+            pl.BlockSpec(memory_space=_SMEM),
+            pl.BlockSpec((1, QROWS, D), lambda b: (b, 0, 0)),
+            pl.BlockSpec((1, T, D), lambda b: (b, 0, 0)),
+            pl.BlockSpec((1, T, D), lambda b: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, QROWS, D), lambda b: (b, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, QROWS, D), q.dtype),
+        interpret=jax.default_backend() != "tpu",
+    )(len_arr, qf, kf, vf)
+    return out[:, :1, :].reshape(B, H, 1, D)
