@@ -8,6 +8,8 @@ module surface (``ops``, ``moe``, ``pipe`` via runtime, ``zero``).
 __version__ = "0.1.0"
 version = __version__
 
+import jax.numpy as jnp
+
 from deepspeed_tpu.runtime.config import DeepSpeedConfig, DeepSpeedConfigError
 from deepspeed_tpu.runtime.engine import DeepSpeedEngine
 from deepspeed_tpu.runtime import lr_schedules
@@ -19,6 +21,23 @@ import deepspeed_tpu.comm as comm
 def init_distributed(dist_backend="xla", **kwargs):
     """Reference: deepspeed.init_distributed (utils/distributed.py:12)."""
     comm.init_distributed(dist_backend=dist_backend, **kwargs)
+
+
+def _as_config_dict(config):
+    """Raw dict view of a config given as dict, JSON/hjson path, or
+    DeepSpeedConfig (for pre-engine dispatch decisions)."""
+    if isinstance(config, dict):
+        return config
+    if isinstance(config, str):
+        import json
+        try:
+            with open(config) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+    if isinstance(config, DeepSpeedConfig):
+        return getattr(config, "_param_dict", None)
+    return None
 
 
 def initialize(args=None,
@@ -42,6 +61,48 @@ def initialize(args=None,
     ``loss_fn``, ``sample_batch`` (for shape init), ``mp_rules``
     (megatron-style tensor-parallel sharding rules).
     """
+    # ZeRO-3 parameter offload takes the layered host-loop engine (the
+    # zero.Init remote_device=cpu/nvme path, partition_parameters.py:701):
+    # params never fully materialise on device, so the monolithic-jit
+    # DeepSpeedEngine cannot express it. ``model`` must then be a sequence
+    # of flax layers (LayerSpec decomposition).
+    _cfg_dict = _as_config_dict(config if config is not None else config_params)
+    if _cfg_dict is not None:
+        _off = (_cfg_dict.get("zero_optimization", {})
+                .get("offload_param", {}) or {})
+        if _off.get("device") in ("cpu", "nvme"):
+            from deepspeed_tpu.runtime.zero.param_offload import \
+                Zero3OffloadEngine
+            assert isinstance(model, (list, tuple)), (
+                "offload_param requires a layered model: pass model as a "
+                "sequence of flax modules (body layers x->x, final layer "
+                "(x, batch)->loss)")
+            assert "sample_batch" in kwargs, (
+                "offload_param requires sample_batch= for shape init")
+            assert optimizer is None and lr_scheduler is None and \
+                training_data is None, (
+                    "offload_param drives its own host CPU-Adam; client "
+                    "optimizer/lr_scheduler/training_data are unsupported")
+            opt_params = (_cfg_dict.get("optimizer", {}) or {}
+                          ).get("params", {})
+            if (_cfg_dict.get("bf16", {}) or {}).get("enabled"):
+                _dtype = jnp.bfloat16
+            elif (_cfg_dict.get("fp16", {}) or {}).get("enabled"):
+                _dtype = jnp.float16
+            else:
+                _dtype = jnp.float32
+            engine = Zero3OffloadEngine(
+                model, kwargs["sample_batch"],
+                lr=opt_params.get("lr", 1e-3),
+                betas=tuple(opt_params.get("betas", (0.9, 0.999))),
+                eps=opt_params.get("eps", 1e-8),
+                weight_decay=opt_params.get("weight_decay", 0.0),
+                nvme_path=(_off.get("nvme_path")
+                           if _off.get("device") == "nvme" else None),
+                compute_dtype=_dtype,
+                input_fn=kwargs.get("input_fn"))
+            return engine, None, None, None
+
     assert model is not None, "deepspeed_tpu.initialize: model is required"
 
     engine = DeepSpeedEngine(args=args,

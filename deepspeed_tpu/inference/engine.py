@@ -106,6 +106,7 @@ class InferenceEngine:
         ``logits_fn``) fall back to full-sequence recompute per token."""
         if rng is None:
             rng = jax.random.PRNGKey(0)
+        temperature = float(temperature)  # hashable compiled-loop cache key
         if use_cache is None:
             # a user apply_fn wraps module.apply in unknown ways (extra
             # collections/rngs), so the bare-apply cache path can't be used
@@ -140,6 +141,8 @@ class InferenceEngine:
                 f"the model's n_positions ({max_pos})")
         loop = self._gen_cache.get((temperature, eos_token_id))
         if loop is None:
+            if len(self._gen_cache) >= 32:  # bound compiled-program leak
+                self._gen_cache.clear()
             loop = self._build_cached_loop(temperature, eos_token_id)
             self._gen_cache[(temperature, eos_token_id)] = loop
         with self.mesh:

@@ -73,18 +73,6 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, sm_scale,
     o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
 
 
-def _pick_block(seq, target=512):
-    b = min(seq, target)
-    while seq % b:
-        b //= 2
-    return max(b, 1)
-
-
-@functools.lru_cache(maxsize=None)
-def _flash_available():
-    return jax.default_backend() == "tpu" and pltpu is not None
-
-
 def decode_attention(q, k_cache, v_cache, cache_len, *, sm_scale=None,
                      use_flash=None):
     """softmax(q·K[:len]ᵀ)·V[:len] for one decode step.
@@ -99,16 +87,25 @@ def decode_attention(q, k_cache, v_cache, cache_len, *, sm_scale=None,
     if sm_scale is None:
         sm_scale = D ** -0.5
     if use_flash is None:
+        from deepspeed_tpu.ops.transformer.attention import _flash_available
         use_flash = _flash_available()
     if not use_flash:
         mask = (jnp.arange(T) < cache_len)[None, None, None, :]
         return mha_reference(q, k_cache, v_cache, causal=False,
                              sm_scale=sm_scale, mask=mask)
 
-    block_k = _pick_block(T)
+    # pad the cache dim to a block multiple rather than shrinking the
+    # block (a tiny divisor of an odd T would serialise the kv loop);
+    # padded columns sit beyond cache_len, so the mask already kills them
+    block_k = min(T, 512)
+    Tp = -(-T // block_k) * block_k
+    if Tp != T:
+        pad = [(0, 0), (0, 0), (0, Tp - T), (0, 0)]
+        k_cache = jnp.pad(k_cache, pad)
+        v_cache = jnp.pad(v_cache, pad)
     qf = jnp.broadcast_to(q.reshape(B * H, 1, D), (B * H, QROWS, D))
-    kf = k_cache.reshape(B * H, T, D)
-    vf = v_cache.reshape(B * H, T, D)
+    kf = k_cache.reshape(B * H, Tp, D)
+    vf = v_cache.reshape(B * H, Tp, D)
     len_arr = jnp.asarray(cache_len, jnp.int32).reshape(1)
 
     out = pl.pallas_call(
@@ -117,8 +114,8 @@ def decode_attention(q, k_cache, v_cache, cache_len, *, sm_scale=None,
         in_specs=[
             pl.BlockSpec(memory_space=_SMEM),
             pl.BlockSpec((1, QROWS, D), lambda b: (b, 0, 0)),
-            pl.BlockSpec((1, T, D), lambda b: (b, 0, 0)),
-            pl.BlockSpec((1, T, D), lambda b: (b, 0, 0)),
+            pl.BlockSpec((1, Tp, D), lambda b: (b, 0, 0)),
+            pl.BlockSpec((1, Tp, D), lambda b: (b, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, QROWS, D), lambda b: (b, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, QROWS, D), q.dtype),

@@ -39,6 +39,10 @@ class ReduceTiedGrads(PipeInstruction):
 
 
 class BufferOpInstruction(PipeInstruction):
+    """Instruction on a pipeline ring-buffer slot. ``buffer_id`` is the
+    slot (micro_batch_id % num_pipe_buffers — reference schedule.py:105);
+    ``micro_batch_id`` identifies the data (LoadMicroBatch needs it)."""
+
     def __init__(self, buffer_id, **kwargs):
         super().__init__(buffer_id=buffer_id, **kwargs)
 
@@ -91,6 +95,12 @@ class PipeSchedule:
     def _valid_micro_batch(self, micro_batch_id):
         return 0 <= micro_batch_id < self.micro_batches
 
+    def _buffer_idx(self, micro_batch_id):
+        """Ring-buffer slot for a micro-batch (reference schedule.py:105):
+        executors allocate only num_pipe_buffers() buffers, so ids wrap."""
+        assert self._valid_micro_batch(micro_batch_id)
+        return micro_batch_id % self.num_pipe_buffers()
+
     def _valid_stage(self, stage_id):
         return 0 <= stage_id < self.stages
 
@@ -129,14 +139,18 @@ class InferenceSchedule(PipeSchedule):
             micro_batch_id = step_id - self.stage_id
 
             if self._valid_micro_batch(micro_batch_id):
+                buf = self._buffer_idx(micro_batch_id)
                 if self.is_first_stage or self.is_last_stage:
-                    cmds.append(LoadMicroBatch(micro_batch_id))
+                    cmds.append(LoadMicroBatch(buf,
+                                               micro_batch_id=micro_batch_id))
                 if self._valid_stage(self.prev_stage) and \
                         self._valid_micro_batch(micro_batch_id):
-                    cmds.append(RecvActivation(micro_batch_id))
-                cmds.append(ForwardPass(micro_batch_id))
+                    cmds.append(RecvActivation(buf,
+                                               micro_batch_id=micro_batch_id))
+                cmds.append(ForwardPass(buf, micro_batch_id=micro_batch_id))
                 if self._valid_stage(self.next_stage):
-                    cmds.append(SendActivation(micro_batch_id))
+                    cmds.append(SendActivation(buf,
+                                               micro_batch_id=micro_batch_id))
             yield cmds
 
     def num_pipe_buffers(self):
@@ -155,28 +169,50 @@ class TrainSchedule(PipeSchedule):
             micro_batch_id, is_forward = self._step_to_micro_batch(step_id)
 
             cmds = []
-            # Exchange activations/grads with neighbours
-            if self._valid_micro_batch(prev_micro_batch_id) and \
-                    self._valid_stage(self.next_stage):
-                if is_forward:
-                    cmds.append(RecvGrad(prev_micro_batch_id))
-                else:
-                    cmds.append(SendActivation(prev_micro_batch_id))
-            if self._valid_micro_batch(micro_batch_id) and \
-                    self._valid_stage(self.prev_stage):
-                if is_forward:
-                    cmds.append(RecvActivation(micro_batch_id))
-                else:
-                    cmds.append(SendGrad(micro_batch_id))
+            # Exchange activations/grads with neighbours (reference
+            # ordering, schedule.py:205-219: on a FORWARD step the
+            # previous backward's input-grad is sent downstream; on a
+            # BACKWARD step the previous forward's output goes up and the
+            # current micro-batch's output-grad is received)
+            if is_forward:
+                if self._valid_micro_batch(micro_batch_id) and \
+                        self._valid_stage(self.prev_stage):
+                    cmds.append(RecvActivation(
+                        self._buffer_idx(micro_batch_id),
+                        micro_batch_id=micro_batch_id))
+                if self._valid_micro_batch(prev_micro_batch_id) and \
+                        self._valid_stage(self.prev_stage):
+                    cmds.append(SendGrad(
+                        self._buffer_idx(prev_micro_batch_id),
+                        micro_batch_id=prev_micro_batch_id))
+            else:
+                if self._valid_micro_batch(prev_micro_batch_id) and \
+                        self._valid_stage(self.next_stage):
+                    cmds.append(SendActivation(
+                        self._buffer_idx(prev_micro_batch_id),
+                        micro_batch_id=prev_micro_batch_id))
+                if self._valid_micro_batch(micro_batch_id) and \
+                        self._valid_stage(self.next_stage):
+                    cmds.append(RecvGrad(
+                        self._buffer_idx(micro_batch_id),
+                        micro_batch_id=micro_batch_id))
+
+            # First/last stage loads (reference :222)
+            if self.is_first_stage or self.is_last_stage:
+                if is_forward and self._valid_micro_batch(micro_batch_id):
+                    cmds.append(LoadMicroBatch(
+                        self._buffer_idx(micro_batch_id),
+                        micro_batch_id=micro_batch_id))
 
             # Computation
             if self._valid_micro_batch(micro_batch_id):
+                buf = self._buffer_idx(micro_batch_id)
                 if is_forward:
-                    if self.is_first_stage or self.is_last_stage:
-                        cmds.append(LoadMicroBatch(micro_batch_id))
-                    cmds.append(ForwardPass(micro_batch_id))
+                    cmds.append(ForwardPass(buf,
+                                            micro_batch_id=micro_batch_id))
                 else:
-                    cmds.append(BackwardPass(micro_batch_id))
+                    cmds.append(BackwardPass(buf,
+                                             micro_batch_id=micro_batch_id))
 
             # Model step at the end of the batch
             if step_id == total_steps - 1:

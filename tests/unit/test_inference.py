@@ -53,6 +53,23 @@ def test_decode_attention_matches_masked_dense(use_flash):
                                    rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.parametrize("T", [63, 100, 1023])
+def test_decode_attention_odd_cache_sizes(T):
+    """Non-power-of-two allocated caches must stay block-efficient (the
+    kernel pads to a block multiple instead of shrinking the block)."""
+    rng = np.random.default_rng(4)
+    B, H, D = 1, 2, 32
+    q = jnp.asarray(rng.standard_normal((B, H, 1, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, H, T, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, H, T, D)), jnp.float32)
+    for length in (1, T // 2, T):
+        got = decode_attention(q, k, v, length, use_flash=True)
+        mask = (jnp.arange(T) < length)[None, None, None, :]
+        want = mha_reference(q, k, v, causal=False, mask=mask)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+
 def test_decode_attention_cache_len_is_traced():
     """cache_len must be a dynamic value (no recompile per step)."""
     rng = np.random.default_rng(2)

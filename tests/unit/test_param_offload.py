@@ -1,0 +1,138 @@
+"""ZeRO-3 parameter offload (runtime/zero/param_offload.py).
+
+The reference capability under test: training a model whose parameters do
+not fit device memory by keeping them host-resident (CPU/NVMe) and
+streaming one layer at a time (partition_parameters.py:701 remote_device +
+partitioned_param_swapper.py:36). The budget assertion checks the device
+never holds more than ~2 layers of a deep stack; the oracle assertion
+checks the streamed training matches a monolithic pure-JAX Adam run.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from flax import linen as nn
+
+import deepspeed_tpu
+from deepspeed_tpu.runtime.zero.param_offload import Zero3OffloadEngine
+
+HID = 64
+NLAYERS = 8
+
+
+class _Body(nn.Module):
+    hidden: int = HID
+
+    @nn.compact
+    def __call__(self, x):
+        return nn.relu(nn.Dense(self.hidden)(x))
+
+
+class _Head(nn.Module):
+    hidden: int = HID
+
+    @nn.compact
+    def __call__(self, x, batch):
+        return jnp.mean((nn.Dense(self.hidden)(x) - batch[1]) ** 2)
+
+
+def _layers():
+    return [_Body() for _ in range(NLAYERS)] + [_Head()]
+
+
+def _batch(seed=0, bs=16):
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal((bs, HID)).astype(np.float32),
+            rng.standard_normal((bs, HID)).astype(np.float32))
+
+
+def test_device_budget_and_training(tmp_path):
+    eng = Zero3OffloadEngine(_layers(), _batch(), lr=1e-2, seed=0)
+    losses = [float(eng.train_batch(_batch(s))) for s in range(8)]
+    assert losses[-1] < losses[0]
+    st = eng.store
+    # device never held more than ~2 of the 9 layers simultaneously
+    assert st.peak_live_bytes * 3 < st.total_param_bytes, (
+        st.peak_live_bytes, st.total_param_bytes)
+    assert st.live_bytes == 0  # everything released after the step
+
+
+def test_matches_monolithic_adam_oracle():
+    layers = _layers()
+    eng = Zero3OffloadEngine(layers, _batch(), lr=1e-3, seed=3)
+
+    # clone the engine's initial masters into a monolithic param list
+    params0 = [
+        jax.tree.unflatten(eng.store.treedefs[i],
+                           [jnp.asarray(h) for h in eng.store.host_leaves(i)])
+        for i in range(len(layers))
+    ]
+
+    def loss_fn(plist, batch):
+        x = batch[0]
+        for i, m in enumerate(layers[:-1]):
+            x = m.apply({"params": plist[i]}, x)
+        return layers[-1].apply({"params": plist[-1]}, x, batch)
+
+    opt = optax.adam(1e-3)
+    opt_state = opt.init(params0)
+    params = params0
+    oracle, streamed = [], []
+    for s in range(5):
+        b = _batch(s + 10)
+        loss, g = jax.value_and_grad(loss_fn)(params, b)
+        upd, opt_state = opt.update(g, opt_state)
+        params = optax.apply_updates(params, upd)
+        oracle.append(float(loss))
+        streamed.append(float(eng.train_batch(b)))
+    np.testing.assert_allclose(streamed, oracle, rtol=2e-4, atol=2e-5)
+
+
+def test_nvme_mode_matches_ram_mode(tmp_path):
+    ram = Zero3OffloadEngine(_layers(), _batch(), lr=1e-2, seed=1)
+    nvme = Zero3OffloadEngine(_layers(), _batch(), lr=1e-2, seed=1,
+                              nvme_path=str(tmp_path))
+    for s in range(4):
+        b = _batch(s + 20)
+        lr_, ln_ = float(ram.train_batch(b)), float(nvme.train_batch(b))
+        np.testing.assert_allclose(ln_, lr_, rtol=1e-6)
+
+
+def test_checkpoint_roundtrip():
+    eng = Zero3OffloadEngine(_layers(), _batch(), lr=1e-2, seed=2)
+    for s in range(3):
+        eng.train_batch(_batch(s))
+    sd = eng.state_dict()
+    cont = [float(eng.train_batch(_batch(s + 50))) for s in range(3)]
+
+    fresh = Zero3OffloadEngine(_layers(), _batch(), lr=1e-2, seed=99)
+    fresh.load_state_dict(sd)
+    resumed = [float(fresh.train_batch(_batch(s + 50))) for s in range(3)]
+    np.testing.assert_allclose(resumed, cont, rtol=1e-6)
+
+
+def test_initialize_dispatches_offload_param(tmp_path):
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=_layers(),
+        config={"train_batch_size": 16,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                "zero_optimization": {
+                    "stage": 3,
+                    "offload_param": {"device": "cpu"}}},
+        sample_batch=_batch())
+    assert isinstance(engine, Zero3OffloadEngine)
+    l0 = float(engine.train_batch(_batch(1)))
+    l1 = float(engine.train_batch(_batch(1)))
+    assert l1 < l0
+
+
+def test_initialize_offload_param_requires_layers():
+    with pytest.raises(AssertionError, match="layered"):
+        deepspeed_tpu.initialize(
+            model=_Body(),
+            config={"train_batch_size": 16,
+                    "zero_optimization": {
+                        "stage": 3, "offload_param": {"device": "cpu"}}},
+            sample_batch=_batch())
