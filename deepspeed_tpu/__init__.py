@@ -68,8 +68,10 @@ def initialize(args=None,
     # of flax layers (LayerSpec decomposition).
     _cfg_dict = _as_config_dict(config if config is not None else config_params)
     if _cfg_dict is not None:
-        _off = (_cfg_dict.get("zero_optimization", {})
-                .get("offload_param", {}) or {})
+        _zo = _cfg_dict.get("zero_optimization", {}) or {}
+        _off = dict(_zo.get("offload_param", {}) or {})
+        if _zo.get("cpu_offload_params") and not _off.get("device"):
+            _off["device"] = "cpu"  # deprecated spelling (zero/config.py:121)
         if _off.get("device") in ("cpu", "nvme"):
             from deepspeed_tpu.runtime.zero.param_offload import \
                 Zero3OffloadEngine
@@ -83,8 +85,15 @@ def initialize(args=None,
                 training_data is None, (
                     "offload_param drives its own host CPU-Adam; client "
                     "optimizer/lr_scheduler/training_data are unsupported")
-            opt_params = (_cfg_dict.get("optimizer", {}) or {}
-                          ).get("params", {})
+            _opt_cfg = _cfg_dict.get("optimizer", {}) or {}
+            _opt_name = str(_opt_cfg.get("type", "Adam")).lower()
+            assert _opt_name in ("adam", "adamw"), (
+                f"offload_param drives the host CPU-Adam; optimizer type "
+                f"{_opt_cfg.get('type')!r} is unsupported on this path")
+            if _off["device"] == "nvme":
+                assert _off.get("nvme_path"), (
+                    "offload_param.device='nvme' requires nvme_path")
+            opt_params = _opt_cfg.get("params", {})
             if (_cfg_dict.get("bf16", {}) or {}).get("enabled"):
                 _dtype = jnp.bfloat16
             elif (_cfg_dict.get("fp16", {}) or {}).get("enabled"):

@@ -101,9 +101,12 @@ class CausalSelfAttention(nn.Module):
             # caches, write the prompt's K/V, run normal causal flash.
             # Later calls = one-token steps: append at cache_index, run the
             # decode kernel over the live prefix.
-            from deepspeed_tpu.ops.transformer.decode import decode_attention
+            from deepspeed_tpu.ops.transformer.decode import (
+                aligned_cache_len, decode_attention)
             is_step = self.has_variable("cache", "cached_key")
-            T = cfg.n_positions
+            # block-aligned allocation: avoids a whole-cache pad copy per
+            # decode step inside decode_attention
+            T = aligned_cache_len(cfg.n_positions)
             ck = self.variable("cache", "cached_key", jnp.zeros,
                                (B, H, T, D), k.dtype)
             cv = self.variable("cache", "cached_value", jnp.zeros,

@@ -38,6 +38,16 @@ except Exception:  # pragma: no cover
 
 NEG_INF = -1e30
 QROWS = 8  # sublane tile height; the 1 live query row is replicated into it
+BLOCK_K = 512  # kv tile length (sublane dim of the K/V blocks)
+
+
+def aligned_cache_len(n_positions: int) -> int:
+    """Cache allocation size that avoids the per-step pad copy in
+    decode_attention: a BLOCK_K multiple when larger than one block, else
+    a 16-multiple (one whole block of any sublane-tileable size)."""
+    if n_positions > BLOCK_K:
+        return -(-n_positions // BLOCK_K) * BLOCK_K
+    return -(-n_positions // 16) * 16
 
 
 def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, sm_scale,
@@ -96,8 +106,11 @@ def decode_attention(q, k_cache, v_cache, cache_len, *, sm_scale=None,
 
     # pad the cache dim to a block multiple rather than shrinking the
     # block (a tiny divisor of an odd T would serialise the kv loop);
-    # padded columns sit beyond cache_len, so the mask already kills them
-    block_k = min(T, 512)
+    # padded columns sit beyond cache_len, so the mask already kills them.
+    # This copies the whole cache — callers on the hot path should allocate
+    # aligned_cache_len(T) so Tp == T and the pad is a no-op (the model's
+    # flax cache does; see models/gpt2.py).
+    block_k = min(T, BLOCK_K)
     Tp = -(-T // block_k) * block_k
     if Tp != T:
         pad = [(0, 0), (0, 0), (0, Tp - T), (0, 0)]

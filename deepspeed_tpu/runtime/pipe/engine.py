@@ -29,7 +29,6 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-import optax
 
 from deepspeed_tpu.runtime.pipe import schedule as sched_mod
 from deepspeed_tpu.runtime.pipe.module import (LayerSpec, PipelineModule,
@@ -189,13 +188,20 @@ class PipelineEngine:
                         src, self.stages[s].device)
                     self.stages[s].params = p
 
-        self.opt = optax.chain(
-            optax.add_decayed_weights(weight_decay) if weight_decay
-            else optax.identity(),
-            optax.adam(lr, b1=betas[0], b2=betas[1], eps=eps))
+        # the repo's own Adam (runtime/optim.py) so weight_decay keeps the
+        # decoupled-AdamW semantics every other engine uses
+        from deepspeed_tpu.runtime import optim as optim_lib
+        self.lr = lr
+        self.opt = optim_lib.adam(b1=betas[0], b2=betas[1], eps=eps,
+                                  weight_decay=weight_decay,
+                                  adam_w_mode=True)
         self.opt_states = [self.opt.init(st.params) for st in self.stages]
-        self._opt_update = jax.jit(self.opt.update)
-        self._opt_apply = jax.jit(optax.apply_updates)
+
+        def opt_step(grads, opt_state, params, lr_val):
+            updates, new_state = self.opt.update(grads, opt_state, params,
+                                                 lr_val)
+            return jax.tree.map(jnp.add, params, updates), new_state
+        self._opt_step = jax.jit(opt_step)
         log_dist(f"PipelineEngine(1F1B host loop): stages={self.S} "
                  f"microbatches={self.M} parts={pipe_module.parts} "
                  f"tied={list(self._tied)}", ranks=[0])
@@ -330,9 +336,9 @@ class PipelineEngine:
 
         # optimizer step per stage
         for s, st in enumerate(self.stages):
-            upd, self.opt_states[s] = self._opt_update(
-                grad_accum[s], self.opt_states[s], st.params)
-            st.params = self._opt_apply(st.params, upd)
+            st.params, self.opt_states[s] = self._opt_step(
+                grad_accum[s], self.opt_states[s], st.params,
+                jnp.float32(self.lr))
         self.global_steps += 1
         return jnp.mean(jnp.stack(losses))
 

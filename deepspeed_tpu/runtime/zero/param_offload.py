@@ -54,12 +54,13 @@ class HostParamStore:
         self._ram: List[Optional[List[np.ndarray]]] = []
         self.treedefs: List[Any] = []
         self.swapper = None
+        self._swap_folder = None
         if nvme_path is not None:
             from deepspeed_tpu.runtime.swap_tensor.swapper import \
                 AsyncTensorSwapper
-            folder = swap_folder or os.path.join(
+            self._swap_folder = swap_folder or os.path.join(
                 nvme_path, f"ds_param_offload_{os.getpid()}")
-            self.swapper = AsyncTensorSwapper(folder)
+            self.swapper = AsyncTensorSwapper(self._swap_folder)
         # device residency accounting (tests assert peak << total)
         self.live_bytes = 0
         self.peak_live_bytes = 0
@@ -100,6 +101,22 @@ class HostParamStore:
         for j, h in enumerate(leaves):
             self.swapper.swap_out(f"L{i}_p{j}", h)
         self.swapper.synchronize()
+
+    def close(self):
+        """Delete this run's NVMe swap files (masters are full model size —
+        leaking them across runs fills the device)."""
+        if self.swapper is None or self._swap_folder is None:
+            return
+        self.swapper.synchronize()
+        import shutil
+        shutil.rmtree(self._swap_folder, ignore_errors=True)
+        self.swapper = None
+
+    def __del__(self):  # pragma: no cover — best-effort cleanup
+        try:
+            self.close()
+        except Exception:
+            pass
 
     # ----------------------------------------------------------- device side
     def fetch(self, i: int, dtype) -> Any:
@@ -272,7 +289,6 @@ class _HostAdam:
         try:
             from deepspeed_tpu.ops.op_builder.builder import CPUAdamBuilder
             if CPUAdamBuilder().is_compatible():
-                import itertools
                 from deepspeed_tpu.ops.adam import cpu_adam as _ca
                 self.lib = CPUAdamBuilder().load()
                 self.opt_id = next(_ca._ids)

@@ -1,0 +1,38 @@
+"""Regenerate the committed golden loss curves.
+
+Run from the repo root:  python tests/model/make_baselines.py
+The curves are environment-pinned artifacts (like the reference's stored
+Megatron-GPT2 baselines); regenerate only when the oracle or the tiny
+model definition intentionally changes, and say so in the commit.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def main():
+    import jax
+    jax.config.update("jax_platforms", "cpu")  # baselines are CPU-pinned
+    from tests.model import oracle
+
+    out = {
+        "config": {"model": oracle.TINY, "batch_size": oracle.BATCH_SIZE,
+                   "seq_len": oracle.SEQ_LEN, "lr": oracle.LR,
+                   "seed": oracle.SEED, "optimizer": "adam(0.9,0.999,1e-8)",
+                   "platform": "cpu-fp32"},
+        "losses": oracle.golden_curve(steps=20),
+    }
+    path = os.path.join(os.path.dirname(__file__), "baselines",
+                        "gpt2_tiny_fp32_adam.json")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(f"wrote {path}: first={out['losses'][0]:.6f} "
+          f"last={out['losses'][-1]:.6f}")
+
+
+if __name__ == "__main__":
+    main()

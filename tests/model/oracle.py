@@ -1,0 +1,68 @@
+"""Pure-JAX training oracle for the loss-curve parity harness.
+
+The analogue of the reference's model-level baselines
+(tests/model/Megatron_GPT2/run_func_test.py: real runs compared against
+committed loss curves). This oracle deliberately re-implements the
+training math from scratch — model init via flax, Adam written out by
+hand, no imports from deepspeed_tpu.runtime — so a systematic engine bug
+(wrong bias correction, wrong grad averaging, wrong loss scaling) shows up
+as a curve deviation instead of cancelling out.
+
+Determinism: params from ``PRNGKey(seed)`` (the engine uses the same key
+for its ``model.init``), batches from ``synthetic_batch(..., seed=step)``.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.gpt2 import (GPT2Config, GPT2LMHeadModel,
+                                       synthetic_batch)
+
+TINY = dict(vocab_size=512, n_positions=128, n_embd=64, n_layer=2, n_head=4)
+BATCH_SIZE = 8
+SEQ_LEN = 32
+LR = 1e-3
+SEED = 0
+
+
+def make_batches(steps, batch_size=BATCH_SIZE, seq_len=SEQ_LEN,
+                 vocab=TINY["vocab_size"]):
+    return [synthetic_batch(batch_size, seq_len, vocab, seed=1000 + s)
+            for s in range(steps)]
+
+
+def golden_curve(steps=20, lr=LR, seed=SEED, b1=0.9, b2=0.999, eps=1e-8):
+    """fp32 Adam training curve on the tiny GPT-2; returns python floats."""
+    cfg = GPT2Config(**TINY)
+    model = GPT2LMHeadModel(cfg)
+    batches = make_batches(steps)
+    params = model.init(jax.random.PRNGKey(seed), batches[0])["params"]
+
+    def loss_fn(p, batch):
+        return model.apply({"params": p}, batch)
+
+    # hand-rolled Adam (decoupled-wd form with wd=0 == classic Adam);
+    # step incremented before correction, eps outside the sqrt — the
+    # FusedAdam convention the engine claims (csrc/adam/multi_tensor_adam.cu)
+    mu = jax.tree.map(jnp.zeros_like, params)
+    nu = jax.tree.map(jnp.zeros_like, params)
+
+    @jax.jit
+    def train_step(params, mu, nu, step, batch):
+        loss, g = jax.value_and_grad(loss_fn)(params, batch)
+        step = step + 1
+        mu = jax.tree.map(lambda m, gg: b1 * m + (1 - b1) * gg, mu, g)
+        nu = jax.tree.map(lambda v, gg: b2 * v + (1 - b2) * gg * gg, nu, g)
+        c1 = 1.0 - b1 ** step.astype(jnp.float32)
+        c2 = 1.0 - b2 ** step.astype(jnp.float32)
+        params = jax.tree.map(
+            lambda p, m, v: p - lr * (m / c1) / (jnp.sqrt(v / c2) + eps),
+            params, mu, nu)
+        return params, mu, nu, step, loss
+
+    step = jnp.zeros([], jnp.int32)
+    losses = []
+    for batch in batches:
+        params, mu, nu, step, loss = train_step(params, mu, nu, step, batch)
+        losses.append(float(loss))
+    return losses
