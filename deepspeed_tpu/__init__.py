@@ -70,8 +70,12 @@ def initialize(args=None,
     if _cfg_dict is not None:
         _zo = _cfg_dict.get("zero_optimization", {}) or {}
         _off = dict(_zo.get("offload_param", {}) or {})
-        if _zo.get("cpu_offload_params") and not _off.get("device"):
-            _off["device"] = "cpu"  # deprecated spelling (zero/config.py:121)
+        if _zo.get("cpu_offload_params") and not _off.get("device") and \
+                _zo.get("stage") == 3:
+            # deprecated spelling (zero/config.py:121); param offload only
+            # exists at stage 3 — stage<2 configs carrying the flag keep
+            # their historical no-op behavior
+            _off["device"] = "cpu"
         if _off.get("device") in ("cpu", "nvme"):
             from deepspeed_tpu.runtime.zero.param_offload import \
                 Zero3OffloadEngine

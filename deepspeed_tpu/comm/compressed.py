@@ -1,0 +1,158 @@
+"""Compressed (1-bit) allreduce — a real collective, not just algorithm
+parity.
+
+Rebuild of the reference's error-compensated compressed allreduce
+(deepspeed/runtime/comm/nccl.py:47 ``compressed_allreduce``; MPI variant
+comm/mpi.py:170): each rank contributes sign bits (packed 8/byte into
+uint8) plus ONE fp32 scale per tensor, cutting bytes-on-wire ~16x vs an
+fp32 allreduce. Two-stage error feedback (worker + server) keeps the
+quantisation error from accumulating — the 1-bit Adam convergence result.
+
+TPU-native shape: the function runs INSIDE ``shard_map`` over a mesh axis.
+The reference's cupy bit-packing + ``dist.all_to_all_single`` +
+``dist.all_gather`` become jnp bit algebra + ``lax.all_to_all`` +
+``lax.all_gather`` lowering to ICI/DCN collectives. The reference's
+"server" (each rank reducing its own chunk) is the all_to_all row split.
+
+Wire format per rank and tensor: ``numel/8`` uint8 sign bytes (all_to_all)
++ 1 fp32 worker scale (all_gather) out; ``numel/(8*size)`` uint8 server
+sign bytes + 1 fp32 server scale broadcast back (all_gather). Exact-fp32
+wire cost would be ``4*numel`` in + ``4*numel`` out.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+_BIT_WEIGHTS = np.array([128, 64, 32, 16, 8, 4, 2, 1], np.uint8)
+
+
+def pack_signs(positive):
+    """bool [M] (M % 8 == 0) -> uint8 [M/8]; bit 7 first (cupy.packbits)."""
+    b = positive.reshape(-1, 8).astype(jnp.uint8)
+    return (b * jnp.asarray(_BIT_WEIGHTS)).sum(axis=-1).astype(jnp.uint8)
+
+
+def unpack_signs(packed):
+    """uint8 [K] -> float ±1 [K*8]."""
+    shifts = jnp.arange(7, -1, -1, dtype=jnp.uint8)
+    bits = (packed[:, None] >> shifts) & jnp.uint8(1)
+    return (bits.astype(jnp.float32) * 2.0 - 1.0).reshape(-1)
+
+
+def padded_numel(numel: int, world: int) -> int:
+    """Error buffers are allocated at this size (reference pads buffer_m up
+    to worker_error.numel(), nccl.py:60-65): divisible by 8*world so sign
+    bytes chunk evenly across ranks."""
+    q = 8 * world
+    return -(-numel // q) * q
+
+
+def compressed_allreduce(x, worker_error, server_error, axis_name):
+    """Mean-allreduce of ``x`` over ``axis_name`` in 1-bit precision.
+
+    Must run inside shard_map/pjit with ``axis_name`` bound. ``x`` is this
+    rank's flat fp32 tensor [N]; ``worker_error`` [P] and ``server_error``
+    [P / world] carry the error feedback (P = padded_numel(N, world)).
+    Returns (result [N], new_worker_error, new_server_error).
+    """
+    world = lax.psum(1, axis_name)
+    n = x.shape[0]
+    p = worker_error.shape[0]
+    chunk = p // world
+    assert server_error.shape[0] == chunk, (server_error.shape, chunk)
+
+    buf = jnp.zeros((p,), jnp.float32).at[:n].set(x.astype(jnp.float32))
+    buf = buf + worker_error
+    # RMS scale (reference worker_scale = norm/sqrt(numel), nccl.py:66)
+    worker_scale = jnp.linalg.norm(buf) / jnp.sqrt(p)
+    positive = buf >= 0  # sign(0) -> +1, the reference's bool trick
+    signs = jnp.where(positive, 1.0, -1.0)
+    new_worker_error = buf - worker_scale * signs
+
+    # phase 1: sign bytes all_to_all (each rank collects chunk r of every
+    # rank), scale allgather — nccl.py:96-104
+    packed = pack_signs(positive).reshape(world, chunk // 8)
+    recv = lax.all_to_all(packed, axis_name, split_axis=0, concat_axis=0,
+                          tiled=False)
+    scales = lax.all_gather(worker_scale, axis_name)            # [world]
+
+    # server stage: mean of the ranks' ±1 chunks weighted by their scales,
+    # plus server error feedback — nccl.py:110-126
+    vals = jax.vmap(unpack_signs)(recv)                         # [world, chunk]
+    server_m = (vals * scales[:, None]).mean(axis=0) + server_error
+    server_scale = jnp.linalg.norm(server_m) / jnp.sqrt(chunk)
+    s_positive = server_m >= 0
+    s_signs = jnp.where(s_positive, 1.0, -1.0)
+    new_server_error = server_m - server_scale * s_signs
+
+    # phase 2: server sign bytes + scale allgather back — nccl.py:131-142
+    s_packed = pack_signs(s_positive)                           # [chunk/8]
+    all_packed = lax.all_gather(s_packed, axis_name)            # [world, ..]
+    all_scales = lax.all_gather(server_scale, axis_name)        # [world]
+    parts = jax.vmap(unpack_signs)(all_packed)                  # [world, chunk]
+    result = (parts * all_scales[:, None]).reshape(-1)[:n]
+    return result.astype(x.dtype), new_worker_error, new_server_error
+
+
+def make_compressed_allreduce(mesh, axis_name="data"):
+    """shard_map-wrapped entry point: takes REPLICATED-per-rank inputs
+    where dim 0 is the rank dim ([world, ...] stacked local tensors) and
+    runs the collective over ``axis_name``.
+
+    The host-facing analogue of NcclBackend.compressed_allreduce: use it
+    when per-rank values genuinely differ (local momenta). Inside a pjit
+    train step, call :func:`compressed_allreduce` directly under
+    shard_map.
+    """
+    from jax.sharding import PartitionSpec as P
+    try:
+        from jax import shard_map
+    except ImportError:  # pre-0.8 jax
+        from jax.experimental.shard_map import shard_map
+
+    spec = P(axis_name)
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=(spec, spec, spec))
+    def run(x, we, se):
+        out, we2, se2 = compressed_allreduce(
+            x[0], we[0], se[0], axis_name)
+        return out[None], we2[None], se2[None]
+
+    return run
+
+
+def collective_wire_bytes(fn, *args):
+    """Sum of operand bytes entering collective primitives of ``fn(*args)``
+    — the measured bytes-on-wire of one call (used by tests to verify the
+    compression actually shrinks traffic)."""
+    jaxpr = jax.make_jaxpr(fn)(*args)
+    total = 0
+    coll = {"all_to_all", "all_gather", "psum", "all_reduce",
+            "reduce_scatter"}
+
+    def walk(jp):
+        nonlocal total
+        for eqn in jp.eqns:
+            if eqn.primitive.name in coll:
+                for v in eqn.invars:
+                    aval = getattr(v, "aval", None)
+                    if aval is not None and hasattr(aval, "shape"):
+                        total += int(np.prod(aval.shape, initial=1)
+                                     * aval.dtype.itemsize)
+            for sub in eqn.params.values():
+                if hasattr(sub, "jaxpr"):
+                    walk(sub.jaxpr)
+                elif isinstance(sub, (list, tuple)):
+                    for s in sub:
+                        if hasattr(s, "jaxpr"):
+                            walk(s.jaxpr)
+
+    walk(jaxpr.jaxpr)
+    return total

@@ -34,10 +34,13 @@ class OnebitAdamState(NamedTuple):
 
 def _compress(x, error):
     """Error-compensated 1-bit compression (compressed_allreduce,
-    comm/nccl.py:47): sign bits + one fp scale; the residual feeds back."""
+    comm/nccl.py:47): sign bits + one fp scale; the residual feeds back.
+    Scale is the RMS — norm/sqrt(numel), the reference's worker_scale
+    (nccl.py:66) — and sign(0) maps to +1 like the reference's bool trick.
+    The wire-format collective lives in comm/compressed.py."""
     corrected = x + error
-    scale = jnp.mean(jnp.abs(corrected))
-    compressed = jnp.sign(corrected) * scale
+    scale = jnp.linalg.norm(corrected) / jnp.sqrt(corrected.size)
+    compressed = jnp.where(corrected >= 0, scale, -scale)
     new_error = corrected - compressed
     return compressed, new_error
 

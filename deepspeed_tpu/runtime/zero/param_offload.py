@@ -55,9 +55,11 @@ class HostParamStore:
         self.treedefs: List[Any] = []
         self.swapper = None
         self._swap_folder = None
+        self._owns_folder = False
         if nvme_path is not None:
             from deepspeed_tpu.runtime.swap_tensor.swapper import \
                 AsyncTensorSwapper
+            self._owns_folder = swap_folder is None
             self._swap_folder = swap_folder or os.path.join(
                 nvme_path, f"ds_param_offload_{os.getpid()}")
             self.swapper = AsyncTensorSwapper(self._swap_folder)
@@ -104,12 +106,21 @@ class HostParamStore:
 
     def close(self):
         """Delete this run's NVMe swap files (masters are full model size —
-        leaking them across runs fills the device)."""
+        leaking them across runs fills the device). A caller-supplied
+        swap_folder may be shared, so only this store's own files go."""
         if self.swapper is None or self._swap_folder is None:
             return
         self.swapper.synchronize()
-        import shutil
-        shutil.rmtree(self._swap_folder, ignore_errors=True)
+        if self._owns_folder:
+            import shutil
+            shutil.rmtree(self._swap_folder, ignore_errors=True)
+        else:
+            for i, td in enumerate(self.treedefs):
+                for j in range(td.num_leaves):
+                    try:
+                        os.remove(self.swapper._path(f"L{i}_p{j}"))
+                    except OSError:
+                        pass
         self.swapper = None
 
     def __del__(self):  # pragma: no cover — best-effort cleanup

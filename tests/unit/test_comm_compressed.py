@@ -1,0 +1,153 @@
+"""Compressed (1-bit) allreduce collective (comm/compressed.py).
+
+Parity oracle: a numpy re-implementation of the reference's
+compressed_allreduce (deepspeed/runtime/comm/nccl.py:47) run as a single
+process over the stacked per-rank tensors. The shard_map collective must
+match it bit-for-bit, and its measured bytes entering collectives must be
+an order of magnitude below the exact fp32 allreduce.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.comm.compressed import (collective_wire_bytes,
+                                           compressed_allreduce,
+                                           make_compressed_allreduce,
+                                           pack_signs, padded_numel,
+                                           unpack_signs)
+from deepspeed_tpu.utils import groups
+
+WORLD = 8
+N = 1000  # deliberately not divisible by 8*world — exercises padding
+P = padded_numel(N, WORLD)
+CHUNK = P // WORLD
+
+
+def _reference_sim(xs, w_errs, s_errs):
+    """nccl.py:47 compressed_allreduce, simulated over stacked ranks."""
+    world, p = xs.shape
+    chunk = p // world
+    signs = np.zeros_like(xs)
+    scales = np.zeros(world)
+    new_we = np.zeros_like(w_errs)
+    for r in range(world):
+        buf = xs[r] + w_errs[r]
+        scale = np.linalg.norm(buf) / np.sqrt(p)        # nccl.py:66
+        sg = np.where(buf >= 0, 1.0, -1.0)              # bool trick :67
+        new_we[r] = buf - scale * sg
+        signs[r], scales[r] = sg, scale
+    out = np.zeros(p)
+    new_se = np.zeros_like(s_errs)
+    for r in range(world):                              # "server" chunk r
+        m = (signs[:, r * chunk:(r + 1) * chunk] *
+             scales[:, None]).mean(axis=0) + s_errs[r]  # :118-121
+        ss = np.linalg.norm(m) / np.sqrt(chunk)         # :123
+        sg = np.where(m >= 0, 1.0, -1.0)
+        new_se[r] = m - ss * sg                         # :125
+        out[r * chunk:(r + 1) * chunk] = ss * sg
+    return out, new_we, new_se
+
+
+def _rank_data(seed=0):
+    rng = np.random.default_rng(seed)
+    xs = rng.standard_normal((WORLD, N)).astype(np.float32)
+    return xs
+
+
+def test_pack_unpack_roundtrip():
+    rng = np.random.default_rng(1)
+    bits = jnp.asarray(rng.integers(0, 2, 64 * 9).astype(bool))
+    vals = unpack_signs(pack_signs(bits))
+    np.testing.assert_array_equal(np.asarray(vals),
+                                  np.where(np.asarray(bits), 1.0, -1.0))
+
+
+def test_matches_reference_simulation():
+    xs = _rank_data()
+    xs_pad = np.zeros((WORLD, P), np.float32)
+    xs_pad[:, :N] = xs
+    want, want_we, want_se = _reference_sim(
+        xs_pad, np.zeros((WORLD, P)), np.zeros((WORLD, CHUNK)))
+
+    groups.destroy()
+    groups.initialize()
+    mesh = groups.get_mesh()
+    fn = make_compressed_allreduce(mesh, "data")
+    out, we, se = fn(jnp.asarray(xs),
+                     jnp.zeros((WORLD, P), jnp.float32),
+                     jnp.zeros((WORLD, CHUNK), jnp.float32))
+    # every rank reconstructs the same full tensor
+    out = np.asarray(out)
+    for r in range(WORLD):
+        np.testing.assert_allclose(out[r], want[:N], rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(we)[:, :], want_we, rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(se), want_se, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_error_feedback_reduces_bias():
+    """With persistent inputs, the error-compensated average of repeated
+    compressed allreduces converges toward the exact mean (the 1-bit Adam
+    convergence argument)."""
+    xs = jnp.asarray(_rank_data(seed=3))
+    exact = np.asarray(xs).mean(axis=0)
+
+    groups.destroy()
+    groups.initialize()
+    fn = make_compressed_allreduce(groups.get_mesh(), "data")
+    we = jnp.zeros((WORLD, P), jnp.float32)
+    se = jnp.zeros((WORLD, CHUNK), jnp.float32)
+    acc = np.zeros(N)
+    steps = 16
+    first_err = None
+    for t in range(steps):
+        out, we, se = fn(xs, we, se)
+        acc += np.asarray(out)[0]
+        err = np.linalg.norm(acc / (t + 1) - exact) / np.linalg.norm(exact)
+        if first_err is None:
+            first_err = err
+    assert err < first_err * 0.25, (first_err, err)
+
+
+def test_wire_bytes_reduction():
+    groups.destroy()
+    groups.initialize()
+    mesh = groups.get_mesh()
+    fn = make_compressed_allreduce(mesh, "data")
+    xs = jnp.zeros((WORLD, N), jnp.float32)
+    we = jnp.zeros((WORLD, P), jnp.float32)
+    se = jnp.zeros((WORLD, CHUNK), jnp.float32)
+    compressed_bytes = collective_wire_bytes(fn, xs, we, se)
+
+    from jax.sharding import PartitionSpec as Pspec
+    try:
+        from jax import shard_map
+    except ImportError:  # pre-0.8 jax
+        from jax.experimental.shard_map import shard_map
+    import functools
+
+    @functools.partial(shard_map, mesh=mesh, in_specs=(Pspec("data"),),
+                       out_specs=Pspec("data"))
+    def exact(x):
+        return jax.lax.pmean(x, "data")
+
+    exact_bytes = collective_wire_bytes(exact, xs)
+    assert compressed_bytes * 8 <= exact_bytes, (compressed_bytes,
+                                                 exact_bytes)
+
+
+def test_onebit_compress_uses_rms_scale():
+    """ADVICE round 1: scale must be norm/sqrt(numel) (reference
+    worker_scale), not mean(|x|)."""
+    from deepspeed_tpu.runtime.fp16.onebit.adam import _compress
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.standard_normal(257).astype(np.float32))
+    e = jnp.zeros_like(x)
+    comp, new_e = _compress(x, e)
+    scale = float(jnp.linalg.norm(x) / jnp.sqrt(x.size))
+    np.testing.assert_allclose(np.asarray(jnp.abs(comp)), scale, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(comp + new_e), np.asarray(x),
+                               rtol=1e-6, atol=1e-7)
