@@ -66,7 +66,26 @@ class InferenceEngine:
                  f"dtype={self.dtype.__name__}", ranks=[0])
 
     def _load_checkpoint(self, path):
-        """Model-states file or consolidated 16bit export."""
+        """Three accepted forms (reference InferenceEngine._load_checkpoint
+        :244): a checkpoint-description JSON (SDLoaderFactory — Megatron
+        checkpoints, auto mp merge + flax conversion), a model-states
+        pickle, or a consolidated 16bit export."""
+        if str(path).endswith(".json"):
+            from deepspeed_tpu.runtime.state_dict_factory import (
+                SDLoaderFactory, megatron_to_gpt2_params)
+            loader = SDLoaderFactory.get_sd_loader_json(path)
+            # single-controller SPMD: merge to mp=1 host-side, then the
+            # engine re-shards onto the mesh via mp_rules (device_put) —
+            # the reference's per-rank split happens declaratively here
+            _, sd, _ = loader.load(mp_world_size=1, mp_rank=0)
+            module_sd = loader.get_module(sd)
+            from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel
+            if isinstance(self.module, GPT2LMHeadModel):
+                version = loader.get_checkpoint_version(sd)
+                return megatron_to_gpt2_params(module_sd,
+                                               self.module.config,
+                                               checkpoint_version=version)
+            return module_sd
         with open(path, "rb") as f:
             sd = pickle.load(f)
         if isinstance(sd, dict) and "module" in sd:

@@ -45,12 +45,18 @@ def _nbytes(tree) -> int:
     return sum(l.size * l.dtype.itemsize for l in jax.tree.leaves(tree))
 
 
+_store_ids = iter(range(1 << 30))
+
+
 class HostParamStore:
     """Per-layer host fp32 masters with optional NVMe backing and live-
     bytes accounting (the swap half of partitioned_param_swapper.py:36)."""
 
     def __init__(self, nvme_path: Optional[str] = None,
                  swap_folder: Optional[str] = None):
+        # swap keys are namespaced per store so several stores may share
+        # one caller-supplied folder without clobbering each other
+        self._key_prefix = f"st{next(_store_ids)}_{os.getpid()}_"
         self._ram: List[Optional[List[np.ndarray]]] = []
         self.treedefs: List[Any] = []
         self.swapper = None
@@ -70,6 +76,9 @@ class HostParamStore:
         self._dev: dict = {}
         self._dev_bytes: dict = {}
 
+    def _key(self, i: int, j: int) -> str:
+        return f"{self._key_prefix}L{i}_p{j}"
+
     # ------------------------------------------------------------- host side
     def add_layer(self, params) -> int:
         """Take ownership of one layer's params as host fp32 leaves."""
@@ -82,7 +91,7 @@ class HostParamStore:
         self.treedefs.append(treedef)
         if self.swapper is not None:
             for j, h in enumerate(host):
-                self.swapper.swap_out(f"L{i}_p{j}", h)
+                self.swapper.swap_out(self._key(i, j), h)
             self.swapper.synchronize()
             self._ram.append(None)
         else:
@@ -93,7 +102,7 @@ class HostParamStore:
         """Masters of layer i in RAM (swapped in from NVMe if backed)."""
         if self._ram[i] is not None:
             return self._ram[i]
-        return [self.swapper.swap_in(f"L{i}_p{j}")
+        return [self.swapper.swap_in(self._key(i, j))
                 for j in range(self.treedefs[i].num_leaves)]
 
     def write_back(self, i: int, leaves: List[np.ndarray]):
@@ -101,7 +110,7 @@ class HostParamStore:
         if self._ram[i] is not None:
             return
         for j, h in enumerate(leaves):
-            self.swapper.swap_out(f"L{i}_p{j}", h)
+            self.swapper.swap_out(self._key(i, j), h)
         self.swapper.synchronize()
 
     def close(self):
@@ -118,7 +127,7 @@ class HostParamStore:
             for i, td in enumerate(self.treedefs):
                 for j in range(td.num_leaves):
                     try:
-                        os.remove(self.swapper._path(f"L{i}_p{j}"))
+                        os.remove(self.swapper._path(self._key(i, j)))
                     except OSError:
                         pass
         self.swapper = None
