@@ -13,13 +13,13 @@ Generation: ``generate`` runs greedy/temperature decoding as one
 ``lax.scan`` over the sequence — compiled once per (batch, length) shape.
 """
 
-import pickle
 from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deepspeed_tpu.runtime.state_dict_factory import load_checkpoint_file
 from deepspeed_tpu.runtime.zero.partition import (ModelParallelRules,
                                                   build_param_shardings)
 from deepspeed_tpu.utils import groups
@@ -36,6 +36,7 @@ class InferenceEngine:
         self.checkpoint = checkpoint
         self.dtype = dtype or jnp.bfloat16
         self.injection_dict = injection_dict
+        self.quantization_setting = quantization_setting
 
         if not groups.mesh_is_initialized():
             groups.initialize(mp_size=mp_size, mpu=mpu)
@@ -79,6 +80,8 @@ class InferenceEngine:
             # the reference's per-rank split happens declaratively here
             _, sd, _ = loader.load(mp_world_size=1, mp_rank=0)
             module_sd = loader.get_module(sd)
+            if self.quantization_setting is not None:
+                module_sd = self._apply_weight_quantization(module_sd)
             from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel
             if isinstance(self.module, GPT2LMHeadModel):
                 version = loader.get_checkpoint_version(sd)
@@ -86,11 +89,43 @@ class InferenceEngine:
                                                self.module.config,
                                                checkpoint_version=version)
             return module_sd
-        with open(path, "rb") as f:
-            sd = pickle.load(f)
+        sd = load_checkpoint_file(path)
         if isinstance(sd, dict) and "module" in sd:
             return sd["module"]
         return sd
+
+    def _apply_weight_quantization(self, module_sd):
+        """MoQ post-training weight quantization (reference
+        quantization_setting → WeightQuantization): transformer matmul
+        weights are grouped-int8 quantized and immediately dequantized, so
+        inference numerics equal the reference's on-the-fly-dequant fused
+        kernels. quantization_setting: groups (int) or
+        (mlp_extra_grouping, groups)."""
+        from deepspeed_tpu.runtime.weight_quantizer import (
+            WeightQuantization, dequantize)
+        qs = self.quantization_setting
+        if isinstance(qs, (tuple, list)):
+            mlp_extra_grouping, groups = qs
+        else:
+            mlp_extra_grouping, groups = True, int(qs)
+        q = WeightQuantization(mlp_extra_grouping=mlp_extra_grouping,
+                               mp_size=self.mp_world_size)
+        out = dict(module_sd)
+        quantized = 0
+        for key, val in module_sd.items():
+            if any(s in key for s in ("attention.dense.weight",
+                                      "mlp.dense_4h_to_h.weight",
+                                      "mlp.dense_h_to_4h.weight",
+                                      "attention.query_key_value.weight")):
+                g = groups * 2 if (mlp_extra_grouping and
+                                   q.is_mlp(val)) else groups
+                data_int, scale = q.quantize_data(val, 8, g)
+                out[key] = dequantize(data_int, 1.0 / scale, groups=g
+                                      ).astype(val.dtype)
+                quantized += 1
+        log_dist(f"MoQ weight quantization applied to {quantized} tensors "
+                 f"(groups={groups})", ranks=[0])
+        return out
 
     def forward(self, batch):
         with self.mesh:

@@ -223,7 +223,7 @@ def _flash_fwd(q, k, v, causal, sm_scale):
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, sm_scale, res, g):
+def _flash_bwd(causal, sm_scale, res, g, g_lse=None):
     q, k, v, out, lse = res
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
@@ -236,12 +236,16 @@ def _flash_bwd(causal, sm_scale, res, g):
     vf = v.reshape(B * H, Sk, D)
     dof = g.reshape(B * H, Sq, D)
     # delta = rowsum(do * o): the softmax-jacobian correction term,
-    # lane-replicated like lse
-    delta = jnp.broadcast_to(
-        jnp.sum(dof.astype(jnp.float32) *
-                out.reshape(B * H, Sq, D).astype(jnp.float32),
-                axis=-1, keepdims=True),
-        (B * H, Sq, LANES))
+    # lane-replicated like lse. A direct lse cotangent (ring attention's
+    # merge weights differentiate through lse) folds in exactly here:
+    # dL/ds_ij = p_ij (dp_ij - delta_i + g_lse_i), since dlse_i/ds_ij=p_ij.
+    delta_rows = jnp.sum(
+        dof.astype(jnp.float32) *
+        out.reshape(B * H, Sq, D).astype(jnp.float32),
+        axis=-1, keepdims=True)
+    if g_lse is not None:
+        delta_rows = delta_rows - g_lse.reshape(B * H, Sq, 1)
+    delta = jnp.broadcast_to(delta_rows, (B * H, Sq, LANES))
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, sm_scale=sm_scale, causal=causal,
@@ -290,3 +294,31 @@ def _flash_bwd(causal, sm_scale, res, g):
 flash_attention.defvjp(lambda q, k, v, causal, sm_scale:
                        _flash_fwd(q, k, v, causal, sm_scale),
                        _flash_bwd)
+
+
+# ------------------------------------------- (out, lse) differentiable form
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention_with_lse(q, k, v, causal=True, sm_scale=None):
+    """Flash attention returning ``(out, lse)`` with lse [B, H, Sq] fp32,
+    differentiable in BOTH outputs — the building block ring attention's
+    online-softmax merge needs (its chunk weights are functions of lse)."""
+    (out, lse), _ = _flash_fwd_lse(q, k, v, causal, sm_scale)
+    return out, lse
+
+
+def _flash_fwd_lse(q, k, v, causal, sm_scale):
+    out, res = _flash_fwd(q, k, v, causal, sm_scale)
+    B, H, Sq, _ = q.shape
+    lse = res[4][:, :, 0].reshape(B, H, Sq)
+    return (out, lse), res
+
+
+def _flash_bwd_lse(causal, sm_scale, res, g):
+    g_out, g_lse = g
+    return _flash_bwd(causal, sm_scale, res, g_out, g_lse=g_lse)
+
+
+flash_attention_with_lse.defvjp(
+    lambda q, k, v, causal, sm_scale: _flash_fwd_lse(q, k, v, causal,
+                                                     sm_scale),
+    _flash_bwd_lse)

@@ -35,9 +35,9 @@ def _attend_with_lse(q, k, v, causal, sm_scale, use_flash):
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
     if use_flash:
-        out, (_, _, _, _, lse) = flash._flash_fwd(q, k, v, causal, sm_scale)
-        B, H, S, _ = q.shape
-        return out, lse[:, :, 0].reshape(B, H, S)
+        # custom-VJP form: grads flow through BOTH out and lse (the merge
+        # weights), so jax.grad of ring attention is exact on TPU
+        return flash.flash_attention_with_lse(q, k, v, causal, sm_scale)
     # jnp fallback (CPU tests): replicate the flash math
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * sm_scale

@@ -585,12 +585,39 @@ class DeepSpeedEngine:
             self.progressive_layer_drop.get_theta()
             if self.progressive_layer_drop is not None else 1.0)
         with self.mesh:
-            batch = jax.device_put(batch, self._batch_sharding(batch))
+            batch = self._globalize_batch(batch)
             self.state, loss = self._jit_micro(
                 self.state, batch, self._next_rng(), theta)
         self._pending_loss = loss
         self._last_batch = batch
         return loss
+
+    def _globalize_batch(self, batch):
+        """Place the host batch onto the mesh as the GLOBAL batch.
+
+        Single process: device_put against the batch sharding. Multi
+        process: each host holds only its slice (deepspeed_io loads
+        global_micro/process_count rows), so the global array must be
+        assembled from per-process shards — device_put would silently
+        treat the local slice as the whole batch (ADVICE round 1)."""
+        shardings = self._batch_sharding(batch)
+        if jax.process_count() == 1:
+            return jax.device_put(batch, shardings)
+        # replicated batch sharding can't be assembled from differing
+        # per-process slices — every host would need the FULL batch
+        for sh in jax.tree.leaves(shardings):
+            if sh.is_fully_replicated:
+                raise NotImplementedError(
+                    "multi-process run with a replicated batch sharding: "
+                    "each process only loads its slice (deepspeed_io), so "
+                    "a replicated global batch cannot be assembled; use a "
+                    "data-parallel mesh axis or load the full batch per "
+                    "process via model_parameters/batch_spec")
+        import numpy as _np
+        return jax.tree.map(
+            lambda x, sh: jax.make_array_from_process_local_data(
+                sh, _np.asarray(x)),
+            batch, shardings)
 
     def backward(self, loss=None, allreduce_gradients=True, release_loss=False):
         """Bookkeeping half of the fused forward/backward (see ``forward``)."""
@@ -680,7 +707,7 @@ class DeepSpeedEngine:
 
     def eval_batch(self, batch):
         with self.mesh:
-            batch = jax.device_put(batch, self._batch_sharding(batch))
+            batch = self._globalize_batch(batch)
             return self._jit_eval(self.state.params, batch)
 
     def __call__(self, batch):

@@ -41,7 +41,10 @@ def _to_numpy(obj):
     try:
         import torch
         if isinstance(obj, torch.Tensor):
-            return obj.detach().cpu().numpy()
+            t = obj.detach().cpu()
+            if t.dtype == torch.bfloat16:  # numpy has no bf16; widen
+                t = t.float()
+            return t.numpy()
     except ImportError:
         pass
     if isinstance(obj, dict):
@@ -92,7 +95,13 @@ class SDLoaderBase(ABC):
         self.module_key = None
         self.ckpt_list = ckpt_list
         self.version = version
+        self._first_sd_cache = None  # shard 0, loaded once (multi-GB files)
         self.check_ckpt_list()
+
+    def _load_first(self):
+        if self._first_sd_cache is None:
+            self._first_sd_cache = load_checkpoint_file(self.ckpt_list[0])
+        return self._first_sd_cache
 
     def load(self, mp_world_size, mp_rank, module_key=AUTO_MODULE_KEY,
              is_pipe_parallel=False, quantize=False, quantize_bits=8,
@@ -139,7 +148,8 @@ class SDLoaderBase(ABC):
         ckpts = self.ckpt_list[num_to_merge * mp_rank:
                                num_to_merge * (mp_rank + 1)]
         logger.info(f"mp_rank: {mp_rank}, ckpt_list: {ckpts}")
-        return [load_checkpoint_file(c) for c in ckpts]
+        return [self._load_first() if c == self.ckpt_list[0]
+                else load_checkpoint_file(c) for c in ckpts]
 
     def get_split_state_dict(self, mp_world_size, mp_rank):
         num_ckpt = len(self.ckpt_list)
@@ -148,7 +158,8 @@ class SDLoaderBase(ABC):
         num_to_split = mp_world_size // num_ckpt
         ckpt_index = mp_rank // num_to_split
         ckpt_offset = mp_rank % num_to_split
-        sd = load_checkpoint_file(self.ckpt_list[ckpt_index])
+        sd = self._load_first() if ckpt_index == 0 \
+            else load_checkpoint_file(self.ckpt_list[ckpt_index])
         return sd, num_to_split, ckpt_offset
 
     def _choose_module_key(self, sd):
@@ -176,7 +187,7 @@ class SDLoaderBase(ABC):
 
     def check_ckpt_list(self):
         assert len(self.ckpt_list) > 0
-        sd = load_checkpoint_file(self.ckpt_list[0])
+        sd = self._load_first()
         if isinstance(sd, dict) and "mp_world_size" in sd:
             assert len(self.ckpt_list) == sd["mp_world_size"], (
                 f"checkpoint count {len(self.ckpt_list)} != saved "
@@ -335,7 +346,8 @@ class MegatronSDLoader(SDLoaderBase):
                          "attention.query_key_value",
                          "mlp.dense_h_to_4h.weight",
                          "mlp.dense_h_to_4h.bias"]
-        sd = load_checkpoint_file(ckpt_file_name)
+        sd = self._load_first() if ckpt_file_name == self.ckpt_list[0] \
+            else load_checkpoint_file(ckpt_file_name)
         module = self.get_module(sd)
         for key in keys_to_check:
             assert any(key in k for k in module.keys()), (
@@ -377,55 +389,69 @@ def megatron_to_gpt2_params(client_sd: Dict[str, Any], config,
     """Map a (merged, mp=1) Megatron GPT state dict onto this package's
     flax GPT2LMHeadModel params. Megatron linears are [out, in]; flax
     kernels are [in, out] (transpose). Head-interleaved QKV layouts
-    (checkpoint_version 1.0/2.0) are re-ordered to contiguous [q|k|v]."""
+    (checkpoint_version 1.0/2.0) are re-ordered to contiguous [q|k|v].
+
+    Keys are matched by suffix, so Megatron-LM's module prefixes
+    ('language_model.embedding.word_embeddings.weight', ...) resolve the
+    same way the loader's substring matching does."""
     E = config.n_embd
     p: Dict[str, Any] = {}
 
-    def ln(dst, src):
-        p[dst] = {"scale": np.asarray(client_sd[f"{src}.weight"]),
-                  "bias": np.asarray(client_sd[f"{src}.bias"])}
+    def lookup(name):
+        if name in client_sd:
+            return client_sd[name]
+        hits = [k for k in client_sd if k.endswith("." + name)]
+        assert len(hits) == 1, (
+            f"expected exactly one key ending with {name!r}, got {hits}")
+        return client_sd[hits[0]]
 
-    wte = np.asarray(client_sd["word_embeddings.weight"], np.float32)
+    client_sd = dict(client_sd)
+
+    def ln(dst, src):
+        p[dst] = {"scale": np.asarray(lookup(f"{src}.weight")),
+                  "bias": np.asarray(lookup(f"{src}.bias"))}
+
+    wte = np.asarray(lookup("word_embeddings.weight"), np.float32)
     if wte.shape[0] < config.padded_vocab:
         wte = np.pad(wte, [(0, config.padded_vocab - wte.shape[0]), (0, 0)])
     p["wte"] = wte
-    p["wpe"] = np.asarray(client_sd["position_embeddings.weight"],
+    p["wpe"] = np.asarray(lookup("position_embeddings.weight"),
                           np.float32)
     ln("ln_f", "transformer.final_layernorm")
     for i in range(config.n_layer):
         pre = f"transformer.layers.{i}"
         blk: Dict[str, Any] = {}
         blk["ln_1"] = {
-            "scale": np.asarray(client_sd[f"{pre}.input_layernorm.weight"]),
-            "bias": np.asarray(client_sd[f"{pre}.input_layernorm.bias"])}
+            "scale": np.asarray(lookup(f"{pre}.input_layernorm.weight")),
+            "bias": np.asarray(lookup(f"{pre}.input_layernorm.bias"))}
         blk["ln_2"] = {
             "scale": np.asarray(
-                client_sd[f"{pre}.post_attention_layernorm.weight"]),
+                lookup(f"{pre}.post_attention_layernorm.weight")),
             "bias": np.asarray(
-                client_sd[f"{pre}.post_attention_layernorm.bias"])}
+                lookup(f"{pre}.post_attention_layernorm.bias"))}
         qkv_w = reorder_qkv_to_contiguous(
-            np.asarray(client_sd[f"{pre}.attention.query_key_value.weight"]),
+            np.asarray(lookup(f"{pre}.attention.query_key_value.weight")),
             checkpoint_version, config.n_head)
         qkv_b = reorder_qkv_to_contiguous(
-            np.asarray(client_sd[f"{pre}.attention.query_key_value.bias"]),
+            np.asarray(lookup(f"{pre}.attention.query_key_value.bias")),
             checkpoint_version, config.n_head)
         assert qkv_w.shape == (3 * E, E), qkv_w.shape
         blk["attn"] = {
             "qkv": {"kernel": qkv_w.T, "bias": qkv_b},
             "proj": {
                 "kernel": np.asarray(
-                    client_sd[f"{pre}.attention.dense.weight"]).T,
+                    lookup(f"{pre}.attention.dense.weight")).T,
                 "bias": np.asarray(
-                    client_sd[f"{pre}.attention.dense.bias"])}}
+                    lookup(f"{pre}.attention.dense.bias"))}}
         blk["mlp"] = {
             "fc": {"kernel": np.asarray(
-                client_sd[f"{pre}.mlp.dense_h_to_4h.weight"]).T,
+                lookup(f"{pre}.mlp.dense_h_to_4h.weight")).T,
                 "bias": np.asarray(
-                    client_sd[f"{pre}.mlp.dense_h_to_4h.bias"])},
+                    lookup(f"{pre}.mlp.dense_h_to_4h.bias"))},
             "proj": {"kernel": np.asarray(
-                client_sd[f"{pre}.mlp.dense_4h_to_h.weight"]).T,
+                lookup(f"{pre}.mlp.dense_4h_to_h.weight")).T,
                 "bias": np.asarray(
-                    client_sd[f"{pre}.mlp.dense_4h_to_h.bias"])}}
+                    lookup(f"{pre}.mlp.dense_4h_to_h.bias"))}}
         p[f"h_{i}"] = blk
     return p
 

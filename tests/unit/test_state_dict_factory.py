@@ -189,6 +189,46 @@ def test_interleaved_qkv_versions_convert_correctly(tmp_path, full_sd,
         np.asarray(params["h_0"]["attn"]["qkv"]["kernel"]))
 
 
+def test_init_inference_quantization_setting(tmp_path, full_sd):
+    """quantization_setting quantizes transformer weights (MoQ): params
+    differ from the fp originals but stay close, and inference runs."""
+    import json
+
+    import deepspeed_tpu
+    from deepspeed_tpu.utils import groups
+
+    sd, params = full_sd
+    p = _save(tmp_path / "mp_rank_00.pt", sd)
+    jpath = tmp_path / "ckpt.json"
+    jpath.write_text(json.dumps({"type": "Megatron",
+                                 "checkpoints": [str(p)], "version": 0}))
+    groups.destroy()
+    groups.initialize()
+    eng = deepspeed_tpu.init_inference(GPT2LMHeadModel(CFG),
+                                       checkpoint=str(jpath),
+                                       dtype=jnp.float32,
+                                       quantization_setting=(False, 8))
+    qkv_q = np.asarray(eng.params["h_0"]["attn"]["qkv"]["kernel"])
+    qkv_f = np.asarray(params["h_0"]["attn"]["qkv"]["kernel"])
+    assert not np.array_equal(qkv_q, qkv_f)          # actually quantized
+    assert np.abs(qkv_q - qkv_f).max() < 0.05        # ...but int8-close
+    ids = jnp.zeros((1, 8), jnp.int32)
+    logits = eng.module.apply({"params": eng.params}, {"input_ids": ids},
+                              return_logits=True)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_megatron_prefixed_keys_convert(full_sd):
+    """Real Megatron-LM checkpoints prefix keys (language_model. ...);
+    the flax converter must match by suffix."""
+    sd, params = full_sd
+    prefixed = {f"language_model.{k}": v for k, v in sd.items()}
+    flax_params = megatron_to_gpt2_params(prefixed, CFG)
+    np.testing.assert_array_equal(
+        np.asarray(flax_params["h_0"]["attn"]["qkv"]["kernel"]),
+        np.asarray(params["h_0"]["attn"]["qkv"]["kernel"]))
+
+
 def test_mp_world_size_mismatch_rejected(tmp_path, full_sd):
     sd, _ = full_sd
     p = _save(tmp_path / "ck.pt", sd, mp_world_size=4)
