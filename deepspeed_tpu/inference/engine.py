@@ -89,6 +89,11 @@ class InferenceEngine:
                                                self.module.config,
                                                checkpoint_version=version)
             return module_sd
+        if self.quantization_setting is not None:
+            log_dist(
+                "quantization_setting is only applied to Megatron-format "
+                "checkpoint JSONs (the weight names drive the grouping); "
+                "this flax/pickle checkpoint loads UNQUANTIZED", ranks=[0])
         sd = load_checkpoint_file(path)
         if isinstance(sd, dict) and "module" in sd:
             return sd["module"]
@@ -96,33 +101,18 @@ class InferenceEngine:
 
     def _apply_weight_quantization(self, module_sd):
         """MoQ post-training weight quantization (reference
-        quantization_setting → WeightQuantization): transformer matmul
-        weights are grouped-int8 quantized and immediately dequantized, so
-        inference numerics equal the reference's on-the-fly-dequant fused
-        kernels. quantization_setting: groups (int) or
-        (mlp_extra_grouping, groups)."""
-        from deepspeed_tpu.runtime.weight_quantizer import (
-            WeightQuantization, dequantize)
+        quantization_setting → WeightQuantization). quantization_setting:
+        groups (int) or (mlp_extra_grouping, groups)."""
+        from deepspeed_tpu.runtime.weight_quantizer import \
+            quantize_dequantize_sd
         qs = self.quantization_setting
         if isinstance(qs, (tuple, list)):
             mlp_extra_grouping, groups = qs
         else:
             mlp_extra_grouping, groups = True, int(qs)
-        q = WeightQuantization(mlp_extra_grouping=mlp_extra_grouping,
-                               mp_size=self.mp_world_size)
-        out = dict(module_sd)
-        quantized = 0
-        for key, val in module_sd.items():
-            if any(s in key for s in ("attention.dense.weight",
-                                      "mlp.dense_4h_to_h.weight",
-                                      "mlp.dense_h_to_4h.weight",
-                                      "attention.query_key_value.weight")):
-                g = groups * 2 if (mlp_extra_grouping and
-                                   q.is_mlp(val)) else groups
-                data_int, scale = q.quantize_data(val, 8, g)
-                out[key] = dequantize(data_int, 1.0 / scale, groups=g
-                                      ).astype(val.dtype)
-                quantized += 1
+        out, quantized = quantize_dequantize_sd(
+            module_sd, groups, mlp_extra_grouping=mlp_extra_grouping,
+            mp_size=self.mp_world_size)
         log_dist(f"MoQ weight quantization applied to {quantized} tensors "
                  f"(groups={groups})", ranks=[0])
         return out

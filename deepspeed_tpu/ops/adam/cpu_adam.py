@@ -45,15 +45,22 @@ class DeepSpeedCPUAdam:
     def step(self, grads, lr=None):
         """grads: list of numpy fp32 arrays matching params."""
         self.step_count += 1
-        lr = self.lr if lr is None else lr
-        for p, g, m, v in zip(self.params, grads, self.exp_avg,
-                              self.exp_avg_sq):
-            g = np.ascontiguousarray(g, dtype=np.float32)
-            rc = self.lib.ds_adam_step(self.opt_id, self.step_count, lr,
-                                       _ptr(p), _ptr(g), _ptr(m), _ptr(v),
-                                       p.size)
-            assert rc == 0, f"ds_adam_step failed ({rc})"
+        for i, g in enumerate(grads):
+            self.step_single(i, g, lr=lr, step_no=self.step_count)
         return self.params
+
+    def step_single(self, idx, grad, lr=None, step_no=None):
+        """One tensor's update — the unit the pipelined optimizer swapper
+        interleaves with NVMe reads/writes (reference
+        pipelined_optimizer_swapper.py)."""
+        lr = self.lr if lr is None else lr
+        step_no = self.step_count if step_no is None else step_no
+        p, m, v = self.params[idx], self.exp_avg[idx], self.exp_avg_sq[idx]
+        g = np.ascontiguousarray(grad, dtype=np.float32)
+        rc = self.lib.ds_adam_step(self.opt_id, step_no, lr,
+                                   _ptr(p), _ptr(g), _ptr(m), _ptr(v),
+                                   p.size)
+        assert rc == 0, f"ds_adam_step failed ({rc})"
 
     def __del__(self):
         try:

@@ -405,8 +405,6 @@ def megatron_to_gpt2_params(client_sd: Dict[str, Any], config,
             f"expected exactly one key ending with {name!r}, got {hits}")
         return client_sd[hits[0]]
 
-    client_sd = dict(client_sd)
-
     def ln(dst, src):
         p[dst] = {"scale": np.asarray(lookup(f"{src}.weight")),
                   "bias": np.asarray(lookup(f"{src}.bias"))}

@@ -51,6 +51,25 @@ class AsyncTensorSwapper:
             self._pending.append(req)
         return out
 
+    def swap_in_async(self, key: str):
+        """Submit a read and return (buffer, request) — the per-request
+        half of the reference's PipelinedOptimizerSwapper: callers overlap
+        the read with compute and wait(req, nbytes) just before use."""
+        meta = self._meta[key]
+        out = np.empty(meta["shape"], meta["dtype"])
+        req = self.aio.async_pread(out, self._path(key))
+        return out, req
+
+    def wait(self, req, expect_nbytes=None) -> int:
+        """Block on one request; a failed or short transfer raises (the
+        buffer would otherwise hold uninitialised garbage)."""
+        n = self.aio.wait(req)
+        assert n >= 0, f"aio request failed (errno {-n})"
+        if expect_nbytes is not None:
+            assert n == expect_nbytes, (
+                f"short aio transfer: {n} of {expect_nbytes} bytes")
+        return n
+
     def synchronize(self):
         """Wait for all in-flight requests (reference swap_out_tensors
         epilogue); releases the keep-alive buffers."""

@@ -118,6 +118,32 @@ class WeightQuantization:
         return sd, self.merge_scales()
 
 
+MEGATRON_QUANTIZABLE_SUBSTRINGS = (
+    "attention.dense.weight", "mlp.dense_4h_to_h.weight",
+    "mlp.dense_h_to_4h.weight", "attention.query_key_value.weight")
+
+
+def quantize_dequantize_sd(module_sd, groups, mlp_extra_grouping=True,
+                           mp_size=1, quantize_bits=8):
+    """Grouped int8 quantize + immediate dequantize of the megatron
+    transformer matmul weights: numerics equal the reference's
+    on-the-fly-dequant fused inference kernels while the params stay a
+    normal fp tree. Returns (new_sd, num_quantized)."""
+    q = WeightQuantization(mlp_extra_grouping=mlp_extra_grouping,
+                           mp_size=mp_size)
+    out = dict(module_sd)
+    n = 0
+    for key, val in module_sd.items():
+        if any(s in key for s in MEGATRON_QUANTIZABLE_SUBSTRINGS):
+            g = groups * 2 if (mlp_extra_grouping and q.is_mlp(val)) \
+                else groups
+            data_int, scale = q.quantize_data(val, quantize_bits, g)
+            out[key] = dequantize(data_int, 1.0 / scale, groups=g
+                                  ).astype(val.dtype)
+            n += 1
+    return out, n
+
+
 def dequantize(data_int, inv_scales, groups=None):
     """int8 grouped values + inverse scales -> fp32 (the host-side pair of
     the reference's dequantize.cu; TPU-side dequant fuses into the matmul

@@ -103,15 +103,24 @@ class OffloadedOptimizer:
                 self._state_key("v", i))
 
     # ---------------------------------------------------------------- step
+    _PREFETCH = 2  # moment buffers in flight (double buffering)
+
     def step(self, grads: Any, lr: float, params: Any, param_shardings):
-        """Apply one host Adam step; returns the updated device params."""
-        if self.opt is None:
+        """Apply one host Adam step; returns the updated device params.
+
+        Pipelined (reference pipelined_optimizer_swapper.py): device→host
+        grad copies are issued async for every leaf up front; in NVMe mode
+        each buffer's (m, v) read is prefetched while the previous buffer's
+        Adam sweep runs and its write-back is submitted async behind it."""
+        first_step = self.opt is None
+        grad_leaves = self.treedef.flatten_up_to(grads)
+        for g in grad_leaves:  # overlap D2H with everything below
+            if hasattr(g, "copy_to_host_async"):
+                g.copy_to_host_async()
+        if first_step:
             self._init_masters(grads, params)
-        elif self.swapper is not None:
-            self._swap_in_states()
         self.maybe_apply_loaded_state()
 
-        grad_leaves = self.treedef.flatten_up_to(grads)
         grads_np = []
         for g_leaf, leaf_masters in zip(grad_leaves, self.masters):
             shards = {tuple((sl.start, sl.stop) for sl in idx): d
@@ -120,10 +129,43 @@ class OffloadedOptimizer:
                 key = tuple((sl.start, sl.stop) for sl in idx)
                 grads_np.append(np.ascontiguousarray(shards[key],
                                                      np.float32))
-        self.opt.step(grads_np, lr=lr)
 
-        if self.swapper is not None:
-            self._swap_out_states(block=False)
+        n = len(self.opt.params)
+        self.opt.step_count += 1
+        step_no = self.opt.step_count
+        if self.swapper is not None and self._swap_ready and not first_step:
+            # the previous step's async write-backs must land before we
+            # re-read the same files (FIFO ordering only holds for
+            # thread_count=1 aio handles)
+            self.swapper.synchronize()
+            # pipelined: fetch i+PREFETCH ‖ adam(i) ‖ write-back(i)
+            fetches = {}
+
+            def start(i):
+                if i < n:
+                    fetches[i] = (
+                        self.swapper.swap_in_async(self._state_key("m", i)),
+                        self.swapper.swap_in_async(self._state_key("v", i)))
+
+            for i in range(min(self._PREFETCH, n)):
+                start(i)
+            for i in range(n):
+                (m_buf, m_req), (v_buf, v_req) = fetches.pop(i)
+                self.swapper.wait(m_req, m_buf.nbytes)
+                self.swapper.wait(v_req, v_buf.nbytes)
+                self.opt.exp_avg[i] = m_buf
+                self.opt.exp_avg_sq[i] = v_buf
+                start(i + self._PREFETCH)
+                self.opt.step_single(i, grads_np[i], lr=lr, step_no=step_no)
+                self.swapper.swap_out(self._state_key("m", i), m_buf)
+                self.swapper.swap_out(self._state_key("v", i), v_buf)
+            self.swapper.synchronize()
+        else:
+            for i in range(n):
+                self.opt.step_single(i, grads_np[i], lr=lr, step_no=step_no)
+            if self.swapper is not None:
+                self._swap_out_states(block=False)
+                self._swap_ready = True
 
         # scatter updated master shards back onto the device params
         new_leaves = []
@@ -138,6 +180,7 @@ class OffloadedOptimizer:
                     full[idx] = master
                 new_leaves.append(full)
         new_params = self.treedef.unflatten(new_leaves)
+        # async put: the compiled next step blocks only when it consumes
         return jax.device_put(new_params, param_shardings)
 
     def state_dict(self):

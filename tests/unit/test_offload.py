@@ -69,3 +69,30 @@ def test_offload_checkpoint_roundtrip(tmp_path):
     cont_new = [float(engine2.train_batch(batch=_batch(10 + i)))
                 for i in range(2)]
     np.testing.assert_allclose(cont_ref, cont_new, rtol=1e-6)
+
+
+def test_pipelined_swapper_engages_and_state_roundtrips(tmp_path):
+    """From the second step the NVMe path must use the pipelined
+    (prefetch + async write-back) swapper, and checkpoint state saved
+    after pipelined steps must still round-trip."""
+    engine, _ = _run(_config(offload={"device": "nvme",
+                                      "nvme_path": str(tmp_path)}),
+                     steps=1)
+    opt = engine._offload_opt
+    calls = {"n": 0}
+    orig = opt.swapper.swap_in_async
+
+    def counting(key):
+        calls["n"] += 1
+        return orig(key)
+
+    opt.swapper.swap_in_async = counting
+    for i in range(3):
+        engine.train_batch(batch=_batch(10 + i))
+    # 2 moment tensors per master buffer per step
+    assert calls["n"] == 3 * 2 * len(opt.opt.params)
+
+    sd = opt.state_dict()
+    assert sd["step"] == 4
+    for m in sd["exp_avg"]:
+        assert np.isfinite(m).all()
