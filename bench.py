@@ -84,7 +84,10 @@ def main():
     on_tpu = jax.default_backend() == "tpu"
     peak_tflops = float(os.environ.get("BENCH_PEAK_TFLOPS", "197"))
     if on_tpu:
-        name = os.environ.get("BENCH_MODEL", "gpt2")
+        # default: GPT-2 350M ZeRO-1 (BASELINE.json config #2) — the best
+        # measured headline on one chip (125M stage-0 underfills the MXU;
+        # larger models exceed this chip's compile/memory limits)
+        name = os.environ.get("BENCH_MODEL", "gpt2-medium")
         if name not in bench_shapes:
             raise SystemExit(f"BENCH_MODEL must be one of "
                              f"{sorted(bench_shapes)}, got {name!r}")

@@ -97,11 +97,17 @@ def get_process_count():
 
 
 def barrier():
-    """Block until all outstanding device work on all hosts completes."""
-    # A psum over a tiny array jitted across all devices acts as a fence.
+    """Block until all HOSTS reach this point and their device work is
+    done (reference dist.barrier). Multi-process runs use the runtime's
+    cross-host sync collective; a single process only needs the local
+    dispatch fence."""
+    jax.effects_barrier()  # flush ordered effects (host callbacks) first
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("deepspeed_tpu.barrier")
+        return
     x = jnp.zeros((), dtype=jnp.float32)
     jax.block_until_ready(x + 0)
-    jax.effects_barrier()
 
 
 # ---------------------------------------------------------------------------

@@ -52,6 +52,16 @@ class GPT2Config:
     # shards the SEQUENCE over the named mesh axis (SURVEY.md §5.7 — the
     # modern long-context equivalent of the reference's sparse attention)
     attention_mode: str = "auto"
+    # MoE-GPT (BASELINE.json config #4): >0 turns every
+    # ``moe_expert_interval``-th block's MLP into a deepspeed MoE layer
+    # (the reference Megatron-Deepspeed MoE-GPT recipe: experts on
+    # alternate layers, aux loss added to the LM loss)
+    moe_num_experts: int = 0
+    moe_expert_interval: int = 2
+    moe_k: int = 1
+    moe_capacity_factor: float = 1.25
+    moe_eval_capacity_factor: float = 1.25
+    moe_aux_loss_coef: float = 0.01
     dtype: jnp.dtype = jnp.float32     # activation compute dtype is set by
                                        # the engine via param cast; this is
                                        # only for explicitly built models
@@ -179,6 +189,31 @@ class Block(nn.Module):
         return x
 
 
+class MoEBlock(nn.Module):
+    """Transformer block whose MLP is a mixture of experts; returns
+    (x, l_aux) so the model can add the load-balancing loss."""
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, deterministic=True, decode=False):
+        cfg = self.config
+        x = x + CausalSelfAttention(cfg, name="attn")(
+            nn.LayerNorm(epsilon=1e-5, name="ln_1")(x), deterministic,
+            decode)
+        from deepspeed_tpu.moe.layer import MoE
+        h = nn.LayerNorm(epsilon=1e-5, name="ln_2")(x)
+        B, S, E = h.shape
+        out, l_aux, _ = MoE(hidden_size=E,
+                            num_experts=cfg.moe_num_experts,
+                            k=cfg.moe_k,
+                            capacity_factor=cfg.moe_capacity_factor,
+                            eval_capacity_factor=(
+                                cfg.moe_eval_capacity_factor),
+                            name="moe")(h.reshape(B * S, E),
+                                        train=not deterministic)
+        return x + out.reshape(B, S, E), l_aux
+
+
 class _PipeBlock(nn.Module):
     """Block adapted to the GPipe stage-body signature (single tensor
     arg); the deterministic flag is baked in at construction."""
@@ -232,6 +267,11 @@ class GPT2LMHeadModel(nn.Module):
         if cfg.dropout > 0:
             x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
 
+        moe_aux = jnp.float32(0.0)
+        if cfg.moe_num_experts > 0:
+            assert cfg.pp_stages == 1, (
+                "MoE blocks are not expressible in the uniform GPipe "
+                "stack; use the host-loop PipelineEngine for MoE + pp")
         if cfg.pp_stages > 1:
             # pipelined middle: blocks stream over the mesh pipe axis
             # (embedding/head stay outside, like the reference's first/last
@@ -248,10 +288,22 @@ class GPT2LMHeadModel(nn.Module):
                       name="pipe")(x)
         else:
             block = Block
+            moe_block = MoEBlock
             if cfg.remat:
                 block = nn.remat(Block, static_argnums=(2, 3))
+                moe_block = nn.remat(MoEBlock, static_argnums=(2, 3))
             for i in range(cfg.n_layer):
-                x = block(cfg, name=f"h_{i}")(x, deterministic, decode)
+                # every interval-th block, counting from the first so
+                # interval=1 means every block (Megatron-Deepspeed places
+                # experts on alternate layers with interval=2)
+                is_moe = (cfg.moe_num_experts > 0 and
+                          (i + 1) % cfg.moe_expert_interval == 0)
+                if is_moe:
+                    x, l_aux = moe_block(cfg, name=f"h_{i}")(
+                        x, deterministic, decode)
+                    moe_aux = moe_aux + l_aux
+                else:
+                    x = block(cfg, name=f"h_{i}")(x, deterministic, decode)
         x = nn.LayerNorm(epsilon=1e-5, name="ln_f")(x)
 
         # tied LM head; fp32 logits for a stable softmax
@@ -269,7 +321,8 @@ class GPT2LMHeadModel(nn.Module):
         ll = jnp.take_along_axis(logp, shift_labels[..., None], axis=-1)
         # ignore_index=-100 convention (masked positions)
         valid = (shift_labels >= 0).astype(jnp.float32)
-        return -(ll[..., 0] * valid).sum() / jnp.maximum(valid.sum(), 1.0)
+        ce = -(ll[..., 0] * valid).sum() / jnp.maximum(valid.sum(), 1.0)
+        return ce + cfg.moe_aux_loss_coef * moe_aux
 
 
 def gpt2_tp_rules():

@@ -34,7 +34,14 @@ class MoE(nn.Module):
     """Mixture of experts layer (reference moe/layer.py:18).
 
     Returns (output, l_aux, exp_counts) exactly like the reference's
-    ``MoE.forward`` (layer.py:98)."""
+    ``MoE.forward`` (layer.py:98).
+
+    Memory note: ``drop_tokens=False`` sets capacity C = S (tokens) since
+    jit needs static shapes where the reference grows capacity to the
+    observed max (sharded_moe.py:207) — the [S, E, C] dispatch/combine
+    tensors then scale as S²·E. Prefer ``drop_tokens=True`` with a
+    ``capacity_factor`` margin for long sequences; C is then
+    S·k·factor/E."""
     hidden_size: int
     expert: Any = None                  # flax module CLASS for one expert
     expert_kwargs: Optional[dict] = None

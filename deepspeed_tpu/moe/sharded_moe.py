@@ -121,6 +121,12 @@ def top2gating(logits, capacity_factor=1.0, min_capacity=4, noise_rng=None):
     if noise_rng is not None:
         logits_w_noise = logits + jax.random.gumbel(noise_rng, logits.shape)
     else:
+        # DELIBERATE deviation from the reference, which gumbel-samples
+        # the second expert even at eval (gumbel_rsample, :271): without
+        # an rng (eval / _jit_eval) we use the noise-free argmax — a
+        # fixed jit-able key would reuse ONE noise matrix across every
+        # layer and batch, biasing routing by position. Training passes
+        # the engine's fresh "gating" rng and matches the reference.
         logits_w_noise = logits
     logits_except1 = jnp.where(mask1.astype(bool), -jnp.inf, logits_w_noise)
     indices2_s = jnp.argmax(logits_except1, axis=1)

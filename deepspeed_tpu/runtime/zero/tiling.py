@@ -1,0 +1,79 @@
+"""TiledLinear — a huge Linear split into a tile grid.
+
+Rebuild of deepspeed/runtime/zero/tiling.py:27 (``TiledLinear``,
+``TiledLinearReturnBias`` :257): the reference splits a giant nn.Linear
+into in_splits x out_splits smaller Linears so ZeRO-3 fetches one tile at
+a time instead of the whole weight. Under XLA the same decomposition pays
+off differently but for the same reason — each tile is an independent
+param leaf, so the ZeRO-3 sharder, the param-offload store, and the
+checkpoint layout all operate at tile granularity (a 50k x 50k fp32
+weight becomes 16 leaves of 625M instead of one 10GB leaf).
+
+Math parity: out[:, oc] = sum_ic x[:, ic] @ W[ic, oc] (+ bias[oc]), which
+is exactly the untitled Linear for any split counts.
+"""
+
+from typing import Callable, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def split_dim(total: int, parts: int):
+    """(sizes, bounds) of the reference's near-even split — delegates to
+    the one partition_uniform implementation (runtime/pipe/module.py)."""
+    from deepspeed_tpu.runtime.pipe.module import partition_uniform
+    bounds = partition_uniform(total, parts)
+    sizes = [bounds[i + 1] - bounds[i] for i in range(parts)]
+    return sizes, bounds
+
+
+class TiledLinear(nn.Module):
+    """in_splits x out_splits grid of Dense tiles == one big Linear."""
+    in_features: int
+    out_features: int
+    in_splits: int = 1
+    out_splits: int = 1
+    use_bias: bool = True
+    kernel_init: Callable = nn.initializers.lecun_normal()
+    bias_init: Callable = nn.initializers.zeros_init()
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x):
+        assert x.shape[-1] == self.in_features, (
+            f"expected {self.in_features} input features, got {x.shape}")
+        in_sizes, in_bounds = split_dim(self.in_features, self.in_splits)
+        out_sizes, _ = split_dim(self.out_features, self.out_splits)
+
+        outs = []
+        for oc, osz in enumerate(out_sizes):
+            acc = None
+            for ic, isz in enumerate(in_sizes):
+                xin = x[..., in_bounds[ic]:in_bounds[ic + 1]]
+                # bias lives on the last input tile only (added once)
+                tile = nn.Dense(
+                    osz, use_bias=self.use_bias and ic == len(in_sizes) - 1,
+                    kernel_init=self.kernel_init, bias_init=self.bias_init,
+                    dtype=self.dtype, name=f"tile_{ic}_{oc}")(xin)
+                acc = tile if acc is None else acc + tile
+            outs.append(acc)
+        return jnp.concatenate(outs, axis=-1)
+
+
+class TiledLinearReturnBias(TiledLinear):
+    """Variant returning (out_without_bias, bias) — the reference's form
+    for megatron row-parallel layers that defer the bias add until after
+    the allreduce (tiling.py:257)."""
+
+    @nn.compact
+    def __call__(self, x):
+        out = TiledLinear(
+            in_features=self.in_features, out_features=self.out_features,
+            in_splits=self.in_splits, out_splits=self.out_splits,
+            use_bias=False, kernel_init=self.kernel_init,
+            dtype=self.dtype, name="tiles")(x)
+        bias = None
+        if self.use_bias:
+            bias = self.param("bias", self.bias_init, (self.out_features,))
+        return out, bias
