@@ -96,7 +96,14 @@ class InferenceEngine:
                 "this flax/pickle checkpoint loads UNQUANTIZED", ranks=[0])
         sd = load_checkpoint_file(path)
         if isinstance(sd, dict) and "module" in sd:
-            return sd["module"]
+            sd = sd["module"]
+        if isinstance(sd, dict):
+            from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel
+            from deepspeed_tpu.runtime.state_dict_factory import (
+                hf_gpt2_to_params, is_hf_gpt2_state_dict)
+            if isinstance(self.module, GPT2LMHeadModel) and \
+                    is_hf_gpt2_state_dict(sd):
+                return hf_gpt2_to_params(sd, self.module.config)
         return sd
 
     def _apply_weight_quantization(self, module_sd):

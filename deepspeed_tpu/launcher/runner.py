@@ -12,6 +12,12 @@ launcher resolves the host list the same way and then either:
   ``JAX_COORDINATOR_ADDRESS``/``JAX_PROCESS_COUNT``/``JAX_PROCESS_ID``
   env (consumed by comm.init_distributed → jax.distributed.initialize),
   over ssh when ``--launcher ssh`` (pdsh analogue).
+
+Deliberate scope decision (vs reference multinode_runner.py PDSH/OpenMPI/
+MVAPICH): TPU pods do not use MPI launchers — rendezvous is jax's own
+coordinator, host fan-out is plain ssh (or the pod orchestrator, e.g.
+``gcloud compute tpus tpu-vm ssh --worker=all``). MPI/pdsh runners are
+therefore intentionally absent, not missing.
 """
 
 import argparse

@@ -454,6 +454,56 @@ def megatron_to_gpt2_params(client_sd: Dict[str, Any], config,
     return p
 
 
+def is_hf_gpt2_state_dict(sd: Dict[str, Any]) -> bool:
+    """Heuristic: HuggingFace GPT-2 naming (transformer.h.N.attn.c_attn)."""
+    return any("attn.c_attn.weight" in k for k in sd)
+
+
+def hf_gpt2_to_params(state_dict: Dict[str, Any], config) -> Dict:
+    """Map a HuggingFace GPT-2 state dict (torch ``GPT2LMHeadModel``
+    naming) onto this package's flax params — the HF half of the
+    reference's checkpoint interop (state_dict_factory + module_inject
+    HFGPT2LayerPolicy). HF's Conv1D stores weights [in, out], which is
+    already the flax kernel layout (no transpose, unlike Megatron)."""
+    E = config.n_embd
+
+    def get(name):
+        for k in (name, f"transformer.{name}"):
+            if k in state_dict:
+                return np.asarray(state_dict[k], np.float32)
+        raise KeyError(name)
+
+    p: Dict[str, Any] = {}
+    wte = get("wte.weight")
+    if wte.shape[0] < config.padded_vocab:
+        wte = np.pad(wte, [(0, config.padded_vocab - wte.shape[0]), (0, 0)])
+    p["wte"] = wte
+    p["wpe"] = get("wpe.weight")
+    p["ln_f"] = {"scale": get("ln_f.weight"), "bias": get("ln_f.bias")}
+    for i in range(config.n_layer):
+        pre = f"h.{i}"
+        blk = {
+            "ln_1": {"scale": get(f"{pre}.ln_1.weight"),
+                     "bias": get(f"{pre}.ln_1.bias")},
+            "ln_2": {"scale": get(f"{pre}.ln_2.weight"),
+                     "bias": get(f"{pre}.ln_2.bias")},
+            "attn": {
+                "qkv": {"kernel": get(f"{pre}.attn.c_attn.weight"),
+                        "bias": get(f"{pre}.attn.c_attn.bias")},
+                "proj": {"kernel": get(f"{pre}.attn.c_proj.weight"),
+                         "bias": get(f"{pre}.attn.c_proj.bias")}},
+            "mlp": {
+                "fc": {"kernel": get(f"{pre}.mlp.c_fc.weight"),
+                       "bias": get(f"{pre}.mlp.c_fc.bias")},
+                "proj": {"kernel": get(f"{pre}.mlp.c_proj.weight"),
+                         "bias": get(f"{pre}.mlp.c_proj.bias")}},
+        }
+        assert blk["attn"]["qkv"]["kernel"].shape == (E, 3 * E), \
+            blk["attn"]["qkv"]["kernel"].shape
+        p[f"h_{i}"] = blk
+    return p
+
+
 def gpt2_params_to_megatron(params: Dict, config) -> Dict[str, Any]:
     """Inverse of :func:`megatron_to_gpt2_params` (checkpoint tooling +
     round-trip tests)."""
