@@ -473,12 +473,24 @@ def hf_gpt2_to_params(state_dict: Dict[str, Any], config) -> Dict:
                 return np.asarray(state_dict[k], np.float32)
         raise KeyError(name)
 
+    # fail fast on config/checkpoint mismatch (a silent drop of extra
+    # layers or a short wpe would serve wrong-but-plausible logits)
+    ckpt_layers = 1 + max(
+        (int(k.split("h.")[1].split(".")[0]) for k in state_dict
+         if ".h." in k or k.startswith("h.")), default=-1)
+    assert ckpt_layers == config.n_layer, (
+        f"checkpoint has {ckpt_layers} transformer layers but the model "
+        f"config says n_layer={config.n_layer}")
+
     p: Dict[str, Any] = {}
     wte = get("wte.weight")
     if wte.shape[0] < config.padded_vocab:
         wte = np.pad(wte, [(0, config.padded_vocab - wte.shape[0]), (0, 0)])
     p["wte"] = wte
     p["wpe"] = get("wpe.weight")
+    assert p["wpe"].shape[0] >= config.n_positions, (
+        f"checkpoint wpe covers {p['wpe'].shape[0]} positions but the "
+        f"model config says n_positions={config.n_positions}")
     p["ln_f"] = {"scale": get("ln_f.weight"), "bias": get("ln_f.bias")}
     for i in range(config.n_layer):
         pre = f"h.{i}"
