@@ -25,7 +25,15 @@ def _interpret():
     return jax.default_backend() != "tpu"
 
 
-def _row_block(n_rows, target=256):
+def _row_block(n_rows, hidden, budget_bytes=2 << 20):
+    """Rows per block, bounded so one fp32 block stays within a VMEM
+    budget — Pallas double-buffers every in/out block, so unbounded
+    (rows, hidden) tiles blow the ~16 MiB scoped VMEM at large hidden
+    (e.g. the 4096-wide BERT-large MLP)."""
+    target = max(1, budget_bytes // (4 * hidden))
+    # floor to a power of two so power-of-two row counts divide cleanly
+    # (682 -> 512, not a halving cascade down to 2)
+    target = 1 << (target.bit_length() - 1)
     b = min(n_rows, target)
     while n_rows % b:
         b //= 2
@@ -73,7 +81,7 @@ def _ln_fwd(x, gamma, beta, eps):
     h = orig_shape[-1]
     xf = x.reshape(-1, h)
     n = xf.shape[0]
-    bn = _row_block(n)
+    bn = _row_block(n, h)
     y, mu, rstd = pl.pallas_call(
         functools.partial(_ln_fwd_kernel, eps=eps, lanes=LANES),
         grid=(n // bn,),
@@ -107,7 +115,7 @@ def _ln_bwd(eps, res, dy):
     h = xf.shape[-1]
     dyf = dy.reshape(-1, h)
     n = xf.shape[0]
-    bn = _row_block(n)
+    bn = _row_block(n, h)
     dx = pl.pallas_call(
         functools.partial(_ln_bwd_kernel, lanes=LANES),
         grid=(n // bn,),
@@ -150,7 +158,7 @@ def _bias_gelu_fwd_impl(x, bias):
     h = orig_shape[-1]
     xf = x.reshape(-1, h)
     n = xf.shape[0]
-    bn = _row_block(n)
+    bn = _row_block(n, h)
     y = pl.pallas_call(
         _bias_gelu_kernel,
         grid=(n // bn,),
@@ -207,7 +215,7 @@ def fused_softmax(x, scale=1.0):
     h = orig_shape[-1]
     xf = x.reshape(-1, h)
     n = xf.shape[0]
-    bn = _row_block(n)
+    bn = _row_block(n, h)
     y = pl.pallas_call(
         functools.partial(_softmax_kernel, scale=scale),
         grid=(n // bn,),
