@@ -62,6 +62,10 @@ class GPT2Config:
     moe_capacity_factor: float = 1.25
     moe_eval_capacity_factor: float = 1.25
     moe_aux_loss_coef: float = 0.01
+    # "auto" keeps K/V in the activation dtype; "int8" stores the decode
+    # cache quantized (per-row absmax scales) — half the cache HBM, the
+    # dequant folds into the decode kernel's matmuls
+    kv_cache_dtype: str = "auto"
     dtype: jnp.dtype = jnp.float32     # activation compute dtype is set by
                                        # the engine via param cast; this is
                                        # only for explicitly built models
@@ -112,34 +116,63 @@ class CausalSelfAttention(nn.Module):
             # Later calls = one-token steps: append at cache_index, run the
             # decode kernel over the live prefix.
             from deepspeed_tpu.ops.transformer.decode import (
-                aligned_cache_len, decode_attention)
+                aligned_cache_len, decode_attention,
+                decode_attention_quantized, quantize_kv)
             is_step = self.has_variable("cache", "cached_key")
+            assert cfg.kv_cache_dtype in ("auto", "int8"), (
+                f"kv_cache_dtype must be 'auto' or 'int8', got "
+                f"{cfg.kv_cache_dtype!r}")
+            int8_cache = cfg.kv_cache_dtype == "int8"
             # block-aligned allocation: avoids a whole-cache pad copy per
             # decode step inside decode_attention
             T = aligned_cache_len(cfg.n_positions)
+            cache_dtype = jnp.int8 if int8_cache else k.dtype
             ck = self.variable("cache", "cached_key", jnp.zeros,
-                               (B, H, T, D), k.dtype)
+                               (B, H, T, D), cache_dtype)
             cv = self.variable("cache", "cached_value", jnp.zeros,
-                               (B, H, T, D), v.dtype)
+                               (B, H, T, D), cache_dtype)
             ci = self.variable("cache", "cache_index",
                                lambda: jnp.zeros((), jnp.int32))
+            if int8_cache:
+                cks = self.variable("cache", "cached_key_scale", jnp.zeros,
+                                    (B, H, T), jnp.float32)
+                cvs = self.variable("cache", "cached_value_scale",
+                                    jnp.zeros, (B, H, T), jnp.float32)
+
+            def write(pos, k_new, v_new):
+                if int8_cache:
+                    kq, ks = quantize_kv(k_new)
+                    vq, vs = quantize_kv(v_new)
+                    ck.value = jax.lax.dynamic_update_slice(
+                        ck.value, kq, (0, 0, pos, 0))
+                    cv.value = jax.lax.dynamic_update_slice(
+                        cv.value, vq, (0, 0, pos, 0))
+                    cks.value = jax.lax.dynamic_update_slice(
+                        cks.value, ks, (0, 0, pos))
+                    cvs.value = jax.lax.dynamic_update_slice(
+                        cvs.value, vs, (0, 0, pos))
+                else:
+                    ck.value = jax.lax.dynamic_update_slice(
+                        ck.value, k_new, (0, 0, pos, 0))
+                    cv.value = jax.lax.dynamic_update_slice(
+                        cv.value, v_new, (0, 0, pos, 0))
+
             if not is_step:
-                ck.value = jax.lax.dynamic_update_slice(ck.value, k,
-                                                        (0, 0, 0, 0))
-                cv.value = jax.lax.dynamic_update_slice(cv.value, v,
-                                                        (0, 0, 0, 0))
+                write(0, k, v)
                 ci.value = jnp.asarray(S, jnp.int32)
                 out = attention(q, k, v, causal=True, use_flash=cfg.use_flash)
             else:
                 assert S == 1, f"decode steps take one token, got {S}"
                 idx = ci.value
-                ck.value = jax.lax.dynamic_update_slice(ck.value, k,
-                                                        (0, 0, idx, 0))
-                cv.value = jax.lax.dynamic_update_slice(cv.value, v,
-                                                        (0, 0, idx, 0))
+                write(idx, k, v)
                 ci.value = idx + 1
-                out = decode_attention(q, ck.value, cv.value, idx + 1,
-                                       use_flash=cfg.use_flash)
+                if int8_cache:
+                    out = decode_attention_quantized(
+                        q, ck.value, cks.value, cv.value, cvs.value,
+                        idx + 1, use_flash=cfg.use_flash)
+                else:
+                    out = decode_attention(q, ck.value, cv.value, idx + 1,
+                                           use_flash=cfg.use_flash)
         elif cfg.attention_mode.startswith(("ring:", "ulysses:")):
             from deepspeed_tpu.ops.transformer.ring import (
                 ring_attention, ulysses_attention)

@@ -51,7 +51,7 @@ def aligned_cache_len(n_positions: int) -> int:
 
 
 def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, sm_scale,
-                   block_k):
+                   block_k, quantized=False, ks_ref=None, vs_ref=None):
     length = len_ref[0]
     q = q_ref[0]  # [QROWS, D]
 
@@ -59,8 +59,15 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, sm_scale,
         acc, m, l = carry
         k = k_ref[0, pl.ds(j * block_k, block_k), :]
         v = v_ref[0, pl.ds(j * block_k, block_k), :]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+        s = jax.lax.dot_general(q, k.astype(q.dtype),
+                                (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
+        if quantized:
+            # int8 cache: one absmax scale per cached row (the reference's
+            # int8 dequant, csrc/transformer/inference/csrc/dequantize.cu)
+            # folds into the score/value matmuls column-wise
+            ks = ks_ref[0, pl.ds(j * block_k, block_k), 0]      # [BK]
+            s = s * ks[None, :]
         cols = j * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (QROWS, block_k), 1)
         s = jnp.where(cols < length, s, NEG_INF)
@@ -68,9 +75,18 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, sm_scale,
         p = jnp.exp(s - m_new[:, None])
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=1)
-        acc = acc * alpha[:, None] + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        if quantized:
+            vs = vs_ref[0, pl.ds(j * block_k, block_k), 0]      # [BK]
+            # int8 magnitudes (≤127) are exact in bf16, so the value
+            # matmul runs at full bf16 MXU rate like the fp path
+            pv = (p * vs[None, :]).astype(jnp.bfloat16)
+            acc = acc * alpha[:, None] + jax.lax.dot_general(
+                pv, v.astype(jnp.bfloat16), (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        else:
+            acc = acc * alpha[:, None] + jax.lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
         return acc, m_new, l_new
 
     d = q.shape[-1]
@@ -83,16 +99,21 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, sm_scale,
     o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
 
 
-def decode_attention(q, k_cache, v_cache, cache_len, *, sm_scale=None,
-                     use_flash=None):
+def decode_attention(q, k_cache, v_cache, cache_len, *, k_scale=None,
+                     v_scale=None, sm_scale=None, use_flash=None):
     """softmax(q·K[:len]ᵀ)·V[:len] for one decode step.
 
     q: [B, H, 1, D]; k_cache/v_cache: [B, H, T, D] (T = allocated cache);
     cache_len: scalar int32, number of valid cache positions (the current
-    token's K/V must already be written). Returns [B, H, 1, D].
+    token's K/V must already be written). With ``k_scale``/``v_scale``
+    ([B, H, T] fp32 per-row scales) the caches are int8 and dequant folds
+    into the kernel's matmuls (the reference's int8 path,
+    csrc/transformer/inference/csrc/dequantize.cu). Returns [B, H, 1, D].
     """
     B, H, Sq, D = q.shape
     assert Sq == 1, f"decode_attention takes one query token, got {Sq}"
+    quantized = k_scale is not None
+    assert quantized == (v_scale is not None)
     T = k_cache.shape[2]
     if sm_scale is None:
         sm_scale = D ** -0.5
@@ -100,8 +121,12 @@ def decode_attention(q, k_cache, v_cache, cache_len, *, sm_scale=None,
         from deepspeed_tpu.ops.transformer.attention import _flash_available
         use_flash = _flash_available()
     if not use_flash:
+        k, v = k_cache, v_cache
+        if quantized:
+            k = (k.astype(jnp.float32) * k_scale[..., None]).astype(q.dtype)
+            v = (v.astype(jnp.float32) * v_scale[..., None]).astype(q.dtype)
         mask = (jnp.arange(T) < cache_len)[None, None, None, :]
-        return mha_reference(q, k_cache, v_cache, causal=False,
+        return mha_reference(q, k, v, causal=False,
                              sm_scale=sm_scale, mask=mask)
 
     # pad the cache dim to a block multiple rather than shrinking the
@@ -116,22 +141,63 @@ def decode_attention(q, k_cache, v_cache, cache_len, *, sm_scale=None,
         pad = [(0, 0), (0, 0), (0, Tp - T), (0, 0)]
         k_cache = jnp.pad(k_cache, pad)
         v_cache = jnp.pad(v_cache, pad)
+        if quantized:
+            pad2 = [(0, 0), (0, 0), (0, Tp - T)]
+            k_scale = jnp.pad(k_scale, pad2)
+            v_scale = jnp.pad(v_scale, pad2)
     qf = jnp.broadcast_to(q.reshape(B * H, 1, D), (B * H, QROWS, D))
     kf = k_cache.reshape(B * H, Tp, D)
     vf = v_cache.reshape(B * H, Tp, D)
     len_arr = jnp.asarray(cache_len, jnp.int32).reshape(1)
 
+    cache_spec = pl.BlockSpec((1, Tp, D), lambda b: (b, 0, 0))
+    scale_spec = pl.BlockSpec((1, Tp, 1), lambda b: (b, 0, 0))
+    in_specs = [pl.BlockSpec(memory_space=_SMEM),
+                pl.BlockSpec((1, QROWS, D), lambda b: (b, 0, 0)),
+                cache_spec, cache_spec]
+    operands = [len_arr, qf, kf, vf]
+    if quantized:
+        in_specs += [scale_spec, scale_spec]
+        operands += [k_scale.reshape(B * H, Tp, 1).astype(jnp.float32),
+                     v_scale.reshape(B * H, Tp, 1).astype(jnp.float32)]
+
+        def kernel(len_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref):
+            _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref,
+                           sm_scale=sm_scale, block_k=block_k,
+                           quantized=True, ks_ref=ks_ref, vs_ref=vs_ref)
+    else:
+        kernel = functools.partial(_decode_kernel, sm_scale=sm_scale,
+                                   block_k=block_k)
+
     out = pl.pallas_call(
-        functools.partial(_decode_kernel, sm_scale=sm_scale, block_k=block_k),
+        kernel,
         grid=(B * H,),
-        in_specs=[
-            pl.BlockSpec(memory_space=_SMEM),
-            pl.BlockSpec((1, QROWS, D), lambda b: (b, 0, 0)),
-            pl.BlockSpec((1, Tp, D), lambda b: (b, 0, 0)),
-            pl.BlockSpec((1, Tp, D), lambda b: (b, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, QROWS, D), lambda b: (b, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, QROWS, D), q.dtype),
         interpret=jax.default_backend() != "tpu",
-    )(len_arr, qf, kf, vf)
+    )(*operands)
     return out[:, :1, :].reshape(B, H, 1, D)
+
+
+# ------------------------------------------------------- int8 KV cache path
+def quantize_kv(kv):
+    """Per-row absmax int8 quantization of new K/V entries: [B, H, S, D]
+    -> (int8 values, fp32 scales [B, H, S]). The reference stores fp16
+    KV and int8 weights; an int8 KV cache is the TPU-side extension that
+    halves cache HBM (dequant folds into the decode matmuls)."""
+    absmax = jnp.max(jnp.abs(kv.astype(jnp.float32)), axis=-1)
+    scale = absmax / 127.0
+    safe = jnp.where(scale == 0.0, 1.0, scale)
+    q = jnp.clip(jnp.round(kv.astype(jnp.float32) / safe[..., None]),
+                 -127, 127).astype(jnp.int8)
+    return q, jnp.where(scale == 0.0, 0.0, safe)
+
+
+def decode_attention_quantized(q, k_int, k_scale, v_int, v_scale, cache_len,
+                               *, sm_scale=None, use_flash=None):
+    """softmax(q·dequant(K)[:len]ᵀ)·dequant(V)[:len] over an int8 cache —
+    the named entry point for the int8 form of :func:`decode_attention`."""
+    return decode_attention(q, k_int, v_int, cache_len, k_scale=k_scale,
+                            v_scale=v_scale, sm_scale=sm_scale,
+                            use_flash=use_flash)
