@@ -66,6 +66,10 @@ class GPT2Config:
     # cache quantized (per-row absmax scales) — half the cache HBM, the
     # dequant folds into the decode kernel's matmuls
     kv_cache_dtype: str = "auto"
+    # "learned" = GPT-2 wpe table; "rope" = rotary embeddings on q/k
+    # (ops/transformer/rotary.py — the reference apply_rotary_pos_emb
+    # surface; interleaved-pair GPT-J convention)
+    position_embedding: str = "learned"
     dtype: jnp.dtype = jnp.float32     # activation compute dtype is set by
                                        # the engine via param cast; this is
                                        # only for explicitly built models
@@ -75,7 +79,8 @@ class GPT2Config:
         return _pad_vocab(self.vocab_size)
 
     def num_params(self) -> int:
-        wpe = self.n_positions * self.n_embd
+        wpe = 0 if self.position_embedding == "rope" \
+            else self.n_positions * self.n_embd
         wte = self.padded_vocab * self.n_embd
         per_layer = (12 * self.n_embd ** 2          # qkv+proj+fc1+fc2 kernels
                      + 13 * self.n_embd)            # biases + 2 LN
@@ -107,6 +112,11 @@ class CausalSelfAttention(nn.Module):
         q = q.reshape(B, S, H, D).transpose(0, 2, 1, 3)
         k = k.reshape(B, S, H, D).transpose(0, 2, 1, 3)
         v = v.reshape(B, S, H, D).transpose(0, 2, 1, 3)
+        if cfg.position_embedding == "rope":
+            from deepspeed_tpu.ops.transformer.rotary import \
+                apply_rotary_pos_emb
+        if not decode and cfg.position_embedding == "rope":
+            q, k = apply_rotary_pos_emb(q, k, offset=0)
         if decode:
             # KV-cache path (reference: softmax_context_* KV-cache attention,
             # csrc/transformer/inference/csrc/pt_binding.cpp:829; the cache
@@ -158,12 +168,16 @@ class CausalSelfAttention(nn.Module):
                         cv.value, v_new, (0, 0, pos, 0))
 
             if not is_step:
+                if cfg.position_embedding == "rope":
+                    q, k = apply_rotary_pos_emb(q, k, offset=0)
                 write(0, k, v)
                 ci.value = jnp.asarray(S, jnp.int32)
                 out = attention(q, k, v, causal=True, use_flash=cfg.use_flash)
             else:
                 assert S == 1, f"decode steps take one token, got {S}"
                 idx = ci.value
+                if cfg.position_embedding == "rope":
+                    q, k = apply_rotary_pos_emb(q, k, offset=idx)
                 write(idx, k, v)
                 ci.value = idx + 1
                 if int8_cache:
@@ -277,8 +291,13 @@ class GPT2LMHeadModel(nn.Module):
         B, S = input_ids.shape
         wte = self.param("wte", nn.initializers.normal(0.02),
                          (cfg.padded_vocab, cfg.n_embd))
-        wpe = self.param("wpe", nn.initializers.normal(0.01),
-                         (cfg.n_positions, cfg.n_embd))
+        assert cfg.position_embedding in ("learned", "rope"), (
+            f"position_embedding must be 'learned' or 'rope', got "
+            f"{cfg.position_embedding!r}")
+        rope = cfg.position_embedding == "rope"
+        wpe = None if rope else self.param(
+            "wpe", nn.initializers.normal(0.01),
+            (cfg.n_positions, cfg.n_embd))
         if decode:
             assert cfg.pp_stages == 1, "KV-cache decode incompatible with pp"
             assert not cfg.attention_mode.startswith(("ring:", "ulysses:")), \
@@ -288,15 +307,19 @@ class GPT2LMHeadModel(nn.Module):
             pi = self.variable("cache", "pos_index",
                                lambda: jnp.zeros((), jnp.int32))
             if not is_step:
-                pos_emb = wpe[None, :S]
+                pos_emb = None if rope else wpe[None, :S]
                 pi.value = jnp.asarray(S, jnp.int32)
             else:
-                pos_emb = jax.lax.dynamic_slice(
+                pos_emb = None if rope else jax.lax.dynamic_slice(
                     wpe, (pi.value, 0), (S, cfg.n_embd))[None]
                 pi.value = pi.value + S
-            x = wte[input_ids] + pos_emb.astype(wte.dtype)
+            x = wte[input_ids]
+            if pos_emb is not None:
+                x = x + pos_emb.astype(wte.dtype)
         else:
-            x = wte[input_ids] + wpe[None, :S].astype(wte.dtype)
+            x = wte[input_ids]
+            if not rope:
+                x = x + wpe[None, :S].astype(wte.dtype)
         if cfg.dropout > 0:
             x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
 

@@ -199,6 +199,30 @@ def _bias_gelu_bwd(res, dy):
 fused_bias_gelu.defvjp(_bias_gelu_fwd, _bias_gelu_bwd)
 
 
+# ----------------------------------- small fused inference ops (API parity)
+def bias_residual_add(x, bias, residual):
+    """x + bias + residual (reference ``bias_residual_*``,
+    pt_binding.cpp:829 surface). Elementwise — XLA fuses it into the
+    producing matmul; exposed for the deepspeed.ops parity surface."""
+    return x + bias + residual
+
+
+def residual_add(hidden, residual, attention_output=None, mp_size=1):
+    """The injected-inference residual merge (reference ``residual_add``):
+    hidden + residual (+ attention_output/mp_size when the tensor-sliced
+    layer defers the attention branch's allreduce)."""
+    out = hidden + residual
+    if attention_output is not None:
+        out = out + attention_output / mp_size
+    return out
+
+
+def moe_res_matmul(residual, coef, output):
+    """MoS residual mixing (reference ``moe_res_matmul``): out = output *
+    coef2 + residual * coef1 with coef [..., 2]."""
+    return output * coef[..., 1:2] + residual * coef[..., 0:1]
+
+
 # ------------------------------------------------- fused softmax (API parity)
 def _softmax_kernel(x_ref, y_ref, *, scale):
     x = x_ref[:].astype(jnp.float32) * scale

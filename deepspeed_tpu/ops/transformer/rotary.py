@@ -1,0 +1,55 @@
+"""Rotary position embeddings (RoPE).
+
+TPU-native equivalent of the reference inference kernel
+``apply_rotary_pos_emb`` (csrc/transformer/inference/csrc/
+apply_rotary_pos_emb.cu, binding pt_binding.cpp:829 surface): rotates
+each (even, odd) feature pair of Q and K by a position-dependent angle.
+Pure jnp — the op is elementwise + a tiny trig table, which XLA fuses
+into the surrounding QKV projection; a bespoke kernel would only add
+launch overhead on TPU.
+
+Layout: [B, H, S, D] (D even); ``offset`` positions the block inside a
+longer sequence (the decode case: offset = cache length so generated
+tokens continue the rotation).
+"""
+
+import jax.numpy as jnp
+
+
+def rotary_tables(seq_len, dim, base=10000.0, offset=0, dtype=jnp.float32):
+    """(cos, sin) tables [S, D/2] for positions offset..offset+S.
+    ``offset`` may be a traced scalar (decode: the live cache length)."""
+    inv_freq = 1.0 / (base ** (jnp.arange(0, dim, 2,
+                                          dtype=jnp.float32) / dim))
+    pos = jnp.arange(seq_len, dtype=jnp.float32) + offset
+    ang = pos[:, None] * inv_freq[None, :]               # [S, D/2]
+    return jnp.cos(ang).astype(dtype), jnp.sin(ang).astype(dtype)
+
+
+def apply_rotary_pos_emb(q, k, offset=0, base=10000.0, rotary_dim=None):
+    """Rotate q and k (reference apply_rotary_pos_emb).
+
+    Uses the INTERLEAVED-pair convention of original RoPE / GPT-J: pairs
+    are (x[2i], x[2i+1]). GPT-NeoX's half-split layout (x[i], x[i+D/2])
+    requires a feature permutation before/after. With ``rotary_dim`` only
+    the leading features rotate (partial rotary). Returns (q_rot, k_rot)
+    in the input dtype."""
+    B, H, S, D = q.shape
+    rd = rotary_dim or D
+    assert rd % 2 == 0, f"rotary dim must be even, got {rd}"
+    cos, sin = rotary_tables(S, rd, base=base, offset=offset)
+
+    def rot(x):
+        xr, rest = x[..., :rd], x[..., rd:]
+        x1 = xr[..., 0::2].astype(jnp.float32)
+        x2 = xr[..., 1::2].astype(jnp.float32)
+        c = cos[None, None]
+        s = sin[None, None]
+        o1 = x1 * c - x2 * s
+        o2 = x2 * c + x1 * s
+        out = jnp.stack([o1, o2], axis=-1).reshape(x1.shape[:-1] +
+                                                   (rd,)).astype(x.dtype)
+        return jnp.concatenate([out, rest], axis=-1) if rest.shape[-1] \
+            else out
+
+    return rot(q), rot(k)

@@ -522,7 +522,8 @@ def gpt2_params_to_megatron(params: Dict, config) -> Dict[str, Any]:
     sd: Dict[str, Any] = collections.OrderedDict()
     sd["word_embeddings.weight"] = np.asarray(
         params["wte"])[:config.vocab_size]
-    sd["position_embeddings.weight"] = np.asarray(params["wpe"])
+    if "wpe" in params:  # rope models have no learned position table
+        sd["position_embeddings.weight"] = np.asarray(params["wpe"])
     sd["transformer.final_layernorm.weight"] = np.asarray(
         params["ln_f"]["scale"])
     sd["transformer.final_layernorm.bias"] = np.asarray(

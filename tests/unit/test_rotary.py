@@ -1,0 +1,105 @@
+"""Rotary embeddings (ops/transformer/rotary.py — the reference
+apply_rotary_pos_emb surface) and the small fused inference parity ops."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.transformer.rotary import (apply_rotary_pos_emb,
+                                                  rotary_tables)
+
+
+def _qk(seed=0, B=1, H=2, S=16, D=32):
+    rng = np.random.default_rng(seed)
+    return (jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.float32),
+            jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.float32))
+
+
+def test_rotation_preserves_norm():
+    q, k = _qk()
+    qr, kr = apply_rotary_pos_emb(q, k)
+    np.testing.assert_allclose(np.linalg.norm(np.asarray(q), axis=-1),
+                               np.linalg.norm(np.asarray(qr), axis=-1),
+                               rtol=1e-5)
+
+
+def test_scores_depend_only_on_relative_position():
+    """RoPE's defining property: <rot(q, i), rot(k, j)> is a function of
+    (i - j) only."""
+    q, k = _qk(S=16)
+    qr, kr = apply_rotary_pos_emb(q, k)
+    # use the SAME base vectors at every position
+    q0 = jnp.broadcast_to(q[:, :, :1], q.shape)
+    k0 = jnp.broadcast_to(k[:, :, :1], k.shape)
+    q0r, k0r = apply_rotary_pos_emb(q0, k0)
+    scores = np.einsum("bhqd,bhkd->bhqk", np.asarray(q0r), np.asarray(k0r))
+    # all entries on one diagonal (fixed i-j) must be equal
+    for delta in (-3, 0, 5):
+        diag = np.diagonal(scores, offset=delta, axis1=2, axis2=3)
+        np.testing.assert_allclose(diag, diag[..., :1].repeat(
+            diag.shape[-1], -1), rtol=1e-4, atol=1e-4)
+
+
+def test_offset_continues_rotation():
+    """rot(x, offset)[:, :, t] == rot(x, 0)[:, :, offset + t] for equal
+    inputs — the decode-step contract."""
+    B, H, S, D = 1, 1, 12, 16
+    x = jnp.broadcast_to(_qk(S=1, B=B, H=H, D=D)[0], (B, H, S, D))
+    full, _ = apply_rotary_pos_emb(x, x, offset=0)
+    tail, _ = apply_rotary_pos_emb(x[:, :, :4], x[:, :, :4], offset=8)
+    np.testing.assert_allclose(np.asarray(tail), np.asarray(full[:, :, 8:]),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_partial_rotary_dim():
+    q, k = _qk(D=32)
+    qr, _ = apply_rotary_pos_emb(q, k, rotary_dim=16)
+    # untouched tail
+    np.testing.assert_array_equal(np.asarray(qr[..., 16:]),
+                                  np.asarray(q[..., 16:]))
+    assert not np.allclose(np.asarray(qr[..., 2:16]),
+                           np.asarray(q[..., 2:16]))
+
+
+def test_gpt2_rope_cached_generate_matches_recompute():
+    """RoPE + KV cache: the decode offset must continue the rotation —
+    greedy cached generation equals full recompute."""
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+    from deepspeed_tpu.utils import groups
+
+    cfg = GPT2Config(vocab_size=512, n_positions=128, n_embd=64,
+                     n_layer=2, n_head=4, position_embedding="rope")
+    model = GPT2LMHeadModel(cfg)
+    ids = jnp.asarray(np.random.default_rng(3).integers(
+        0, 512, (2, 12), dtype=np.int32))
+    params = model.init(jax.random.PRNGKey(0), {"input_ids": ids})["params"]
+    assert "wpe" not in params  # no learned table under rope
+    groups.destroy()
+    groups.initialize()
+    eng = InferenceEngine(model, params=params, dtype=jnp.float32)
+    a = eng.generate(ids, max_new_tokens=10, use_cache=True)
+    b = eng.generate(ids, max_new_tokens=10, use_cache=False)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_fused_parity_ops():
+    from deepspeed_tpu.ops.transformer.fused import (bias_residual_add,
+                                                     moe_res_matmul,
+                                                     residual_add)
+    rng = np.random.default_rng(4)
+    x, b, r = (jnp.asarray(rng.standard_normal((2, 8)), jnp.float32)
+               for _ in range(3))
+    np.testing.assert_allclose(np.asarray(bias_residual_add(x, b, r)),
+                               np.asarray(x + b + r))
+    att = jnp.asarray(rng.standard_normal((2, 8)), jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(residual_add(x, r, attention_output=att, mp_size=2)),
+        np.asarray(x + r + att / 2))
+    coef = jnp.asarray(rng.standard_normal((2, 2)), jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(moe_res_matmul(r, coef, x)),
+        np.asarray(x * coef[..., 1:2] + r * coef[..., 0:1]))
