@@ -754,6 +754,28 @@ class DeepSpeedEngine:
         import deepspeed_tpu.comm as dist
         if tag is None:
             tag = f"global_step{self.global_steps}"
+        if self.config.checkpoint_tag_validation_enabled:
+            # reference _checkpoint_tag_validation (engine.py:2693) +
+            # stage3's cross-rank consistency asserts: silently diverged
+            # hosts must not write a mixed checkpoint
+            from deepspeed_tpu.utils.debug import (
+                assert_bytes_same_as_other_ranks,
+                assert_ints_same_as_other_ranks,
+                assert_shapes_same_as_other_ranks)
+            try:
+                assert_bytes_same_as_other_ranks(str(tag).encode(),
+                                                 tag="checkpoint-tag")
+                assert_ints_same_as_other_ranks(
+                    [self.global_steps, self.micro_steps],
+                    tag="save_checkpoint")
+                assert_shapes_same_as_other_ranks(self.state.params,
+                                                  tag="params")
+            except AssertionError as e:
+                if self.config.checkpoint_tag_validation_fail:
+                    raise
+                log_dist(f"WARNING: cross-rank checkpoint mismatch "
+                         f"({e}); writing anyway (validation mode Warn)",
+                         ranks=[0])
         os.makedirs(os.path.join(save_dir, str(tag)), exist_ok=True)
 
         self._save_zero_checkpoint(save_dir, tag)

@@ -1,0 +1,68 @@
+"""Cross-rank consistency checks.
+
+The reference has no sanitizer integration (SURVEY.md §5.2); what it does
+have — and what transfers — is ZeRO-3's cross-rank trace-consistency
+assertion (``assert_ints_same_as_other_ranks``, stage3.py:271 /
+runtime/utils.py): cheap collectives that catch silently-diverged hosts
+(different step counters, different schedules, different shapes) before
+they corrupt a checkpoint or hang a collective with a shape mismatch.
+"""
+
+from typing import Sequence
+
+import numpy as np
+
+import jax
+
+
+def assert_ints_same_as_other_ranks(values: Sequence[int], tag: str = ""):
+    """Assert every process passes identical ints (reference stage3.py:271).
+
+    Single-process runs are trivially consistent (no-op). Multi-process:
+    a process_allgather compares all hosts' values and raises on the
+    FIRST divergence with a per-rank dump — the failure you want instead
+    of a mismatched-collective hang three steps later."""
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+    arr = np.asarray(list(values), np.int64)
+    gathered = np.asarray(multihost_utils.process_allgather(arr))
+    if not (gathered == gathered[0]).all():
+        bad = {r: gathered[r].tolist() for r in range(gathered.shape[0])}
+        raise AssertionError(
+            f"cross-rank int divergence{f' [{tag}]' if tag else ''}: {bad}")
+
+
+def assert_bytes_same_as_other_ranks(data: bytes, tag: str = "",
+                                     max_len: int = 256):
+    """Assert every process passes identical bytes (checkpoint tags,
+    config digests). The bytes themselves are compared — not a lossy
+    length/sum fingerprint — padded to ``max_len`` for the allgather."""
+    if jax.process_count() == 1:
+        return
+    assert len(data) <= max_len, f"data too long for byte compare: {len(data)}"
+    buf = np.zeros(max_len + 8, np.uint8)
+    buf[:8] = np.frombuffer(np.int64(len(data)).tobytes(), np.uint8)
+    buf[8:8 + len(data)] = np.frombuffer(data, np.uint8)
+    from jax.experimental import multihost_utils
+    gathered = np.asarray(multihost_utils.process_allgather(buf))
+    if not (gathered == gathered[0]).all():
+        raise AssertionError(
+            f"cross-rank byte divergence{f' [{tag}]' if tag else ''}: "
+            f"rank 0 has {data!r}")
+
+
+def assert_shapes_same_as_other_ranks(tree, tag: str = ""):
+    """Assert a pytree's leaf shapes/dtypes agree across processes —
+    the trace-consistency guard for declaratively sharded state."""
+    if jax.process_count() == 1:
+        return
+    import hashlib
+    leaves = jax.tree.leaves(tree)
+    joined = ";".join(
+        f"{getattr(leaf, 'shape', ())}/{getattr(leaf, 'dtype', '')}"
+        for leaf in leaves)
+    h = int.from_bytes(
+        hashlib.blake2b(joined.encode(), digest_size=7).digest(), "big")
+    assert_ints_same_as_other_ranks([h, len(leaves)],
+                                    tag=tag or "tree-shapes")

@@ -149,3 +149,14 @@ def test_get_model_profile_flax():
         m, params=params, batch=x, as_string=False, print_profile=False)
     assert nparams == 16 * 32 + 32
     assert flops > 0
+
+
+def test_cross_rank_consistency_asserts_single_process():
+    """Single-process: trivially consistent (the multi-process path needs
+    a real multi-host run; the API contract is exercised here)."""
+    from deepspeed_tpu.utils.debug import (
+        assert_ints_same_as_other_ranks, assert_shapes_same_as_other_ranks)
+    import jax.numpy as jnp
+    assert_ints_same_as_other_ranks([1, 2, 3], tag="t")
+    assert_shapes_same_as_other_ranks({"a": jnp.zeros((2, 3)),
+                                       "b": jnp.zeros((4,), jnp.int32)})
