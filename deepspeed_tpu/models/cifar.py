@@ -1,0 +1,47 @@
+"""CIFAR-10 CNN — BASELINE.json config #1 ("CIFAR-10 CNN
+(DeepSpeedExamples/cifar) — ZeRO stage 0, fp32, single process").
+
+The DeepSpeedExamples net (two conv+pool blocks, three fc layers — the
+classic PyTorch-tutorial CNN) as a flax module following this package's
+engine convention: ``__call__(batch)`` returns the mean cross-entropy.
+Batch: (images [B, 32, 32, 3] float, labels [B] int32).
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class CifarNet(nn.Module):
+    """conv5x5(6) → pool → conv5x5(16) → pool → fc120 → fc84 → fc10."""
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, batch, return_logits: bool = False):
+        if isinstance(batch, (tuple, list)):
+            images, labels = batch[0], (batch[1] if len(batch) > 1
+                                        else None)
+        else:
+            images, labels = batch["images"], batch.get("labels")
+        x = jnp.asarray(images)
+        x = nn.relu(nn.Conv(6, (5, 5), padding="VALID", name="conv1")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(nn.Conv(16, (5, 5), padding="VALID", name="conv2")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape(x.shape[0], -1)
+        x = nn.relu(nn.Dense(120, name="fc1")(x))
+        x = nn.relu(nn.Dense(84, name="fc2")(x))
+        logits = nn.Dense(self.num_classes, name="fc3")(x)
+        if return_logits or labels is None:
+            return logits
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, labels[:, None], axis=-1)
+        return -jnp.mean(ll)
+
+
+def synthetic_cifar_batch(batch_size, seed=0):
+    rng = np.random.default_rng(seed)
+    return (jnp.asarray(rng.standard_normal(
+                (batch_size, 32, 32, 3)).astype(np.float32)),
+            jnp.asarray(rng.integers(0, 10, batch_size, dtype=np.int32)))

@@ -133,3 +133,38 @@ def test_bf16_tracks_golden():
     cfg = _base_config(bf16={"enabled": True})
     losses = _run_engine(cfg)
     np.testing.assert_allclose(losses, _golden(), rtol=0.03, atol=0.12)
+
+
+def test_cifar_cnn_zero0_fp32_matches_oracle():
+    """BASELINE.json config #1: CIFAR-10 CNN, ZeRO-0, fp32 — engine curve
+    vs an independent jax Adam loop on the same net."""
+    import jax
+    import optax
+
+    from deepspeed_tpu.models.cifar import CifarNet, synthetic_cifar_batch
+
+    groups.destroy()
+    groups.initialize(devices=jax.devices()[:1])
+    batches = [synthetic_cifar_batch(16, seed=s) for s in range(10)]
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=CifarNet(),
+        config={"train_batch_size": 16, "steps_per_print": 10 ** 9,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 0}},
+        sample_batch=batches[0], seed=0)
+    engine_losses = [float(engine.train_batch(batch=b)) for b in batches]
+
+    model = CifarNet()
+    params = model.init(jax.random.PRNGKey(0), batches[0])["params"]
+    opt = optax.adam(1e-3)
+    opt_state = opt.init(params)
+    oracle_losses = []
+    for b in batches:
+        loss, g = jax.value_and_grad(
+            lambda p, b: model.apply({"params": p}, b))(params, b)
+        upd, opt_state = opt.update(g, opt_state)
+        params = optax.apply_updates(params, upd)
+        oracle_losses.append(float(loss))
+    np.testing.assert_allclose(engine_losses, oracle_losses, rtol=1e-4,
+                               atol=1e-4)
+    assert engine_losses[-1] < engine_losses[0]
