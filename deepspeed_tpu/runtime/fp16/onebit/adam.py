@@ -45,6 +45,14 @@ def _compress(x, error):
     return compressed, new_error
 
 
+def _bias_corrections(step, b1, b2, bias_correction):
+    """Shared Adam bias-correction terms (step already incremented)."""
+    if bias_correction:
+        return (1.0 - b1 ** step.astype(jnp.float32),
+                1.0 - b2 ** step.astype(jnp.float32))
+    return jnp.float32(1.0), jnp.float32(1.0)
+
+
 def onebit_adam(b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.0,
                 freeze_step=100, adam_w_mode=True, bias_correction=True):
     """Optimizer pair (reference OnebitAdam :14)."""
@@ -57,11 +65,7 @@ def onebit_adam(b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.0,
 
     def update(grads, state, params, lr):
         step = state.step + 1
-        if bias_correction:
-            bc1 = 1.0 - b1 ** step.astype(jnp.float32)
-            bc2 = 1.0 - b2 ** step.astype(jnp.float32)
-        else:
-            bc1 = bc2 = jnp.float32(1.0)
+        bc1, bc2 = _bias_corrections(step, b1, b2, bias_correction)
         warm = step <= freeze_step
 
         def leaf_update(g, m, v, e, p):
@@ -107,3 +111,91 @@ class OnebitAdam:
                 cuda_aware=False, comm_backend_name="xla", **_):
         return onebit_adam(b1=betas[0], b2=betas[1], eps=eps,
                            weight_decay=weight_decay, freeze_step=freeze_step)
+
+
+class OnebitAdamDistState(NamedTuple):
+    step: jnp.ndarray
+    mu: Any
+    nu: Any
+    worker_error: Any   # per-leaf flat [P] (comm/nccl.py worker_error)
+    server_error: Any   # per-leaf flat [P / world] (server_error)
+
+
+def onebit_adam_distributed(axis_name, world, b1=0.9, b2=0.999, eps=1e-8,
+                            weight_decay=0.0, freeze_step=100,
+                            adam_w_mode=True, bias_correction=True):
+    """1-bit Adam with the REAL compressed collective in the loop.
+
+    The reference dataflow (onebit/adam.py:14 + comm/nccl.py:47): each dp
+    rank updates momentum from its LOCAL gradient, then the momenta are
+    averaged with the error-compensated 1-bit allreduce
+    (comm/compressed.py). ``update(grads, state, params, lr)`` must run
+    INSIDE shard_map/pjit with ``axis_name`` bound and ``grads`` being the
+    rank-local (unreduced) gradients; warmup steps use an exact pmean.
+    ``world`` is the static axis size (error-buffer layout).
+    """
+    from deepspeed_tpu.comm.compressed import (compressed_allreduce,
+                                               padded_numel)
+
+    def init(params):
+        zeros = lambda fn: jax.tree.map(fn, params)  # noqa: E731
+        return OnebitAdamDistState(
+            step=jnp.zeros([], jnp.int32),
+            mu=zeros(lambda p: jnp.zeros(p.shape, jnp.float32)),
+            nu=zeros(lambda p: jnp.zeros(p.shape, jnp.float32)),
+            worker_error=zeros(lambda p: jnp.zeros(
+                (padded_numel(p.size, world),), jnp.float32)),
+            server_error=zeros(lambda p: jnp.zeros(
+                (padded_numel(p.size, world) // world,), jnp.float32)))
+
+    def update(grads, state, params, lr):
+        step = state.step + 1
+        bc1, bc2 = _bias_corrections(step, b1, b2, bias_correction)
+        warm = step <= freeze_step
+
+        def leaf(g, m, v, we, se, p):
+            g = g.astype(jnp.float32)
+            m_local = b1 * m + (1.0 - b1) * g
+
+            # the two phases run under lax.cond so only ONE collective set
+            # executes per step (warm is replica-uniform): the warmup's
+            # exact fp32 pmean, or the 1-bit wire format — running both
+            # (jnp.where) would make total traffic WORSE than plain Adam
+            def warm_branch(operands):
+                m_local, v, we, se, g = operands
+                m_exact = jax.lax.pmean(m_local, axis_name)
+                v_new = b2 * v + (1.0 - b2) * \
+                    jax.lax.pmean(g, axis_name) ** 2
+                return m_exact, v_new, we, se
+
+            def frozen_branch(operands):
+                m_local, v, we, se, _ = operands
+                m_flat, we_new, se_new = compressed_allreduce(
+                    m_local.reshape(-1), we, se, axis_name)
+                return m_flat.reshape(m_local.shape), v, we_new, se_new
+
+            m_out, v_out, we_out, se_out = jax.lax.cond(
+                warm, warm_branch, frozen_branch, (m_local, v, we, se, g))
+            upd = -lr * (m_out / bc1) / (jnp.sqrt(v_out / bc2) + eps)
+            if adam_w_mode and weight_decay > 0.0:
+                upd = upd - lr * weight_decay * p
+            return upd.astype(p.dtype), m_out, v_out, we_out, se_out
+
+        flat_g, treedef = jax.tree.flatten(grads)
+        out = [leaf(g, m, v, we, se, p) for g, m, v, we, se, p in zip(
+            flat_g,
+            treedef.flatten_up_to(state.mu),
+            treedef.flatten_up_to(state.nu),
+            treedef.flatten_up_to(state.worker_error),
+            treedef.flatten_up_to(state.server_error),
+            treedef.flatten_up_to(params))]
+        updates = treedef.unflatten([o[0] for o in out])
+        new_state = OnebitAdamDistState(
+            step=step,
+            mu=treedef.unflatten([o[1] for o in out]),
+            nu=treedef.unflatten([o[2] for o in out]),
+            worker_error=treedef.unflatten([o[3] for o in out]),
+            server_error=treedef.unflatten([o[4] for o in out]))
+        return updates, new_state
+
+    return optim_lib.Optimizer(init, update)

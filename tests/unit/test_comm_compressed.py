@@ -151,3 +151,83 @@ def test_onebit_compress_uses_rms_scale():
     np.testing.assert_allclose(np.asarray(jnp.abs(comp)), scale, rtol=1e-6)
     np.testing.assert_allclose(np.asarray(comp + new_e), np.asarray(x),
                                rtol=1e-6, atol=1e-7)
+
+
+def test_onebit_adam_distributed_end_to_end():
+    """The full reference dataflow: local grads -> momentum -> compressed
+    allreduce -> identical params on every rank. Warmup steps must equal
+    a plain dp-averaged Adam oracle; post-freeze the ranks stay in sync
+    with live error feedback. Error buffers are RANK-LOCAL state and are
+    threaded through shard_map stacked per rank (Pspec("data")) — the
+    replicated fields (step/mu/nu) are value-replicated because they are
+    functions of replicated inputs plus the allreduced momentum."""
+    import functools
+
+    from deepspeed_tpu.runtime.fp16.onebit.adam import (
+        OnebitAdamDistState, onebit_adam_distributed)
+
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as Pspec
+
+    groups.destroy()
+    groups.initialize()
+    mesh = groups.get_mesh()
+    world, D = 8, 64
+    lr, b1, b2, eps = 1e-2, 0.9, 0.999, 1e-8
+    opt = onebit_adam_distributed("data", world, freeze_step=3)
+    rng = np.random.default_rng(9)
+    params = {"w": jnp.asarray(rng.standard_normal(D), jnp.float32)}
+    state = opt.init(params)
+    stack = lambda tree: jax.tree.map(  # noqa: E731
+        lambda e: jnp.broadcast_to(e, (world,) + e.shape), tree)
+    state = state._replace(worker_error=stack(state.worker_error),
+                           server_error=stack(state.server_error))
+
+    state_spec = OnebitAdamDistState(
+        step=Pspec(), mu=Pspec(), nu=Pspec(),
+        worker_error=Pspec("data"), server_error=Pspec("data"))
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(Pspec("data"), state_spec, Pspec()),
+        out_specs=(Pspec("data"), state_spec), check_vma=False)
+    def step(local_grads, state, params):
+        unstack = lambda tree: jax.tree.map(lambda x: x[0], tree)  # noqa
+        local_state = state._replace(
+            worker_error=unstack(state.worker_error),
+            server_error=unstack(state.server_error))
+        upd, new_state = opt.update({"w": local_grads[0]}, local_state,
+                                    params, jnp.float32(lr))
+        restack = lambda tree: jax.tree.map(lambda x: x[None], tree)  # noqa
+        new_state = new_state._replace(
+            worker_error=restack(new_state.worker_error),
+            server_error=restack(new_state.server_error))
+        return jax.tree.map(lambda u: u[None], upd), new_state
+
+    # plain dp-averaged Adam oracle for the warmup phase
+    m_o = np.zeros(D)
+    v_o = np.zeros(D)
+    for t in range(6):
+        local = jnp.asarray(rng.standard_normal((world, D)), jnp.float32)
+        upd, state = step(local, state, params)
+        upd_np = np.asarray(upd["w"])
+        for r in range(1, world):  # identical updates on every rank
+            np.testing.assert_allclose(upd_np[r], upd_np[0], rtol=1e-6)
+        if t < 3:  # warmup == exact dp-mean Adam
+            gbar = np.asarray(local).mean(axis=0)
+            m_o = b1 * m_o + (1 - b1) * gbar
+            v_o = b2 * v_o + (1 - b2) * gbar ** 2
+            bc1 = 1 - b1 ** (t + 1)
+            bc2 = 1 - b2 ** (t + 1)
+            want = -lr * (m_o / bc1) / (np.sqrt(v_o / bc2) + eps)
+            np.testing.assert_allclose(upd_np[0], want, rtol=2e-5,
+                                       atol=2e-6)
+        params = {"w": params["w"] + upd["w"][0]}
+    assert int(state.step) == 6
+    # error feedback is live post-freeze, and differs per rank
+    we = np.asarray(state.worker_error["w"])
+    assert np.abs(we).sum() > 0
+    assert not np.allclose(we[0], we[1])
