@@ -1,0 +1,61 @@
+"""``ds_ssh`` — run a command on every host in the hostfile.
+
+Rebuild of the reference's ``bin/ds_ssh`` helper: reads the deepspeed
+hostfile (same format as the runner), applies --include/--exclude
+filters, and fans the command out over ssh sequentially (or just prints
+with --dry-run). On TPU pods this is the manual sibling of the runner's
+multi-host launch (see runner.py's scope note: pdsh/MPI are deliberately
+absent; plain ssh or the pod orchestrator fans out).
+"""
+
+import argparse
+import subprocess
+import sys
+
+from deepspeed_tpu.launcher.runner import (DLTS_HOSTFILE, fetch_hostfile,
+                                           parse_resource_filter)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Run a command on all hosts in the hostfile")
+    parser.add_argument("-H", "--hostfile", default=DLTS_HOSTFILE)
+    parser.add_argument("--include", default="")
+    parser.add_argument("--exclude", default="")
+    parser.add_argument("--dry-run", action="store_true",
+                        help="print the per-host commands without running")
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="command to run on every host")
+    args = parser.parse_args(argv)
+    if not args.command:
+        parser.error("no command given")
+
+    resources = fetch_hostfile(args.hostfile)
+    if not resources:
+        print("ds_ssh: no hostfile found; running locally", file=sys.stderr)
+        hosts = ["localhost"]
+    else:
+        if args.include or args.exclude:
+            resources = parse_resource_filter(resources, args.include,
+                                              args.exclude)
+        hosts = list(resources.keys())
+
+    cmd = " ".join(args.command)
+    rc = 0
+    for host in hosts:
+        full = cmd if host == "localhost" else None
+        print(f"=== {host} ===")
+        if args.dry_run:
+            print(f"ssh {host} {cmd}" if full is None else cmd)
+            continue
+        if full is not None:
+            proc = subprocess.run(cmd, shell=True)
+        else:
+            proc = subprocess.run(["ssh", "-o", "StrictHostKeyChecking=no",
+                                   host, cmd])
+        rc = rc or proc.returncode
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

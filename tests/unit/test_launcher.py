@@ -69,3 +69,26 @@ def test_world_info_roundtrip():
     enc = encode_world_info(pool)
     dec = json.loads(base64.urlsafe_b64decode(enc))
     assert dec == {"a": [0, 1], "b": [0, 1, 2, 3]}
+
+
+def test_ds_ssh_dry_run(tmp_path, capsys):
+    """ds_ssh reads the hostfile, applies filters, and emits one ssh
+    command per selected host (reference bin/ds_ssh)."""
+    from deepspeed_tpu.launcher.ds_ssh import main as ds_ssh_main
+    hf = tmp_path / "hostfile"
+    hf.write_text("workerA slots=4\nworkerB slots=4\nworkerC slots=4\n")
+    rc = ds_ssh_main(["-H", str(hf), "--exclude", "workerB",
+                      "--dry-run", "echo", "hello"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "workerA" in out and "workerC" in out
+    assert "workerB" not in out
+    assert out.count("ssh ") == 2
+
+
+def test_ds_ssh_local_fallback(tmp_path, capfd):
+    # capfd (not capsys): the command runs as a subprocess on real fd 1
+    from deepspeed_tpu.launcher.ds_ssh import main as ds_ssh_main
+    rc = ds_ssh_main(["-H", str(tmp_path / "missing"), "echo", "ok"])
+    assert rc == 0
+    assert "ok" in capfd.readouterr().out
