@@ -9,6 +9,7 @@ absent; plain ssh or the pod orchestrator fans out).
 """
 
 import argparse
+import shlex
 import subprocess
 import sys
 
@@ -32,6 +33,9 @@ def main(argv=None):
 
     resources = fetch_hostfile(args.hostfile)
     if not resources:
+        if args.include or args.exclude:
+            parser.error("--include/--exclude require a hostfile "
+                         f"(none found at {args.hostfile})")
         print("ds_ssh: no hostfile found; running locally", file=sys.stderr)
         hosts = ["localhost"]
     else:
@@ -40,15 +44,15 @@ def main(argv=None):
                                               args.exclude)
         hosts = list(resources.keys())
 
-    cmd = " ".join(args.command)
+    cmd = shlex.join(args.command)  # preserve the caller's tokenisation
     rc = 0
     for host in hosts:
-        full = cmd if host == "localhost" else None
+        local = host == "localhost"
         print(f"=== {host} ===")
         if args.dry_run:
-            print(f"ssh {host} {cmd}" if full is None else cmd)
+            print(cmd if local else f"ssh {host} {cmd}")
             continue
-        if full is not None:
+        if local:
             proc = subprocess.run(cmd, shell=True)
         else:
             proc = subprocess.run(["ssh", "-o", "StrictHostKeyChecking=no",
