@@ -31,11 +31,15 @@ def main(argv=None):
     if not args.command:
         parser.error("no command given")
 
+    import os
+
     resources = fetch_hostfile(args.hostfile)
     if not resources:
         if args.include or args.exclude:
-            parser.error("--include/--exclude require a hostfile "
-                         f"(none found at {args.hostfile})")
+            reason = "is empty" if os.path.exists(args.hostfile) \
+                else "was not found"
+            parser.error(f"--include/--exclude require hosts, but the "
+                         f"hostfile {args.hostfile} {reason}")
         print("ds_ssh: no hostfile found; running locally", file=sys.stderr)
         hosts = ["localhost"]
     else:
@@ -44,7 +48,12 @@ def main(argv=None):
                                               args.exclude)
         hosts = list(resources.keys())
 
-    cmd = shlex.join(args.command)  # preserve the caller's tokenisation
+    if len(args.command) == 1:
+        # classic pdsh-style single-string shell snippet: pass verbatim so
+        # pipes/&&/$VARs still reach the remote shell
+        cmd = args.command[0]
+    else:
+        cmd = shlex.join(args.command)  # preserve tokenisation of argv
     rc = 0
     for host in hosts:
         local = host == "localhost"

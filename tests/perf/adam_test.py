@@ -1,0 +1,39 @@
+"""CPU-Adam throughput microbenchmark (reference tests/perf/adam_test.py).
+
+Run manually:  python tests/perf/adam_test.py [numel] — not collected by
+pytest (no test_ prefix), like the reference's perf scripts.
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def main(numel=8 * 1024 * 1024, steps=20):
+    from deepspeed_tpu.ops.adam.cpu_adam import DeepSpeedCPUAdam
+    from deepspeed_tpu.ops.op_builder.builder import CPUAdamBuilder
+
+    if not CPUAdamBuilder().is_compatible():
+        print("no host compiler; skipping")
+        return
+    rng = np.random.default_rng(0)
+    param = rng.standard_normal(numel).astype(np.float32)
+    grad = rng.standard_normal(numel).astype(np.float32)
+    opt = DeepSpeedCPUAdam([param])
+    opt.step([grad])  # warm
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        opt.step([grad])
+    dt = (time.perf_counter() - t0) / steps
+    # 3 reads (p, m, v) + 3 writes + 1 grad read, 4 bytes each
+    gbps = numel * 4 * 7 / dt / 1e9
+    print(f"cpu_adam: {numel / 1e6:.1f}M params in {dt * 1e3:.2f} ms "
+          f"({numel / dt / 1e9:.2f} Gparam/s, ~{gbps:.1f} GB/s effective)")
+
+
+if __name__ == "__main__":
+    main(*[int(a) for a in sys.argv[1:]])

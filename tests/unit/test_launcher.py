@@ -92,3 +92,12 @@ def test_ds_ssh_local_fallback(tmp_path, capfd):
     rc = ds_ssh_main(["-H", str(tmp_path / "missing"), "echo", "ok"])
     assert rc == 0
     assert "ok" in capfd.readouterr().out
+
+
+def test_ds_ssh_single_string_shell_snippet(tmp_path, capfd):
+    """pdsh-style one-string commands keep their pipes/metacharacters."""
+    from deepspeed_tpu.launcher.ds_ssh import main as ds_ssh_main
+    rc = ds_ssh_main(["-H", str(tmp_path / "missing"),
+                      "echo one two | tr ' ' '_'"])
+    assert rc == 0
+    assert "one_two" in capfd.readouterr().out
