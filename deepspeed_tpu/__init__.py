@@ -118,6 +118,38 @@ def initialize(args=None,
 
     assert model is not None, "deepspeed_tpu.initialize: model is required"
 
+    # PipelineModule → 1F1B host-loop engine (reference: initialize()
+    # returns a PipelineEngine when the model is a PipelineModule,
+    # deepspeed/__init__.py:116 isinstance check)
+    from deepspeed_tpu.runtime.pipe.module import PipelineModule
+    if isinstance(model, PipelineModule):
+        from deepspeed_tpu.runtime.pipe.engine import PipelineEngine
+        assert _cfg_dict is not None, (
+            "PipelineModule initialization needs a dict/JSON config")
+        assert "sample_batch" in kwargs, (
+            "PipelineModule initialization requires sample_batch=")
+        assert optimizer is None and lr_scheduler is None and \
+            training_data is None and model_parameters is None, (
+                "the 1F1B PipelineEngine drives its own AdamW; client "
+                "optimizer/lr_scheduler/training_data are unsupported")
+        # proper triangulation + validation (dp-world aware) comes from
+        # DeepSpeedConfig — the pipeline engine is host-side dp=1
+        cfg = DeepSpeedConfig(_cfg_dict, data_parallel_size=1)
+        _opt_name = (cfg.optimizer_name or "adam").lower()
+        assert _opt_name in ("adam", "adamw"), (
+            f"PipelineEngine drives AdamW; optimizer type "
+            f"{cfg.optimizer_name!r} is unsupported on this path")
+        opt_params = cfg.optimizer_params or {}
+        engine = PipelineEngine(
+            model, kwargs["sample_batch"],
+            num_microbatches=max(1, cfg.gradient_accumulation_steps),
+            lr=opt_params.get("lr", 1e-3),
+            betas=tuple(opt_params.get("betas", (0.9, 0.999))),
+            eps=opt_params.get("eps", 1e-8),
+            weight_decay=opt_params.get("weight_decay", 0.0),
+            seed=kwargs.get("seed", 0))
+        return engine, None, None, None
+
     engine = DeepSpeedEngine(args=args,
                              model=model,
                              optimizer=optimizer,

@@ -328,3 +328,21 @@ def test_1f1b_nonuniform_stages():
     oracle = _oracle_trajectory(eng, batches)
     piped = [float(eng.train_batch(b)) for b in batches]
     np.testing.assert_allclose(piped, oracle, rtol=2e-5, atol=2e-6)
+
+
+def test_initialize_dispatches_pipeline_module():
+    """deepspeed.initialize(model=PipelineModule) returns the 1F1B engine
+    (reference deepspeed/__init__.py:116)."""
+    pm = PipelineModule(_lm_specs(2), num_stages=2, loss_fn=_ce_loss,
+                        partition_method="uniform")
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=pm,
+        config={"train_batch_size": 8,
+                "train_micro_batch_size_per_gpu": 4,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}},
+        sample_batch=_lm_batch())
+    assert isinstance(engine, PipelineEngine)
+    assert engine.M == 2  # gas = 8 / 4
+    l0 = float(engine.train_batch(_lm_batch(0)))
+    l1 = float(engine.train_batch(_lm_batch(0)))
+    assert l1 < l0
