@@ -160,3 +160,52 @@ def test_cross_rank_consistency_asserts_single_process():
     assert_ints_same_as_other_ranks([1, 2, 3], tag="t")
     assert_shapes_same_as_other_ranks({"a": jnp.zeros((2, 3)),
                                        "b": jnp.zeros((4,), jnp.int32)})
+
+
+def test_add_config_arguments():
+    """reference test_ds_arguments.py: the argparse helper wires
+    --deepspeed/--deepspeed_config and initialize(args=...) consumes it."""
+    import argparse
+    import json
+
+    import deepspeed_tpu
+
+    parser = argparse.ArgumentParser()
+    parser = deepspeed_tpu.add_config_arguments(parser)
+    args = parser.parse_args(["--deepspeed", "--deepspeed_config",
+                              "/tmp/nonexistent.json"])
+    assert args.deepspeed is True
+    assert args.deepspeed_config == "/tmp/nonexistent.json"
+    args = parser.parse_args([])
+    assert args.deepspeed is False and args.deepspeed_config is None
+
+
+def test_initialize_reads_config_from_args(tmp_path):
+    import argparse
+    import json
+
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.simple import SimpleModel, sample_batch
+    from deepspeed_tpu.utils import groups
+
+    cfg_path = tmp_path / "ds_config.json"
+    cfg_path.write_text(json.dumps({
+        "train_batch_size": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 1}}))
+    parser = deepspeed_tpu.add_config_arguments(argparse.ArgumentParser())
+    args = parser.parse_args(["--deepspeed", "--deepspeed_config",
+                              str(cfg_path)])
+    groups.destroy()
+    groups.initialize()
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        args=args, model=SimpleModel(hidden_dim=32, nlayers=1),
+        sample_batch=sample_batch(8, 32))
+    rng = np.random.default_rng(0)
+    batch = (rng.standard_normal((8, 32)).astype(np.float32),
+             rng.standard_normal((8, 32)).astype(np.float32))
+    l0 = float(engine.train_batch(batch=batch))
+    l1 = float(engine.train_batch(batch=batch))
+    assert l1 < l0
