@@ -293,28 +293,7 @@ class PipelineEngine:
             else:  # pragma: no cover
                 raise ValueError(f"unknown instruction {name}")
 
-        # cooperative interpretation: a stage blocks only on an un-arrived
-        # recv; everything else retires in order (p2p pairing of p2p.py)
-        for t in range(total_steps):
-            pending = {s: list(streams[s][t]) for s in range(self.S)}
-            while any(pending.values()):
-                progressed = False
-                for s in range(self.S):
-                    while pending[s]:
-                        cmd = pending[s][0]
-                        nm = type(cmd).__name__
-                        if nm == "RecvActivation" and not box.ready(
-                                ("act", s, cmd.micro_batch_id)):
-                            break
-                        if nm == "RecvGrad" and not box.ready(
-                                ("grad", s, cmd.micro_batch_id)):
-                            break
-                        execute(s, pending[s].pop(0))
-                        progressed = True
-                if not progressed:
-                    raise RuntimeError(
-                        f"pipeline deadlock at step {t}: "
-                        f"{ {s: p for s, p in pending.items() if p} }")
+        self._run_schedule(streams, execute, box)
 
         # tied-weight grad allreduce (reference _exec_reduce_tied_grads
         # :233): sum the copies' grads so every stage applies the same
@@ -340,6 +319,84 @@ class PipelineEngine:
                 grad_accum[s], self.opt_states[s], st.params,
                 jnp.float32(self.lr))
         self.global_steps += 1
+        return jnp.mean(jnp.stack(losses))
+
+    def _run_schedule(self, streams, execute, box):
+        """Cooperative interpretation of per-stage instruction streams: a
+        stage blocks only on an un-arrived recv; everything else retires
+        in order (the p2p pairing of pipe/p2p.py)."""
+        for t in range(len(streams[0])):
+            pending = {s: list(streams[s][t]) for s in range(self.S)}
+            while any(pending.values()):
+                progressed = False
+                for s in range(self.S):
+                    while pending[s]:
+                        cmd = pending[s][0]
+                        nm = type(cmd).__name__
+                        if nm == "RecvActivation" and not box.ready(
+                                ("act", s, cmd.micro_batch_id)):
+                            break
+                        if nm == "RecvGrad" and not box.ready(
+                                ("grad", s, cmd.micro_batch_id)):
+                            break
+                        execute(s, pending[s].pop(0))
+                        progressed = True
+                if not progressed:
+                    raise RuntimeError(
+                        f"pipeline deadlock at step {t}: "
+                        f"{ {s: p for s, p in pending.items() if p} }")
+
+    def eval_batch(self, batch):
+        """Forward-only pipeline pass executing InferenceSchedule
+        (reference PipelineEngine.eval_batch → fill-drain, schedule.py
+        :117): micro-batches stream through the stages, the last stage's
+        losses average — no grads, no optimizer step."""
+        x, labels = batch[0], batch[1]
+        B = x.shape[0]
+        assert B % self.M == 0
+        mb = B // self.M
+        micro_x = [jax.device_put(x[i * mb:(i + 1) * mb], self.devices[0])
+                   for i in range(self.M)]
+        micro_y = [jax.device_put(labels[i * mb:(i + 1) * mb],
+                                  self.devices[-1])
+                   for i in range(self.M)]
+        schedules = [sched_mod.InferenceSchedule(self.M, self.S, s)
+                     for s in range(self.S)]
+        streams = [list(sch.steps()) for sch in schedules]
+        nbuf = [sch.num_pipe_buffers() for sch in schedules]
+        in_buf = [[None] * nbuf[s] for s in range(self.S)]
+        lbl_buf = [[None] * nbuf[s] for s in range(self.S)]
+        out_buf = [[None] * nbuf[s] for s in range(self.S)]
+        losses = []
+        box = _Mailbox()
+
+        def execute(s, cmd):
+            st = self.stages[s]
+            name = type(cmd).__name__
+            if name == "LoadMicroBatch":
+                if st.is_first:
+                    in_buf[s][cmd.buffer_id] = micro_x[cmd.micro_batch_id]
+                if st.is_last:
+                    lbl_buf[s][cmd.buffer_id] = micro_y[cmd.micro_batch_id]
+            elif name == "ForwardPass":
+                xin = in_buf[s][cmd.buffer_id]
+                if st.is_last:
+                    losses.append(st.fwd(st.params, xin,
+                                         lbl_buf[s][cmd.buffer_id]))
+                else:
+                    out_buf[s][cmd.buffer_id] = st.fwd(st.params, xin)
+            elif name == "SendActivation":
+                box.send(("act", s + 1, cmd.micro_batch_id),
+                         jax.device_put(out_buf[s][cmd.buffer_id],
+                                        self.devices[s + 1]))
+                out_buf[s][cmd.buffer_id] = None
+            elif name == "RecvActivation":
+                in_buf[s][cmd.buffer_id] = box.recv(
+                    ("act", s, cmd.micro_batch_id))
+            else:  # pragma: no cover
+                raise ValueError(f"unexpected inference instruction {name}")
+
+        self._run_schedule(streams, execute, box)
         return jnp.mean(jnp.stack(losses))
 
     # ----------------------------------------------------------- inspection

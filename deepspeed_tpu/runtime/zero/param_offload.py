@@ -292,6 +292,52 @@ class Zero3OffloadEngine:
         self._v = [[np.array(a) for a in layer] for layer in sd["exp_avg_sq"]]
         self.global_steps = sd["step"]
 
+    def save_checkpoint(self, save_dir, tag=None, client_state=None,
+                        save_latest=True):
+        """Engine-compatible file layout: one model-states file holding
+        the layered masters + moments (single-process engine — the dp=1
+        analogue of runtime/checkpoint_io.py), plus the `latest` tag."""
+        import pickle
+
+        from deepspeed_tpu.runtime.engine import (LATEST_FILE,
+                                                  MODEL_FILE_SUFFIX)
+        if tag is None:
+            tag = f"global_step{self.global_steps}"
+        tag_dir = os.path.join(save_dir, str(tag))
+        os.makedirs(tag_dir, exist_ok=True)
+        sd = self.state_dict()
+        sd["client_state"] = client_state or {}
+        with open(os.path.join(tag_dir, f"mp_rank_00{MODEL_FILE_SUFFIX}"),
+                  "wb") as f:
+            pickle.dump(sd, f)
+        if save_latest:
+            with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
+                f.write(str(tag))
+        return True
+
+    def load_checkpoint(self, load_dir, tag=None):
+        import pickle
+
+        from deepspeed_tpu.runtime.engine import (LATEST_FILE,
+                                                  MODEL_FILE_SUFFIX)
+        if tag is None:
+            latest = os.path.join(load_dir, LATEST_FILE)
+            if not os.path.exists(latest):
+                # engine contract (engine.py load_checkpoint): resume-if-
+                # present — a fresh run starts from scratch, no crash
+                log_dist(f"no '{LATEST_FILE}' file under {load_dir}; "
+                         "starting from scratch", ranks=[0])
+                return None, {}
+            with open(latest) as f:
+                tag = f.read().strip()
+        path = os.path.join(load_dir, str(tag),
+                            f"mp_rank_00{MODEL_FILE_SUFFIX}")
+        with open(path, "rb") as f:
+            sd = pickle.load(f)
+        client_state = sd.pop("client_state", {})
+        self.load_state_dict(sd)
+        return path, client_state
+
 
 class _HostAdam:
     """One Adam leaf update on host buffers: the AVX C++ kernel when it

@@ -136,3 +136,19 @@ def test_initialize_offload_param_requires_layers():
                     "zero_optimization": {
                         "stage": 3, "offload_param": {"device": "cpu"}}},
             sample_batch=_batch())
+
+
+def test_file_checkpoint_roundtrip(tmp_path):
+    eng = Zero3OffloadEngine(_layers(), _batch(), lr=1e-2, seed=4)
+    for s in range(3):
+        eng.train_batch(_batch(s))
+    eng.save_checkpoint(str(tmp_path), tag="t3",
+                        client_state={"epoch": 1})
+    assert (tmp_path / "latest").read_text() == "t3"
+    cont = [float(eng.train_batch(_batch(s + 70))) for s in range(2)]
+
+    fresh = Zero3OffloadEngine(_layers(), _batch(), lr=1e-2, seed=77)
+    path, client = fresh.load_checkpoint(str(tmp_path))
+    assert client == {"epoch": 1}
+    resumed = [float(fresh.train_batch(_batch(s + 70))) for s in range(2)]
+    np.testing.assert_allclose(resumed, cont, rtol=1e-6)

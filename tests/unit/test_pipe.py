@@ -346,3 +346,28 @@ def test_initialize_dispatches_pipeline_module():
     l0 = float(engine.train_batch(_lm_batch(0)))
     l1 = float(engine.train_batch(_lm_batch(0)))
     assert l1 < l0
+
+
+def test_pipeline_eval_batch_matches_sequential():
+    """Forward-only InferenceSchedule execution: eval loss == monolithic
+    forward on the same params."""
+    pm = PipelineModule(_lm_specs(4), num_stages=2, loss_fn=_ce_loss,
+                        partition_method="uniform")
+    eng = PipelineEngine(pm, _lm_batch(), num_microbatches=4, seed=5)
+    x, y = _lm_batch(40)
+    # snapshot BEFORE eval so the no-mutation check below is real
+    params = [jax.device_put(p, jax.devices()[0])
+              for p in eng.stage_params()]
+    before = [np.asarray(jax.tree.leaves(p)[0]) for p in params]
+
+    got = float(eng.eval_batch((x, y)))
+
+    h = x
+    for s, st in enumerate(eng.stages[:-1]):
+        h = st.module.apply({"params": params[s]}, h)
+    want = float(eng.stages[-1].module.apply({"params": params[-1]}, h, y))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    # eval must not touch params
+    after = [np.asarray(jax.tree.leaves(p)[0]) for p in eng.stage_params()]
+    for a, b in zip(after, before):
+        np.testing.assert_array_equal(a, b)
