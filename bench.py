@@ -123,16 +123,23 @@ def main():
 
     batch = synthetic_batch(batch_size, seq_len, cfg.vocab_size, seed=1)
 
+    # jax.block_until_ready is NOT a reliable barrier through the axon
+    # tunnel (it returned immediately in round 3, inflating TFLOPS 5x);
+    # transferring a scalar out of the final state forces completion of
+    # the whole dispatched chain.
+    def _sync():
+        jax.device_get(engine.state.step)
+
     def _compile_step():
         engine.train_batch(batch=batch)
-        jax.block_until_ready(engine.state.params)
+        _sync()
 
     _retry(_compile_step, "first train_batch compile")
 
     t0 = time.perf_counter()
     for _ in range(steps):
         engine.train_batch(batch=batch)
-    jax.block_until_ready(engine.state.params)
+    _sync()
     dt = time.perf_counter() - t0
 
     tokens_per_s = batch_size * seq_len * steps / dt

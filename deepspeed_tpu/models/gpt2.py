@@ -363,21 +363,28 @@ class GPT2LMHeadModel(nn.Module):
         x = nn.LayerNorm(epsilon=1e-5, name="ln_f")(x)
 
         # tied LM head; fp32 logits for a stable softmax
-        logits = jnp.einsum("bse,ve->bsv", x, wte,
-                            preferred_element_type=jnp.float32)
         if return_logits:
-            return logits
+            return jnp.einsum("bse,ve->bsv", x, wte,
+                              preferred_element_type=jnp.float32)
 
         if labels is None:
             shift_labels = input_ids[:, 1:]
         else:
             shift_labels = labels[:, 1:]
-        shift_logits = logits[:, :-1]
-        logp = jax.nn.log_softmax(shift_logits, axis=-1)
-        ll = jnp.take_along_axis(logp, shift_labels[..., None], axis=-1)
+        # Slice BEFORE the LM-head matmul (the last position predicts
+        # nothing) so the [B,S,V] fp32 logits tensor is never copied, and
+        # use the logsumexp-minus-gold form of cross-entropy: it writes
+        # only [B,S] intermediates where log_softmax+gather would
+        # materialise a second full [B,S,V] fp32 array — at bench shape
+        # that is ~3.3 GB of HBM traffic per micro-step saved.
+        shift_logits = jnp.einsum("bse,ve->bsv", x[:, :-1], wte,
+                                  preferred_element_type=jnp.float32)
+        lse = jax.scipy.special.logsumexp(shift_logits, axis=-1)
+        gold = jnp.take_along_axis(
+            shift_logits, shift_labels[..., None], axis=-1)[..., 0]
         # ignore_index=-100 convention (masked positions)
         valid = (shift_labels >= 0).astype(jnp.float32)
-        ce = -(ll[..., 0] * valid).sum() / jnp.maximum(valid.sum(), 1.0)
+        ce = ((lse - gold) * valid).sum() / jnp.maximum(valid.sum(), 1.0)
         return ce + cfg.moe_aux_loss_coef * moe_aux
 
 
