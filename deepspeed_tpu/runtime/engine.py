@@ -149,6 +149,10 @@ class DeepSpeedEngine:
         self._offload_device = self.config.zero_config.offload_optimizer.device
         self._offload = self._offload_device not in (None, "none")
         self._offload_opt = None
+        # set by _configure_optimizer when a 1-bit optimizer runs with the
+        # REAL compressed collective (dp > 1): step fns then keep grads
+        # rank-local under shard_map (_build_onebit_step_fns)
+        self._onebit_dist = False
 
         # ---- precision ----------------------------------------------------
         if self.config.fp16_enabled:
@@ -263,9 +267,38 @@ class DeepSpeedEngine:
         return [float(self._lr_fn(max(0, applied_steps)))]
 
     def get_global_grad_norm(self):
+        """Global grad norm of the last applied step; None when the step
+        had no reason to compute it (bf16/fp32 with clipping disabled)."""
         return self._last_grad_norm
 
     # --------------------------------------------------------------- optimizer
+    def _validate_onebit_config(self, name):
+        """The compressed 1-bit data path needs rank-local grads, which is
+        incompatible with features that re-layout or pre-reduce them. The
+        reference has the same envelope (1-bit Adam requires the plain
+        FP16_Optimizer: no ZeRO, no MP — onebit/adam.py:14 docstring)."""
+        bad = []
+        if self.zero_stage != 0:
+            bad.append(f"zero_optimization.stage={self.zero_stage} (need 0)")
+        if self.mp_world_size != 1:
+            bad.append(f"model parallel size {self.mp_world_size} (need 1)")
+        if groups.get_expert_parallel_world_size() != 1:
+            bad.append("expert parallelism (need ep=1)")
+        if groups.get_pipe_parallel_world_size() != 1:
+            bad.append("pipeline parallelism (need pp=1)")
+        if self._offload:
+            bad.append("optimizer offload")
+        if self.config.gradient_clipping > 0:
+            bad.append("gradient_clipping (global norm needs an exact "
+                       "grad allreduce, defeating the compression)")
+        if self._batch_spec is not None:
+            bad.append("custom batch_spec (sequence parallelism)")
+        if bad:
+            raise ValueError(
+                f"{name} with the compressed collective (dp="
+                f"{self.dp_world_size}) is incompatible with: "
+                + "; ".join(bad))
+
     def _configure_optimizer(self):
         if self.client_optimizer is not None:
             assert isinstance(self.client_optimizer, optim_lib.Optimizer), (
@@ -283,21 +316,41 @@ class DeepSpeedEngine:
         use_fused = params.pop("fused", False)
 
         if name == ONEBIT_ADAM_OPTIMIZER:
-            from deepspeed_tpu.runtime.fp16.onebit.adam import onebit_adam
-            return onebit_adam(
+            kw = dict(
                 b1=betas[0], b2=betas[1], eps=params.get("eps", 1e-8),
                 weight_decay=params.get("weight_decay", 0.0),
                 freeze_step=params.get("freeze_step", 100),
                 adam_w_mode=params.pop("adam_w_mode", True),
                 bias_correction=params.get("bias_correction", True))
+            if self.dp_world_size > 1:
+                # the point of 1-bit Adam is changed WIRE traffic: grads
+                # stay rank-local and the momenta travel through the
+                # compressed collective (reference onebit/adam.py:14 +
+                # comm/nccl.py:47) — see _build_onebit_step_fns
+                self._validate_onebit_config(name)
+                from deepspeed_tpu.runtime.fp16.onebit.adam import \
+                    onebit_adam_engine
+                self._onebit_dist = True
+                return onebit_adam_engine(
+                    groups.DATA_AXIS, self.dp_world_size, **kw)
+            from deepspeed_tpu.runtime.fp16.onebit.adam import onebit_adam
+            return onebit_adam(**kw)
         if name == ONEBIT_LAMB_OPTIMIZER:
-            from deepspeed_tpu.runtime.fp16.onebit.lamb import onebit_lamb
-            return onebit_lamb(
+            kw = dict(
                 b1=betas[0], b2=betas[1], eps=params.get("eps", 1e-6),
                 weight_decay=params.get("weight_decay", 0.0),
                 freeze_step=params.get("freeze_step", 100),
                 min_coeff=params.get("min_coeff", 0.01),
                 max_coeff=params.get("max_coeff", 10.0))
+            if self.dp_world_size > 1:
+                self._validate_onebit_config(name)
+                from deepspeed_tpu.runtime.fp16.onebit.lamb import \
+                    onebit_lamb_engine
+                self._onebit_dist = True
+                return onebit_lamb_engine(
+                    groups.DATA_AXIS, self.dp_world_size, **kw)
+            from deepspeed_tpu.runtime.fp16.onebit.lamb import onebit_lamb
+            return onebit_lamb(**kw)
         if name in (ADAM_OPTIMIZER, ADAMW_OPTIMIZER):
             # Reference: both "adam" and "adamw" route to FusedAdam, which
             # defaults to adam_w_mode=True (ops/adam/fused_adam.py:16).
@@ -386,18 +439,40 @@ class DeepSpeedEngine:
             opt_shape = ()
         else:
             opt_shape = jax.eval_shape(self.optimizer.init, params)
-        self.opt_shardings = build_opt_shardings(
-            opt_shape, self.mesh, self.zero_stage, self.mp_rules,
-            min_shard_numel=0)
+        if self._onebit_dist:
+            # mu/nu are synchronized by the collective (replicated); the
+            # error-feedback buffers are RANK-LOCAL, laid out flat with
+            # the rank dim folded in and sharded over the data axis (see
+            # onebit_adam_engine); accumulated grads are rank-local too,
+            # stored with a leading [dp] dim.
+            repl = NamedSharding(self.mesh, P())
+            ranked = NamedSharding(self.mesh, P(groups.DATA_AXIS))
+            self.opt_shardings = type(opt_shape)(
+                step=repl,
+                mu=jax.tree.map(lambda _: repl, opt_shape.mu),
+                nu=jax.tree.map(lambda _: repl, opt_shape.nu),
+                worker_error=jax.tree.map(lambda _: ranked,
+                                          opt_shape.worker_error),
+                server_error=jax.tree.map(lambda _: ranked,
+                                          opt_shape.server_error))
+            self.grad_shardings = jax.tree.map(
+                lambda p: NamedSharding(
+                    self.mesh, P(groups.DATA_AXIS, *([None] * p.ndim))),
+                params)
+            self._grad_constraint = lambda g: g
+        else:
+            self.opt_shardings = build_opt_shardings(
+                opt_shape, self.mesh, self.zero_stage, self.mp_rules,
+                min_shard_numel=0)
 
-        # grads accumulate with the stage>=2 layout (reduce-scattered);
-        # stage<2 keeps them like the params (replicated across DP).
-        self.grad_shardings = build_opt_shardings(
-            jax.eval_shape(lambda p: p, params), self.mesh,
-            1 if self.zero_stage >= 2 else 0, self.mp_rules,
-            min_shard_numel=0)
-        self._grad_constraint = grad_constraint_fn(
-            self.mesh, self.zero_stage, self.mp_rules, min_shard_numel=0)
+            # grads accumulate with the stage>=2 layout (reduce-scattered);
+            # stage<2 keeps them like the params (replicated across DP).
+            self.grad_shardings = build_opt_shardings(
+                jax.eval_shape(lambda p: p, params), self.mesh,
+                1 if self.zero_stage >= 2 else 0, self.mp_rules,
+                min_shard_numel=0)
+            self._grad_constraint = grad_constraint_fn(
+                self.mesh, self.zero_stage, self.mp_rules, min_shard_numel=0)
 
         scalar_sh = NamedSharding(self.mesh, P())
         self.state_shardings = TrainState(
@@ -410,12 +485,19 @@ class DeepSpeedEngine:
 
         # Build the initial state ON the mesh with one compiled init fn so
         # every leaf is born sharded (no host round-trip of full params).
+        dp = self.dp_world_size
+
+        def make_acc(x):
+            if self._onebit_dist:   # rank-local accumulation: [dp, ...]
+                return jnp.zeros((dp,) + x.shape, jnp.float32)
+            return jnp.zeros_like(x, jnp.float32)
+
         def make_state(p):
             return TrainState(
                 step=jnp.zeros([], jnp.int32),
                 params=p,
                 opt_state=() if self._offload else self.optimizer.init(p),
-                acc_grads=jax.tree.map(lambda x: jnp.zeros_like(x, jnp.float32), p),
+                acc_grads=jax.tree.map(make_acc, p),
                 scale=make_scale_state(
                     self._init_scale,
                     delayed_shift=self.config.fp16.hysteresis))
@@ -467,6 +549,9 @@ class DeepSpeedEngine:
         return jnp.asarray(loss, jnp.float32)
 
     def _build_step_fns(self):
+        if self._onebit_dist:
+            self._build_onebit_step_fns()
+            return
         gas = self.gradient_accumulation_steps()
         cfg = self.config
 
@@ -481,18 +566,25 @@ class DeepSpeedEngine:
             loss = sloss * gas / state.scale.loss_scale
             return state._replace(acc_grads=acc), loss
 
-        def grad_prologue(state):
-            """Shared epilogue-of-accumulation: unscale, overflow check,
-            norm + clip, scale-state update, acc reset. Returns
-            (state-with-reset-acc-and-new-scale, grads, grad_norm,
-            overflow)."""
+        # grad_norm is only needed on-device for clipping and for the fp16
+        # overflow bookkeeping; in the bf16/fp32 no-clip case computing it
+        # costs a full extra read of the grad tree per step, so it is
+        # skipped and get_global_grad_norm() returns None.
+        need_norm = bool(cfg.fp16_enabled or cfg.gradient_clipping > 0)
+        self._need_norm = need_norm
+
+        def grad_epilogue(state, grads):
+            """Shared end-of-accumulation math on an UNSCALED-pending grad
+            tree: unscale, overflow check, norm + clip, scale-state update.
+            Returns (state-with-new-scale, grads, grad_norm, finite)."""
             inv_scale = 1.0 / state.scale.loss_scale
-            grads = jax.tree.map(lambda g: g * inv_scale, state.acc_grads)
+            grads = jax.tree.map(lambda g: g * inv_scale, grads)
             finite = jnp.array(True)
             if cfg.fp16_enabled:
                 finite = jnp.all(jnp.stack(
                     [jnp.isfinite(g).all() for g in jax.tree.leaves(grads)]))
-            grad_norm = optim_lib.global_norm(grads)
+            grad_norm = (optim_lib.global_norm(grads) if need_norm
+                         else jnp.float32(0.0))
             if cfg.gradient_clipping > 0:
                 grads, _ = optim_lib.clip_by_global_norm(
                     grads, cfg.gradient_clipping)
@@ -502,12 +594,17 @@ class DeepSpeedEngine:
                 scale_window=cfg.fp16.loss_scale_window,
                 min_scale=cfg.fp16.min_loss_scale,
                 delayed_shift=cfg.fp16.hysteresis)
+            return state._replace(scale=new_scale), grads, grad_norm, finite
+
+        def grad_prologue(state):
+            """grad_epilogue over the accumulation buffer, which it resets."""
+            acc = state.acc_grads
             zeros = jax.tree.map(jnp.zeros_like, state.acc_grads)
-            state = state._replace(acc_grads=zeros, scale=new_scale)
+            state, grads, grad_norm, finite = grad_epilogue(
+                state._replace(acc_grads=zeros), acc)
             return state, grads, grad_norm, finite
 
-        def apply_step(state):
-            state, grads, grad_norm, finite = grad_prologue(state)
+        def optimizer_update(state, grads, finite):
             lr = self._lr_fn_traced(state.step)
 
             def do_update(operand):
@@ -522,8 +619,31 @@ class DeepSpeedEngine:
                 st, _ = operand
                 return st
 
-            state = jax.lax.cond(finite, do_update, skip_update, (state, grads))
+            return jax.lax.cond(finite, do_update, skip_update,
+                                (state, grads))
+
+        def apply_step(state):
+            state, grads, grad_norm, finite = grad_prologue(state)
+            state = optimizer_update(state, grads, finite)
             return state, grad_norm, ~finite
+
+        def fused_train_step(state, batch, rng, pld_theta):
+            """gas=1 fast path: forward+backward+optimizer in ONE compiled
+            program. Skipping the acc_grads round-trip (write grads, read
+            them back, write zeros) saves ~3x the grad-tree bytes of HBM
+            traffic per step; acc_grads passes through untouched (it is
+            all-zeros between steps by invariant, and the donated buffer
+            aliases through at zero cost)."""
+            def scaled_loss(p):
+                loss = self._compute_loss(p, batch, rng, pld_theta)
+                return loss * state.scale.loss_scale
+
+            sloss, grads = jax.value_and_grad(scaled_loss)(state.params)
+            grads = self._grad_constraint(grads)
+            loss = sloss / state.scale.loss_scale
+            state, grads, grad_norm, finite = grad_epilogue(state, grads)
+            state = optimizer_update(state, grads, finite)
+            return state, loss, grad_norm, ~finite
 
         def offload_pre_step(state):
             """Device half of the offloaded step: the shared prologue —
@@ -532,11 +652,19 @@ class DeepSpeedEngine:
             return state, grads, grad_norm, ~finite
 
         sh = self.state_shardings
+        scalar = NamedSharding(self.mesh, P())
         self._jit_micro = jax.jit(
             micro_step, donate_argnums=0,
             in_shardings=(sh, None, None, None),
-            out_shardings=(sh, NamedSharding(self.mesh, P())))
-        scalar = NamedSharding(self.mesh, P())
+            out_shardings=(sh, scalar))
+        # gas=1 (the common large-model config): one fused program per
+        # global step instead of micro+apply with an HBM acc round-trip
+        self._jit_train = None
+        if gas == 1 and not self._offload:
+            self._jit_train = jax.jit(
+                fused_train_step, donate_argnums=0,
+                in_shardings=(sh, None, None, None),
+                out_shardings=(sh, scalar, scalar, scalar))
         self._jit_offload_pre = jax.jit(
             offload_pre_step, donate_argnums=0,
             in_shardings=(sh,),
@@ -546,6 +674,114 @@ class DeepSpeedEngine:
             in_shardings=(sh,),
             out_shardings=(sh, NamedSharding(self.mesh, P()),
                            NamedSharding(self.mesh, P())))
+        self._jit_eval = jax.jit(
+            lambda params, batch: self._compute_loss(params, batch, None))
+
+    def _build_onebit_step_fns(self):
+        """Step fns for the compressed 1-bit optimizers (reference
+        onebit/adam.py:14 + comm/nccl.py:47 compressed_allreduce).
+
+        The normal path lets XLA psum the grads over the data axis — exact
+        fp32 reduction, which makes post-freeze "compression" a no-op on
+        the wire. Here the whole micro/apply pair runs under ``shard_map``
+        over the data axis: each rank computes grads from its OWN batch
+        shard, accumulates them rank-locally ([dp, ...] acc layout), and
+        the only cross-rank traffic is the optimizer's own collectives —
+        an exact pmean during warmup, the sign-packed uint8 wire format
+        (comm/compressed.py) after ``freeze_step``.
+        """
+        gas = self.gradient_accumulation_steps()
+        cfg = self.config
+        axis = groups.DATA_AXIS
+        try:
+            from jax import shard_map
+        except ImportError:  # pre-0.8 jax
+            from jax.experimental.shard_map import shard_map
+        import functools
+        smap = functools.partial(shard_map, mesh=self.mesh)
+
+        opt_spec = type(self.state.opt_state)(
+            step=P(), mu=P(), nu=P(),
+            worker_error=P(axis), server_error=P(axis))
+
+        def micro_step(state, batch, rng, pld_theta):
+            def body(params, acc, scale, batch, rng, theta):
+                rrng = jax.random.fold_in(rng, jax.lax.axis_index(axis))
+
+                def scaled_loss(p):
+                    loss = self._compute_loss(p, batch, rrng, theta)
+                    return loss * scale / gas
+
+                sloss, g = jax.value_and_grad(scaled_loss)(params)
+                acc = jax.tree.map(lambda a, gg: a + gg[None], acc, g)
+                loss = jax.lax.pmean(sloss, axis) * gas / scale
+                return acc, loss
+
+            acc, loss = smap(
+                body,
+                in_specs=(P(), P(axis), P(), P(axis), P(), P()),
+                out_specs=(P(axis), P()), check_vma=False)(
+                    state.params, state.acc_grads, state.scale.loss_scale,
+                    batch, rng, pld_theta)
+            return state._replace(acc_grads=acc), loss
+
+        def apply_step(state):
+            lr = self._lr_fn_traced(state.step)
+
+            def body(params, opt_state, acc, inv_scale, lr):
+                grads = jax.tree.map(lambda a: a[0] * inv_scale, acc)
+
+                def do(op):
+                    p, o = op
+                    updates, new_o = self.optimizer.update(grads, o, p, lr)
+                    return jax.tree.map(jnp.add, p, updates), new_o
+
+                if cfg.fp16_enabled:
+                    bad = sum(
+                        (~jnp.isfinite(g).all()).astype(jnp.int32)
+                        for g in jax.tree.leaves(grads))
+                    finite = jax.lax.psum(bad, axis) == 0
+                    new_params, new_opt = jax.lax.cond(
+                        finite, do, lambda op: op, (params, opt_state))
+                else:
+                    finite = jnp.bool_(True)
+                    new_params, new_opt = do((params, opt_state))
+                zeros = jax.tree.map(jnp.zeros_like, acc)
+                return new_params, new_opt, zeros, finite
+
+            new_params, new_opt, zeros, finite = smap(
+                body,
+                in_specs=(P(), opt_spec, P(axis), P(), P()),
+                out_specs=(P(), opt_spec, P(axis), P()),
+                check_vma=False)(
+                    state.params, state.opt_state, state.acc_grads,
+                    1.0 / state.scale.loss_scale, lr)
+            new_scale = update_scale(
+                state.scale, ~finite,
+                dynamic=self._dynamic_scale,
+                scale_window=cfg.fp16.loss_scale_window,
+                min_scale=cfg.fp16.min_loss_scale,
+                delayed_shift=cfg.fp16.hysteresis)
+            state = state._replace(
+                params=new_params, opt_state=new_opt, acc_grads=zeros,
+                scale=new_scale, step=state.step + finite.astype(jnp.int32))
+            # grad clipping is excluded by _validate_onebit_config, so no
+            # global norm is computed (get_global_grad_norm -> None)
+            return state, jnp.float32(0.0), ~finite
+
+        sh = self.state_shardings
+        scalar = NamedSharding(self.mesh, P())
+        self._jit_micro = jax.jit(
+            micro_step, donate_argnums=0,
+            in_shardings=(sh, None, None, None),
+            out_shardings=(sh, scalar))
+        self._jit_apply = jax.jit(
+            apply_step, donate_argnums=0,
+            in_shardings=(sh,),
+            out_shardings=(sh, scalar, scalar))
+        self._jit_train = None          # gas loop path drives train_batch
+        self._jit_offload_pre = None    # offload excluded by validation
+        self._need_norm = False
         self._jit_eval = jax.jit(
             lambda params, batch: self._compute_loss(params, batch, None))
 
@@ -651,10 +887,18 @@ class DeepSpeedEngine:
             grad_norm, overflow = self._offload_step()
         else:
             self.state, grad_norm, overflow = self._jit_apply(self.state)
-        self._last_grad_norm = grad_norm
+        self._post_apply(grad_norm, overflow, lr_kwargs)
+
+    def _post_apply(self, grad_norm, overflow, lr_kwargs=None):
+        """Host bookkeeping after an applied (or skipped) optimizer step."""
+        # None (not a misleading 0.0) when the step skipped computing it
+        self._last_grad_norm = grad_norm if self._need_norm else None
         self.global_steps += 1
         self.global_samples += self.train_batch_size()
-        overflowed = bool(jax.device_get(overflow))
+        # only fp16 can overflow; skipping the device_get elsewhere keeps
+        # the train loop free of a per-step host sync
+        overflowed = (bool(jax.device_get(overflow))
+                      if self.config.fp16_enabled else False)
         if self.quantizer is not None:
             # MoQ: progressive fake-quantization of the trained params
             # (reference _take_model_step hook, engine.py:1816-1827 —
@@ -675,22 +919,46 @@ class DeepSpeedEngine:
         elif self.lr_scheduler is not None:
             self.lr_scheduler.step(**(lr_kwargs or {}))
 
+    def _fused_train_batch(self, data_iter, batch):
+        """gas=1 fast path: one fused compiled program per global step."""
+        micro = batch if batch is not None else next(data_iter)
+        if self.curriculum_scheduler is not None:
+            micro = self._apply_curriculum(micro)
+        if self.progressive_layer_drop is not None:
+            self.progressive_layer_drop.update_state(self.global_steps)
+        theta = jnp.float32(
+            self.progressive_layer_drop.get_theta()
+            if self.progressive_layer_drop is not None else 1.0)
+        with self.mesh:
+            gbatch = self._globalize_batch(micro)
+            self.state, loss, grad_norm, overflow = self._jit_train(
+                self.state, gbatch, self._next_rng(), theta)
+        self._pending_loss = None
+        self._last_batch = gbatch   # flops profiler reads this
+        self.micro_steps += 1
+        self._post_apply(grad_norm, overflow)
+        return loss
+
     def train_batch(self, data_iter=None, batch=None):
         """One full global step: gas micro-batches + optimizer step."""
         self.tput_timer.start()
-        losses = []
-        for _ in range(self.gradient_accumulation_steps()):
-            if batch is not None:
-                micro = batch
-            else:
-                assert data_iter is not None
-                micro = next(data_iter)
-            loss = self.forward(micro)
-            self.backward(loss)
-            losses.append(loss)
-        self.step()
-        self.tput_timer.stop(global_step=True)
-        mean_loss = jnp.mean(jnp.stack(losses))
+        if self._jit_train is not None:
+            mean_loss = self._fused_train_batch(data_iter, batch)
+            self.tput_timer.stop(global_step=True)
+        else:
+            losses = []
+            for _ in range(self.gradient_accumulation_steps()):
+                if batch is not None:
+                    micro = batch
+                else:
+                    assert data_iter is not None
+                    micro = next(data_iter)
+                loss = self.forward(micro)
+                self.backward(loss)
+                losses.append(loss)
+            self.step()
+            self.tput_timer.stop(global_step=True)
+            mean_loss = jnp.mean(jnp.stack(losses))
         if self.global_steps % self.steps_per_print() == 0:
             log_dist(f"step={self.global_steps} loss={float(mean_loss):.6f} "
                      f"lr={self.get_lr()[0]:.3e}", ranks=[0])

@@ -103,6 +103,40 @@ def onebit_adam(b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.0,
     return optim_lib.Optimizer(init, update)
 
 
+def make_global_dist_state(state_cls, params, world):
+    """GLOBAL-layout init for the engine-facing 1-bit optimizers.
+
+    The engine stores optimizer state as global jax.Arrays; the per-rank
+    error-feedback buffers are laid out flat with the rank dim folded in
+    (worker_error [world*P], server_error [world*(P/world)]) and sharded
+    over the data axis, so that inside the engine's shard_map each rank's
+    local block is exactly the [P] / [P/world] buffer the distributed
+    ``update`` expects. Shared by the Adam and LAMB dist-state layouts
+    (identical field structure)."""
+    from deepspeed_tpu.comm.compressed import padded_numel
+    zeros = lambda fn: jax.tree.map(fn, params)  # noqa: E731
+    return state_cls(
+        step=jnp.zeros([], jnp.int32),
+        mu=zeros(lambda p: jnp.zeros(p.shape, jnp.float32)),
+        nu=zeros(lambda p: jnp.zeros(p.shape, jnp.float32)),
+        worker_error=zeros(lambda p: jnp.zeros(
+            (world * padded_numel(p.size, world),), jnp.float32)),
+        server_error=zeros(lambda p: jnp.zeros(
+            (padded_numel(p.size, world),), jnp.float32)))
+
+
+def onebit_adam_engine(axis_name, world, **kw):
+    """Engine-facing wrapper over :func:`onebit_adam_distributed`:
+    ``init`` builds the global layout (:func:`make_global_dist_state`);
+    ``update`` IS the distributed update and must run inside shard_map
+    with ``axis_name`` bound."""
+    base = onebit_adam_distributed(axis_name, world, **kw)
+    return optim_lib.Optimizer(
+        lambda params: make_global_dist_state(
+            OnebitAdamDistState, params, world),
+        base.update)
+
+
 class OnebitAdam:
     """API-parity shell (reference OnebitAdam ctor surface)."""
 

@@ -75,6 +75,112 @@ def onebit_lamb(b1=0.9, b2=0.999, eps=1e-6, weight_decay=0.0,
     return optim_lib.Optimizer(init, update)
 
 
+class OnebitLambDistState(NamedTuple):
+    step: jnp.ndarray
+    mu: Any
+    nu: Any
+    worker_error: Any   # per-leaf flat [P] (comm/nccl.py worker_error)
+    server_error: Any   # per-leaf flat [P / world] (server_error)
+
+
+def onebit_lamb_distributed(axis_name, world, b1=0.9, b2=0.999, eps=1e-6,
+                            weight_decay=0.0, freeze_step=100,
+                            min_coeff=0.01, max_coeff=10.0,
+                            bias_correction=True):
+    """1-bit LAMB with the REAL compressed collective in the loop
+    (reference onebit/lamb.py:14 over comm/nccl.py:47).
+
+    Same contract as :func:`onebit_adam_distributed`: ``update`` must run
+    INSIDE shard_map/pjit with ``axis_name`` bound and rank-LOCAL grads;
+    warmup steps use an exact fp32 pmean, post-freeze the momenta travel
+    through the error-compensated 1-bit allreduce and the variance
+    freezes. The per-tensor trust ratio is computed from the synchronized
+    update, so every rank applies the same scaled step.
+    """
+    from deepspeed_tpu.comm.compressed import (compressed_allreduce,
+                                               padded_numel)
+
+    def init(params):
+        zeros = lambda fn: jax.tree.map(fn, params)  # noqa: E731
+        return OnebitLambDistState(
+            step=jnp.zeros([], jnp.int32),
+            mu=zeros(lambda p: jnp.zeros(p.shape, jnp.float32)),
+            nu=zeros(lambda p: jnp.zeros(p.shape, jnp.float32)),
+            worker_error=zeros(lambda p: jnp.zeros(
+                (padded_numel(p.size, world),), jnp.float32)),
+            server_error=zeros(lambda p: jnp.zeros(
+                (padded_numel(p.size, world) // world,), jnp.float32)))
+
+    def update(grads, state, params, lr):
+        step = state.step + 1
+        if bias_correction:
+            bc1 = 1.0 - b1 ** step.astype(jnp.float32)
+            bc2 = 1.0 - b2 ** step.astype(jnp.float32)
+        else:
+            bc1 = bc2 = jnp.float32(1.0)
+        warm = step <= freeze_step
+
+        def leaf(g, m, v, we, se, p):
+            g = g.astype(jnp.float32)
+            m_local = b1 * m + (1.0 - b1) * g
+
+            def warm_branch(operands):
+                m_local, v, we, se, g = operands
+                m_exact = jax.lax.pmean(m_local, axis_name)
+                v_new = b2 * v + (1.0 - b2) * \
+                    jax.lax.pmean(g, axis_name) ** 2
+                return m_exact, v_new, we, se
+
+            def frozen_branch(operands):
+                m_local, v, we, se, _ = operands
+                m_flat, we_new, se_new = compressed_allreduce(
+                    m_local.reshape(-1), we, se, axis_name)
+                return m_flat.reshape(m_local.shape), v, we_new, se_new
+
+            m_out, v_out, we_out, se_out = jax.lax.cond(
+                warm, warm_branch, frozen_branch, (m_local, v, we, se, g))
+            u = (m_out / bc1) / (jnp.sqrt(v_out / bc2) + eps)
+            if weight_decay > 0.0:
+                u = u + weight_decay * p.astype(jnp.float32)
+            w_norm = jnp.linalg.norm(p.astype(jnp.float32).reshape(-1))
+            u_norm = jnp.linalg.norm(u.reshape(-1))
+            ratio = jnp.where((w_norm > 0) & (u_norm > 0),
+                              jnp.clip(w_norm / u_norm, min_coeff, max_coeff),
+                              jnp.float32(1.0))
+            upd = (-lr * ratio * u).astype(p.dtype)
+            return upd, m_out, v_out, we_out, se_out
+
+        flat_g, treedef = jax.tree.flatten(grads)
+        out = [leaf(g, m, v, we, se, p) for g, m, v, we, se, p in zip(
+            flat_g,
+            treedef.flatten_up_to(state.mu),
+            treedef.flatten_up_to(state.nu),
+            treedef.flatten_up_to(state.worker_error),
+            treedef.flatten_up_to(state.server_error),
+            treedef.flatten_up_to(params))]
+        updates = treedef.unflatten([o[0] for o in out])
+        new_state = OnebitLambDistState(
+            step=step,
+            mu=treedef.unflatten([o[1] for o in out]),
+            nu=treedef.unflatten([o[2] for o in out]),
+            worker_error=treedef.unflatten([o[3] for o in out]),
+            server_error=treedef.unflatten([o[4] for o in out]))
+        return updates, new_state
+
+    return optim_lib.Optimizer(init, update)
+
+
+def onebit_lamb_engine(axis_name, world, **kw):
+    """Engine-facing wrapper: GLOBAL flat error buffers sharded over
+    ``axis_name`` (see onebit/adam.py make_global_dist_state)."""
+    from deepspeed_tpu.runtime.fp16.onebit.adam import make_global_dist_state
+    base = onebit_lamb_distributed(axis_name, world, **kw)
+    return optim_lib.Optimizer(
+        lambda params: make_global_dist_state(
+            OnebitLambDistState, params, world),
+        base.update)
+
+
 class OnebitLamb:
     def __new__(cls, params=None, lr=1e-3, freeze_step=100,
                 betas=(0.9, 0.999), eps=1e-6, weight_decay=0.0,

@@ -1,0 +1,158 @@
+"""Engine-integrated compressed 1-bit optimizers.
+
+The reference's point (onebit/adam.py:14 driving comm/nccl.py:47
+compressed_allreduce) is that selecting OneBitAdam in the CONFIG changes
+the wire traffic of a normal ``initialize()`` run. These tests assert
+exactly that through the public engine surface on a dp=8 mesh:
+
+1. loss parity vs a dp-mean oracle (a dp=1 engine on the same global
+   batch) — exact through the warmup, tracking across the freeze boundary;
+2. the compiled micro-step contains NO grad-sized fp32 all-reduce (grads
+   stay rank-local) and the compiled apply step DOES contain the
+   sign-packed uint8 exchange;
+3. the error-feedback buffers only become non-zero once the freeze
+   boundary is crossed (the compressed branch actually executed).
+"""
+
+import re
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.simple import SimpleModel
+from deepspeed_tpu.utils import groups
+
+HIDDEN = 64
+BATCH = 8
+FREEZE = 3
+
+
+def _config(opt, freeze_step=FREEZE, **opt_params):
+    return {
+        "train_batch_size": BATCH,
+        "train_micro_batch_size_per_gpu": BATCH //
+        groups.get_data_parallel_world_size(),
+        "steps_per_print": 10 ** 9,
+        "optimizer": {"type": opt,
+                      "params": {"lr": 1e-2, "freeze_step": freeze_step,
+                                 **opt_params}},
+    }
+
+
+def _make_engine(opt, **opt_params):
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=HIDDEN, nlayers=2),
+        config=_config(opt, **opt_params),
+        sample_batch=_batch(0))
+    return engine
+
+
+def _batch(i):
+    rng = np.random.default_rng(100 + i)
+    return (rng.standard_normal((BATCH, HIDDEN)).astype(np.float32),
+            rng.standard_normal((BATCH, HIDDEN)).astype(np.float32))
+
+
+def _run(engine, steps):
+    return [float(engine.train_batch(batch=_batch(i)))
+            for i in range(steps)]
+
+
+@pytest.mark.parametrize("opt", ["OneBitAdam", "OneBitLamb"])
+def test_engine_onebit_loss_parity_across_freeze(opt):
+    # dp=8: the real compressed data path
+    groups.initialize()
+    dist = _make_engine(opt)
+    assert dist._onebit_dist
+    dist_losses = _run(dist, FREEZE + 3)
+    groups.destroy()
+
+    # dp-mean oracle: dp=1 engine on the SAME global batches — during
+    # warmup the distributed path's pmean reproduces its exact grads
+    groups.initialize(devices=jax.devices()[:1])
+    oracle = _make_engine(opt)
+    assert not oracle._onebit_dist
+    oracle_losses = _run(oracle, FREEZE + 3)
+
+    # losses at steps 0..FREEZE-1 come from warmup-updated params: exact
+    np.testing.assert_allclose(dist_losses[:FREEZE], oracle_losses[:FREEZE],
+                               rtol=1e-4)
+    # across the boundary the compression errors differ (per-rank momenta
+    # vs whole-momentum), but the trajectories must keep tracking
+    np.testing.assert_allclose(dist_losses[FREEZE:], oracle_losses[FREEZE:],
+                               rtol=0.15)
+    assert dist_losses[-1] < dist_losses[0]
+
+
+def test_engine_onebit_wire_format():
+    groups.initialize()
+    engine = _make_engine("OneBitAdam")
+
+    # jaxpr renders each eqn one-line with the output type inline
+    # (``c:f32[64,64] = psum ...``), unlike StableHLO's multi-line regions
+    micro_jaxpr = str(jax.make_jaxpr(
+        lambda s, b, r, t: engine._jit_micro(s, b, r, t))(
+            engine.state, jax.device_put(_batch(0)), jax.random.PRNGKey(0),
+            np.float32(1.0)))
+    # grads must stay rank-local: every psum in the micro step is
+    # scalar-sized (the loss pmean, f32[]), never a grad-sized f32[NxM]
+    assert "psum" in micro_jaxpr  # the loss pmean is there
+    bad = [ln.strip()[:120] for ln in micro_jaxpr.splitlines()
+           if re.search(r"f32\[\d[^\]]*\] = psum", ln)]
+    assert not bad, f"grad-sized psum in micro step: {bad[:3]}"
+
+    apply_jaxpr = str(jax.make_jaxpr(
+        lambda s: engine._jit_apply(s))(engine.state))
+    # the compressed exchange: sign bytes travel as uint8 through
+    # all_to_all (phase 1) and all_gather (phase 2). (The warmup branch
+    # legitimately carries exact f32 pmeans inside its cond arm, so only
+    # PRESENCE of the 1-bit wire format is asserted here.)
+    assert any("u8[" in ln and "all_to_all" in ln
+               for ln in apply_jaxpr.splitlines()), \
+        "no uint8 all_to_all (compressed exchange) in the apply step"
+    assert any("u8[" in ln and "all_gather" in ln
+               for ln in apply_jaxpr.splitlines()), \
+        "no uint8 all_gather (server broadcast) in the apply step"
+
+
+def test_engine_onebit_error_feedback_activates_at_freeze():
+    groups.initialize()
+    engine = _make_engine("OneBitAdam")
+
+    def max_err():
+        return max(float(np.abs(np.asarray(x)).max()) for x in
+                   jax.tree.leaves(engine.state.opt_state.worker_error))
+
+    _run(engine, FREEZE)          # warmup only
+    assert max_err() == 0.0
+    _run(engine, 1)               # first compressed step
+    assert max_err() > 0.0
+
+
+def test_engine_onebit_rejects_incompatible_config():
+    groups.initialize()
+    cfg = _config("OneBitAdam")
+    cfg["zero_optimization"] = {"stage": 1}
+    with pytest.raises(ValueError, match="zero_optimization"):
+        deepspeed_tpu.initialize(
+            model=SimpleModel(hidden_dim=HIDDEN, nlayers=2),
+            config=cfg, sample_batch=_batch(0))
+
+
+def test_engine_onebit_checkpoint_roundtrip(tmp_path):
+    groups.initialize()
+    engine = _make_engine("OneBitAdam")
+    _run(engine, FREEZE + 2)      # past the freeze: error buffers live
+    engine.save_checkpoint(str(tmp_path), tag="ob")
+
+    fresh = _make_engine("OneBitAdam")
+    fresh.load_checkpoint(str(tmp_path), tag="ob")
+    for a, b in zip(jax.tree.leaves(engine.state.opt_state),
+                    jax.tree.leaves(fresh.state.opt_state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # resumed run continues identically
+    la = _run(engine, 2)
+    lb = _run(fresh, 2)
+    np.testing.assert_allclose(la, lb, rtol=1e-6)
