@@ -128,18 +128,34 @@ def initialize(args=None,
             "PipelineModule initialization needs a dict/JSON config")
         assert "sample_batch" in kwargs, (
             "PipelineModule initialization requires sample_batch=")
-        assert optimizer is None and lr_scheduler is None and \
-            training_data is None and model_parameters is None, (
-                "the 1F1B PipelineEngine drives its own AdamW; client "
-                "optimizer/lr_scheduler/training_data are unsupported")
-        # proper triangulation + validation (dp-world aware) comes from
-        # DeepSpeedConfig — the pipeline engine is host-side dp=1
-        cfg = DeepSpeedConfig(_cfg_dict, data_parallel_size=1)
+        assert optimizer is None and training_data is None and \
+            model_parameters is None, (
+                "the 1F1B PipelineEngine drives its own optimizer; client "
+                "optimizer/training_data are unsupported")
+        # proper triangulation + validation comes from DeepSpeedConfig;
+        # dp replicates whole pipeline columns (PP x DP grid)
+        _dp = int(kwargs.get("dp", 1))
+        cfg = DeepSpeedConfig(_cfg_dict, data_parallel_size=_dp)
+        # fail LOUDLY on config keys this engine does not implement
+        # (ADVICE r2: silently dropping fp16/zero/scheduler keys trains
+        # differently than the reference JSON asks for)
+        if cfg.zero_optimization_stage != 0:
+            raise DeepSpeedConfigError(
+                f"the host-loop PipelineEngine does not implement ZeRO "
+                f"(got stage {cfg.zero_optimization_stage}); use the SPMD "
+                f"pipeline (GPT2Config.pp_stages) for ZeRO x PP, or stage 0")
         _opt_name = (cfg.optimizer_name or "adam").lower()
-        assert _opt_name in ("adam", "adamw"), (
-            f"PipelineEngine drives AdamW; optimizer type "
-            f"{cfg.optimizer_name!r} is unsupported on this path")
         opt_params = cfg.optimizer_params or {}
+        if cfg.fp16_enabled:
+            _dtype = jnp.float16
+        elif cfg.bfloat16_enabled:
+            _dtype = jnp.bfloat16
+        else:
+            _dtype = None
+        sched = lr_scheduler
+        if sched is None and cfg.scheduler_name is not None:
+            sched = lr_schedules.get_lr_schedule(cfg.scheduler_name,
+                                                 cfg.scheduler_params)
         engine = PipelineEngine(
             model, kwargs["sample_batch"],
             num_microbatches=max(1, cfg.gradient_accumulation_steps),
@@ -147,8 +163,21 @@ def initialize(args=None,
             betas=tuple(opt_params.get("betas", (0.9, 0.999))),
             eps=opt_params.get("eps", 1e-8),
             weight_decay=opt_params.get("weight_decay", 0.0),
-            seed=kwargs.get("seed", 0))
-        return engine, None, None, None
+            seed=kwargs.get("seed", 0),
+            dp=_dp,
+            optimizer_name=_opt_name,
+            compute_dtype=_dtype,
+            dynamic_loss_scale=(cfg.fp16_enabled and
+                                cfg.fp16.dynamic_loss_scale),
+            initial_scale=(cfg.initial_dynamic_scale
+                           if cfg.fp16_enabled and cfg.fp16.dynamic_loss_scale
+                           else (cfg.loss_scale if cfg.fp16_enabled else 1.0)),
+            scale_window=cfg.fp16.loss_scale_window,
+            min_scale=cfg.fp16.min_loss_scale,
+            hysteresis=cfg.fp16.hysteresis,
+            lr_scheduler=sched,
+            gradient_clipping=cfg.gradient_clipping)
+        return engine, None, None, engine.lr_scheduler
 
     engine = DeepSpeedEngine(args=args,
                              model=model,

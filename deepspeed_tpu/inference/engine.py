@@ -190,12 +190,15 @@ class InferenceEngine:
             raise ValueError(
                 f"prompt ({S}) + max_new_tokens ({max_new_tokens}) exceeds "
                 f"the model's n_positions ({max_pos})")
-        loop = self._gen_cache.get((temperature, eos_token_id))
+        key = (temperature, eos_token_id)
+        loop = self._gen_cache.pop(key, None)
         if loop is None:
             if len(self._gen_cache) >= 32:  # bound compiled-program leak
-                self._gen_cache.clear()
+                # LRU eviction: hits below re-insert, so insertion order
+                # is recency order and the front is the least recent
+                self._gen_cache.pop(next(iter(self._gen_cache)))
             loop = self._build_cached_loop(temperature, eos_token_id)
-            self._gen_cache[(temperature, eos_token_id)] = loop
+        self._gen_cache[key] = loop  # (re-)insert at the back: most recent
         with self.mesh:
             new = loop(self.params, input_ids, rng, max_new_tokens)
         return jnp.concatenate([input_ids, new], axis=1)

@@ -23,6 +23,10 @@ def main(argv=None):
     parser.add_argument("-H", "--hostfile", default=DLTS_HOSTFILE)
     parser.add_argument("--include", default="")
     parser.add_argument("--exclude", default="")
+    parser.add_argument("--no-strict-host-key-checking", action="store_true",
+                        help="pass -o StrictHostKeyChecking=no to ssh "
+                             "(accepts unknown host keys; off by default "
+                             "so the user's ssh defaults apply)")
     parser.add_argument("--dry-run", action="store_true",
                         help="print the per-host commands without running")
     parser.add_argument("command", nargs=argparse.REMAINDER,
@@ -64,8 +68,10 @@ def main(argv=None):
         if local:
             proc = subprocess.run(cmd, shell=True)
         else:
-            proc = subprocess.run(["ssh", "-o", "StrictHostKeyChecking=no",
-                                   host, cmd])
+            ssh_cmd = ["ssh"]
+            if args.no_strict_host_key_checking:
+                ssh_cmd += ["-o", "StrictHostKeyChecking=no"]
+            proc = subprocess.run(ssh_cmd + [host, cmd])
         rc = rc or proc.returncode
     return rc
 

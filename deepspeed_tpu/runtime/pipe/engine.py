@@ -29,6 +29,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from deepspeed_tpu.runtime.pipe import schedule as sched_mod
 from deepspeed_tpu.runtime.pipe.module import (LayerSpec, PipelineModule,
@@ -54,10 +55,21 @@ class _Mailbox:
         return self._box.pop(key)
 
 
-class _StageRunner:
-    """One pipeline stage: its specs, params, compiled fwd/bwd, buffers."""
+def _cast_tree(tree, dtype):
+    return jax.tree.map(
+        lambda x: x.astype(dtype)
+        if jnp.issubdtype(x.dtype, jnp.floating) else x, tree)
 
-    def __init__(self, stage_id, num_stages, specs, loss_fn, device, rng):
+
+class _StageRunner:
+    """One pipeline stage: its specs, params, compiled fwd/bwd, buffers.
+
+    ``compute_dtype``: fp32 master params are cast before the stage body
+    runs (the main engine's mixed-precision convention, engine.py
+    _compute_loss)."""
+
+    def __init__(self, stage_id, num_stages, specs, loss_fn, device, rng,
+                 compute_dtype=None):
         self.stage_id = stage_id
         self.is_first = stage_id == 0
         self.is_last = stage_id == num_stages - 1
@@ -96,10 +108,16 @@ class _StageRunner:
         self.module = _Stage()
         self.params = None  # set by engine (init or tied sync)
         self._rng = rng
+        cdt = compute_dtype
 
         def apply(p, x, labels=None):
+            if cdt is not None:
+                p = _cast_tree(p, cdt)
+                if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating):
+                    x = jnp.asarray(x).astype(cdt)
             if is_last and loss is not None:
-                return self.module.apply({"params": p}, x, labels)
+                return jnp.asarray(
+                    self.module.apply({"params": p}, x, labels), jnp.float32)
             return self.module.apply({"params": p}, x)
 
         self._apply = apply
@@ -109,16 +127,20 @@ class _StageRunner:
             is_first = self.is_first
 
             def bwd(p, x, labels, ct):
+                scaled = lambda g: g * ct.astype(g.dtype)  # noqa: E731
                 if is_first:  # single stage: input is raw (int) data
                     g_p = jax.grad(lambda p: apply(p, x, labels))(p)
-                    return jax.tree.map(lambda g: g * ct, g_p), None
+                    return jax.tree.map(scaled, g_p), None
                 g_p, g_x = jax.grad(
                     lambda p, x: apply(p, x, labels), argnums=(0, 1))(p, x)
-                return (jax.tree.map(lambda g: g * ct, g_p),
-                        jax.tree.map(lambda g: g * ct, g_x))
+                return (jax.tree.map(scaled, g_p),
+                        jax.tree.map(scaled, g_x))
         else:
             def bwd(p, x, ct):
-                _, vjp = jax.vjp(lambda p, x: apply(p, x), p, x)
+                out, vjp = jax.vjp(lambda p, x: apply(p, x), p, x)
+                # the upstream ct may arrive in a wider dtype (e.g. the
+                # fp32 loss-scale seed times an fp16 activation grad)
+                ct = jax.tree.map(lambda c, o: c.astype(o.dtype), ct, out)
                 return vjp(ct)
         self.bwd = jax.jit(bwd)
 
@@ -147,25 +169,59 @@ class PipelineEngine:
     def __init__(self, pipe_module: PipelineModule, sample_batch,
                  num_microbatches: int, lr=1e-3, betas=(0.9, 0.999),
                  eps=1e-8, weight_decay=0.0, devices: Optional[List] = None,
-                 seed: int = 0, grad_scale_by_microbatches: bool = True):
+                 seed: int = 0, grad_scale_by_microbatches: bool = True,
+                 dp: int = 1, optimizer_name: str = "adamw",
+                 compute_dtype=None, dynamic_loss_scale: bool = False,
+                 initial_scale: float = 1.0, scale_window: int = 1000,
+                 min_scale: float = 1.0, hysteresis: int = 1,
+                 lr_scheduler=None, gradient_clipping: float = 0.0):
         self.pm = pipe_module
         self.S = pipe_module.num_stages
         self.M = num_microbatches
-        assert self.S >= 1
+        self.dp = dp
+        assert self.S >= 1 and dp >= 1
         self.loss_fn = pipe_module.loss_fn
         assert self.loss_fn is not None, "PipelineModule needs loss_fn"
         devs = devices or jax.devices()
-        if len(devs) < self.S:
-            devs = [devs[i % len(devs)] for i in range(self.S)]
-        self.devices = devs[:self.S]
+        # device grid [S][dp]: replica d of stage s runs its own pipeline
+        # column (reference PipeModelDataParallelTopology: PP x DP axes)
+        need = self.S * dp
+        if len(devs) < need:
+            devs = [devs[i % len(devs)] for i in range(need)]
+        self.dev_grid = [[devs[s * dp + d] for d in range(dp)]
+                         for s in range(self.S)]
+        self.devices = [row[0] for row in self.dev_grid]
         self._scale_by_M = grad_scale_by_microbatches
         self.global_steps = 0
+        self.skipped_steps = 0
+        self.compute_dtype = compute_dtype
+        self.gradient_clipping = float(gradient_clipping)
+
+        # fp16 loss scaling, reusing the main engine's scale-state machine
+        # (runtime/fp16/loss_scaler.py; reference PipelineEngine inherits
+        # this from DeepSpeedEngine's FP16_Optimizer)
+        from deepspeed_tpu.runtime.fp16.loss_scaler import (
+            make_scale_state, update_scale)
+        self._fp16 = compute_dtype == jnp.float16
+        self._dynamic_scale = bool(dynamic_loss_scale and self._fp16)
+        self._scale_state = make_scale_state(
+            float(initial_scale) if self._fp16 else 1.0,
+            delayed_shift=hysteresis)
+        self._scale_cfg = dict(scale_window=scale_window,
+                               min_scale=min_scale,
+                               delayed_shift=hysteresis)
+        self._update_scale = update_scale
+
+        # LR schedule (reference PipelineEngine lr via DeepSpeedEngine
+        # _configure_lr_scheduler, runtime/engine.py:790)
+        self.lr_scheduler = lr_scheduler
 
         rng = jax.random.PRNGKey(seed)
         self.stages = [
             _StageRunner(s, self.S, pipe_module.stage_layers(s),
                          self.loss_fn, self.devices[s],
-                         jax.random.fold_in(rng, s))
+                         jax.random.fold_in(rng, s),
+                         compute_dtype=compute_dtype)
             for s in range(self.S)
         ]
         # shape-propagating init on a sample micro-batch
@@ -188,13 +244,21 @@ class PipelineEngine:
                         src, self.stages[s].device)
                     self.stages[s].params = p
 
-        # the repo's own Adam (runtime/optim.py) so weight_decay keeps the
-        # decoupled-AdamW semantics every other engine uses
+        # optimizer from the shared runtime/optim.py; 'Adam' keeps the
+        # reference's L2-regularised semantics, 'AdamW' decoupled decay
+        # (ADVICE r2: adam_w_mode must follow the configured type)
         from deepspeed_tpu.runtime import optim as optim_lib
         self.lr = lr
-        self.opt = optim_lib.adam(b1=betas[0], b2=betas[1], eps=eps,
-                                  weight_decay=weight_decay,
-                                  adam_w_mode=True)
+        name = optimizer_name.lower()
+        if name in ("adam", "adamw"):
+            self.opt = optim_lib.adam(b1=betas[0], b2=betas[1], eps=eps,
+                                      weight_decay=weight_decay,
+                                      adam_w_mode=(name == "adamw"))
+        elif name == "sgd":
+            self.opt = optim_lib.sgd(weight_decay=weight_decay)
+        else:
+            raise ValueError(
+                f"PipelineEngine supports Adam/AdamW/SGD, got {name!r}")
         self.opt_states = [self.opt.init(st.params) for st in self.stages]
 
         def opt_step(grads, opt_state, params, lr_val):
@@ -202,8 +266,18 @@ class PipelineEngine:
                                                  lr_val)
             return jax.tree.map(jnp.add, params, updates), new_state
         self._opt_step = jax.jit(opt_step)
-        log_dist(f"PipelineEngine(1F1B host loop): stages={self.S} "
+
+        def grad_stats(g):
+            leaves = jax.tree.leaves(g)
+            finite = jnp.all(jnp.stack(
+                [jnp.isfinite(leaf).all() for leaf in leaves]))
+            sumsq = sum(jnp.sum(leaf.astype(jnp.float32) ** 2)
+                        for leaf in leaves)
+            return finite, sumsq
+        self._grad_stats = jax.jit(grad_stats)
+        log_dist(f"PipelineEngine(1F1B host loop): stages={self.S} dp={dp} "
                  f"microbatches={self.M} parts={pipe_module.parts} "
+                 f"dtype={getattr(compute_dtype, '__name__', 'float32')} "
                  f"tied={list(self._tied)}", ranks=[0])
 
     def _split_sample(self, batch):
@@ -212,87 +286,142 @@ class PipelineEngine:
             labels[: max(1, labels.shape[0] // self.M)]
 
     # ------------------------------------------------------------- execution
+    def get_lr(self):
+        applied = max(0, self.global_steps - self.skipped_steps)
+        if self.lr_scheduler is not None:
+            return [float(self.lr_scheduler.as_schedule_fn()(applied))]
+        return [self.lr]
+
+    @property
+    def loss_scale(self):
+        return float(jax.device_get(self._scale_state.loss_scale))
+
     def train_batch(self, batch):
+        """One global step: M micro-batches per dp column through the
+        TrainSchedule, dp-averaged ReduceGrads, tied-grad allreduce,
+        fp16 unscale/overflow-skip, clip, optimizer + LR-schedule step.
+
+        GAS in the reference pipeline IS the micro-batch count
+        (train_batch_size = micro_batch * gas * dp, pipe engine.py:46),
+        so there is no separate accumulation loop here."""
         x, labels = batch[0], batch[1]
         B = x.shape[0]
-        assert B % self.M == 0, f"batch {B} % microbatches {self.M} != 0"
-        mb = B // self.M
-        micro_x = [jax.device_put(x[i * mb:(i + 1) * mb], self.devices[0])
-                   for i in range(self.M)]
-        micro_y = [jax.device_put(labels[i * mb:(i + 1) * mb],
-                                  self.devices[-1])
-                   for i in range(self.M)]
+        D, M, S = self.dp, self.M, self.S
+        assert B % (M * D) == 0, \
+            f"batch {B} % (microbatches {M} * dp {D}) != 0"
+        mb = B // (M * D)
 
-        schedules = [sched_mod.TrainSchedule(self.M, self.S, s)
-                     for s in range(self.S)]
-        streams = [list(sch.steps()) for sch in schedules]
+        def rows(d, i):
+            r = (d * M + i) * mb
+            return slice(r, r + mb)
+
+        micro_x = {(d, i): jax.device_put(x[rows(d, i)],
+                                          self.dev_grid[0][d])
+                   for d in range(D) for i in range(M)}
+        micro_y = {(d, i): jax.device_put(labels[rows(d, i)],
+                                          self.dev_grid[-1][d])
+                   for d in range(D) for i in range(M)}
+
+        scale = (float(jax.device_get(self._scale_state.loss_scale))
+                 if self._fp16 else 1.0)
+        ct_seed = jnp.asarray(
+            (1.0 / M if self._scale_by_M else 1.0) * scale, jnp.float32)
+
+        schedules = [sched_mod.TrainSchedule(M, S, s) for s in range(S)]
+        stage_streams = [list(sch.steps()) for sch in schedules]
         nbuf = [sch.num_pipe_buffers() for sch in schedules]
-        # per-stage ring buffers (reference engine.py pipe_buffers)
-        in_buf = [[None] * nbuf[s] for s in range(self.S)]
-        lbl_buf = [[None] * nbuf[s] for s in range(self.S)]
-        grad_in = [[None] * nbuf[s] for s in range(self.S)]  # recv'd ct
-        grad_out = [[None] * nbuf[s] for s in range(self.S)]  # computed g_x
-        out_buf = [[None] * nbuf[s] for s in range(self.S)]
-        grad_accum = [None] * self.S
+        # per-(stage, replica) ring buffers (reference pipe_buffers)
+        in_buf = {(s, d): [None] * nbuf[s] for s in range(S)
+                  for d in range(D)}
+        lbl_buf = {(s, d): [None] * nbuf[s] for s in range(S)
+                   for d in range(D)}
+        grad_in = {(s, d): [None] * nbuf[s] for s in range(S)
+                   for d in range(D)}
+        grad_out = {(s, d): [None] * nbuf[s] for s in range(S)
+                    for d in range(D)}
+        out_buf = {(s, d): [None] * nbuf[s] for s in range(S)
+                   for d in range(D)}
+        # replicated params per column (DP broadcast of the stage master)
+        rep_params = [[st.params if d == 0 else
+                       jax.device_put(st.params, self.dev_grid[s][d])
+                       for d in range(D)]
+                      for s, st in enumerate(self.stages)]
+        grad_accum = [[None] * D for _ in range(S)]
+        grad_total: List[Any] = [None] * S
+        reduced = [0] * S
         losses = []
         box = _Mailbox()
-        total_steps = len(streams[0])
-        ct_seed = jnp.asarray(1.0 / self.M if self._scale_by_M else 1.0,
-                              jnp.float32)
 
-        def execute(s, cmd):
+        def execute(s, d, cmd):
             st = self.stages[s]
             name = type(cmd).__name__
             if name == "LoadMicroBatch":
                 if st.is_first:
-                    in_buf[s][cmd.buffer_id] = micro_x[cmd.micro_batch_id]
+                    in_buf[s, d][cmd.buffer_id] = micro_x[d, cmd.micro_batch_id]
                 if st.is_last:
-                    lbl_buf[s][cmd.buffer_id] = micro_y[cmd.micro_batch_id]
+                    lbl_buf[s, d][cmd.buffer_id] = micro_y[d, cmd.micro_batch_id]
             elif name == "ForwardPass":
-                xin = in_buf[s][cmd.buffer_id]
+                xin = in_buf[s, d][cmd.buffer_id]
                 if st.is_last:
-                    out = st.fwd(st.params, xin, lbl_buf[s][cmd.buffer_id])
+                    out = st.fwd(rep_params[s][d], xin,
+                                 lbl_buf[s, d][cmd.buffer_id])
                     losses.append(out)
                 else:
-                    out = st.fwd(st.params, xin)
-                out_buf[s][cmd.buffer_id] = out
+                    out = st.fwd(rep_params[s][d], xin)
+                out_buf[s, d][cmd.buffer_id] = out
             elif name == "BackwardPass":
-                xin = in_buf[s][cmd.buffer_id]
+                xin = in_buf[s, d][cmd.buffer_id]
                 if st.is_last:
-                    g_p, g_x = st.bwd(st.params, xin,
-                                      lbl_buf[s][cmd.buffer_id], ct_seed)
+                    g_p, g_x = st.bwd(rep_params[s][d], xin,
+                                      lbl_buf[s, d][cmd.buffer_id], ct_seed)
                 else:
-                    g_p, g_x = st.bwd(st.params, xin,
-                                      grad_in[s][cmd.buffer_id])
-                    grad_in[s][cmd.buffer_id] = None
-                grad_out[s][cmd.buffer_id] = g_x
-                grad_accum[s] = g_p if grad_accum[s] is None else \
-                    jax.tree.map(jnp.add, grad_accum[s], g_p)
+                    g_p, g_x = st.bwd(rep_params[s][d], xin,
+                                      grad_in[s, d][cmd.buffer_id])
+                    grad_in[s, d][cmd.buffer_id] = None
+                grad_out[s, d][cmd.buffer_id] = g_x
+                grad_accum[s][d] = g_p if grad_accum[s][d] is None else \
+                    jax.tree.map(jnp.add, grad_accum[s][d], g_p)
             elif name == "SendActivation":
-                box.send(("act", s + 1, cmd.micro_batch_id),
-                         jax.device_put(out_buf[s][cmd.buffer_id],
-                                        self.devices[s + 1]))
-                out_buf[s][cmd.buffer_id] = None
+                box.send(("act", s + 1, d, cmd.micro_batch_id),
+                         jax.device_put(out_buf[s, d][cmd.buffer_id],
+                                        self.dev_grid[s + 1][d]))
+                out_buf[s, d][cmd.buffer_id] = None
             elif name == "RecvActivation":
-                in_buf[s][cmd.buffer_id] = box.recv(
-                    ("act", s, cmd.micro_batch_id))
+                in_buf[s, d][cmd.buffer_id] = box.recv(
+                    ("act", s, d, cmd.micro_batch_id))
             elif name == "SendGrad":
-                box.send(("grad", s - 1, cmd.micro_batch_id),
-                         jax.device_put(grad_out[s][cmd.buffer_id],
-                                        self.devices[s - 1]))
-                grad_out[s][cmd.buffer_id] = None
+                box.send(("grad", s - 1, d, cmd.micro_batch_id),
+                         jax.device_put(grad_out[s, d][cmd.buffer_id],
+                                        self.dev_grid[s - 1][d]))
+                grad_out[s, d][cmd.buffer_id] = None
             elif name == "RecvGrad":
-                grad_in[s][cmd.buffer_id] = box.recv(
-                    ("grad", s, cmd.micro_batch_id))
+                grad_in[s, d][cmd.buffer_id] = box.recv(
+                    ("grad", s, d, cmd.micro_batch_id))
             elif name == "ReduceTiedGrads":
-                pass  # handled globally below (single controller)
+                pass  # cross-STAGE reduce, handled after the loop
             elif name == "ReduceGrads":
-                pass  # dp allreduce: dp=1 in the host-loop engine
+                # the dp allreduce (reference _exec_reduce_grads :246):
+                # when the LAST replica of this stage arrives, average the
+                # column grads onto the stage master device
+                reduced[s] += 1
+                if reduced[s] == D:
+                    dev0 = self.dev_grid[s][0]
+                    tot = jax.tree.map(
+                        lambda g: jax.device_put(g, dev0), grad_accum[s][0])
+                    for d2 in range(1, D):
+                        other = jax.tree.map(
+                            lambda g: jax.device_put(g, dev0),
+                            grad_accum[s][d2])
+                        tot = jax.tree.map(jnp.add, tot, other)
+                    grad_total[s] = (jax.tree.map(lambda g: g / D, tot)
+                                     if D > 1 else tot)
             elif name == "OptimizerStep":
                 pass  # applied once after the loop
             else:  # pragma: no cover
                 raise ValueError(f"unknown instruction {name}")
 
+        streams = {(s, d): stage_streams[s] for s in range(S)
+                   for d in range(D)}
         self._run_schedule(streams, execute, box)
 
         # tied-weight grad allreduce (reference _exec_reduce_tied_grads
@@ -302,49 +431,95 @@ class PipelineEngine:
             if len(owners) < 2:
                 continue
             subs = [jax.tree.map(lambda g: jax.device_put(g, jax.devices()[0]),
-                                 grad_accum[s][f"tied_{key}"])
+                                 grad_total[s][f"tied_{key}"])
                     for s in owners]
             total = subs[0]
             for other in subs[1:]:
                 total = jax.tree.map(jnp.add, total, other)
             for s in owners:
-                g = dict(grad_accum[s])
+                g = dict(grad_total[s])
                 g[f"tied_{key}"] = jax.device_put(total,
                                                   self.stages[s].device)
-                grad_accum[s] = g
+                grad_total[s] = g
 
-        # optimizer step per stage
-        for s, st in enumerate(self.stages):
-            st.params, self.opt_states[s] = self._opt_step(
-                grad_accum[s], self.opt_states[s], st.params,
-                jnp.float32(self.lr))
+        if self._fp16 and scale != 1.0:
+            inv = 1.0 / scale
+            grad_total = [jax.tree.map(lambda g: g * inv, gt)
+                          for gt in grad_total]
+
+        # one compiled reduction per stage (finite-check + clip sumsq), not
+        # a host transfer per leaf; tied copies past the first owner are
+        # excluded so their (identical, already-summed) grads enter the
+        # global norm exactly once, matching the non-pipelined engine
+        need_stats = self._fp16 or self.gradient_clipping > 0
+        overflow = False
+        sumsq = 0.0
+        if need_stats:
+            dup_tied = {(s, f"tied_{key}")
+                        for key, owners in self._tied.items()
+                        for s in owners[1:]}
+            stats = []
+            for s, gt in enumerate(grad_total):
+                once = {k: v for k, v in gt.items()
+                        if (s, k) not in dup_tied}
+                stats.append(self._grad_stats(once))
+            finites, sqs = zip(*[jax.device_get(st) for st in stats])
+            overflow = self._fp16 and not all(bool(f) for f in finites)
+            sumsq = float(sum(sqs))
+
+        if self._fp16:
+            self._scale_state = self._update_scale(
+                self._scale_state, jnp.asarray(overflow),
+                dynamic=self._dynamic_scale, **self._scale_cfg)
+        if overflow:
+            self.skipped_steps += 1
+            log_dist(f"[pipe] OVERFLOW! skipping step; new loss scale: "
+                     f"{self.loss_scale}", ranks=[0])
+        else:
+            if self.gradient_clipping > 0:
+                norm = sumsq ** 0.5
+                if norm > self.gradient_clipping:
+                    factor = self.gradient_clipping / (norm + 1e-6)
+                    grad_total = [jax.tree.map(lambda g: g * factor, gt)
+                                  for gt in grad_total]
+            lr_val = jnp.float32(self.get_lr()[0])
+            for s, st in enumerate(self.stages):
+                st.params, self.opt_states[s] = self._opt_step(
+                    grad_total[s], self.opt_states[s], st.params, lr_val)
+            if self.lr_scheduler is not None:
+                self.lr_scheduler.step()
         self.global_steps += 1
-        return jnp.mean(jnp.stack(losses))
+        # column losses live on their replica's device: co-locate to mean
+        return jnp.mean(jnp.stack(
+            [jax.device_put(l, self.devices[-1]) for l in losses]))
 
     def _run_schedule(self, streams, execute, box):
-        """Cooperative interpretation of per-stage instruction streams: a
-        stage blocks only on an un-arrived recv; everything else retires
-        in order (the p2p pairing of pipe/p2p.py)."""
-        for t in range(len(streams[0])):
-            pending = {s: list(streams[s][t]) for s in range(self.S)}
+        """Cooperative interpretation of per-(stage, replica) instruction
+        streams: a stage blocks only on an un-arrived recv; everything
+        else retires in order (the p2p pairing of pipe/p2p.py)."""
+        keys = sorted(streams)
+        nsteps = len(next(iter(streams.values())))
+        for t in range(nsteps):
+            pending = {k: list(streams[k][t]) for k in keys}
             while any(pending.values()):
                 progressed = False
-                for s in range(self.S):
-                    while pending[s]:
-                        cmd = pending[s][0]
+                for k in keys:
+                    s, d = k if isinstance(k, tuple) else (k, 0)
+                    while pending[k]:
+                        cmd = pending[k][0]
                         nm = type(cmd).__name__
                         if nm == "RecvActivation" and not box.ready(
-                                ("act", s, cmd.micro_batch_id)):
+                                ("act", s, d, cmd.micro_batch_id)):
                             break
                         if nm == "RecvGrad" and not box.ready(
-                                ("grad", s, cmd.micro_batch_id)):
+                                ("grad", s, d, cmd.micro_batch_id)):
                             break
-                        execute(s, pending[s].pop(0))
+                        execute(s, d, pending[k].pop(0))
                         progressed = True
                 if not progressed:
                     raise RuntimeError(
                         f"pipeline deadlock at step {t}: "
-                        f"{ {s: p for s, p in pending.items() if p} }")
+                        f"{ {k: p for k, p in pending.items() if p} }")
 
     def eval_batch(self, batch):
         """Forward-only pipeline pass executing InferenceSchedule
@@ -370,7 +545,7 @@ class PipelineEngine:
         losses = []
         box = _Mailbox()
 
-        def execute(s, cmd):
+        def execute(s, d, cmd):
             st = self.stages[s]
             name = type(cmd).__name__
             if name == "LoadMicroBatch":
@@ -386,18 +561,155 @@ class PipelineEngine:
                 else:
                     out_buf[s][cmd.buffer_id] = st.fwd(st.params, xin)
             elif name == "SendActivation":
-                box.send(("act", s + 1, cmd.micro_batch_id),
+                box.send(("act", s + 1, 0, cmd.micro_batch_id),
                          jax.device_put(out_buf[s][cmd.buffer_id],
                                         self.devices[s + 1]))
                 out_buf[s][cmd.buffer_id] = None
             elif name == "RecvActivation":
                 in_buf[s][cmd.buffer_id] = box.recv(
-                    ("act", s, cmd.micro_batch_id))
+                    ("act", s, 0, cmd.micro_batch_id))
             else:  # pragma: no cover
                 raise ValueError(f"unexpected inference instruction {name}")
 
-        self._run_schedule(streams, execute, box)
+        self._run_schedule({(s, 0): streams[s] for s in range(self.S)},
+                           execute, box)
         return jnp.mean(jnp.stack(losses))
+
+    # ---------------------------------------------------------- checkpoints
+    def save_checkpoint(self, save_dir, tag=None, client_state=None,
+                        save_latest=True):
+        """Per-LAYER checkpoint files (reference pipe/module.py:537
+        ckpt_layer_path + save_state_dict): layer params are keyed by
+        GLOBAL layer index, so a checkpoint written with one stage
+        partitioning loads into any other. Tied layers save once under
+        their key; stage optimizer states save per stage."""
+        import os
+        import pickle
+        if tag is None:
+            tag = f"global_step{self.global_steps}"
+        ckpt_dir = os.path.join(save_dir, str(tag))
+        os.makedirs(ckpt_dir, exist_ok=True)
+
+        tied_written = set()
+        for s, st in enumerate(self.stages):
+            for li, spec in enumerate(st.specs):
+                if isinstance(spec, TiedLayerSpec):
+                    if spec.key in tied_written:
+                        continue
+                    tied_written.add(spec.key)
+                    path = os.path.join(ckpt_dir,
+                                        f"tied_{spec.key}-model_states.pt")
+                    sub = st.params[f"tied_{spec.key}"]
+                else:
+                    gi = self.pm.parts[s] + li
+                    path = self.pm.ckpt_layer_path(ckpt_dir, gi)
+                    sub = st.params.get(f"layer_{li}")
+                    if sub is None:   # plain callables carry no params
+                        continue
+                with open(path, "wb") as f:
+                    pickle.dump(jax.tree.map(np.asarray,
+                                             jax.device_get(sub)), f)
+            opt_path = os.path.join(
+                ckpt_dir, f"zero_pp_rank_{s}_mp_rank_00_optim_states.pt")
+            with open(opt_path, "wb") as f:
+                pickle.dump({
+                    "optimizer_state_dict": jax.tree.map(
+                        np.asarray, jax.device_get(self.opt_states[s])),
+                    "parts": list(self.pm.parts),
+                }, f)
+
+        meta = {
+            "global_steps": self.global_steps,
+            "skipped_steps": self.skipped_steps,
+            "loss_scale": self.loss_scale,
+            "scale_state": {k: np.asarray(jax.device_get(v)) for k, v in
+                            self._scale_state._asdict().items()},
+            "lr_scheduler": (self.lr_scheduler.state_dict()
+                             if self.lr_scheduler else None),
+            "parts": list(self.pm.parts),
+            "num_stages": self.S,
+            "dp": self.dp,
+            "client_state": client_state or {},
+        }
+        with open(os.path.join(ckpt_dir, "mp_rank_00_model_states.pt"),
+                  "wb") as f:
+            pickle.dump(meta, f)
+        if save_latest:
+            with open(os.path.join(save_dir, "latest"), "w") as f:
+                f.write(str(tag))
+        log_dist(f"[pipe] saved checkpoint {ckpt_dir}", ranks=[0])
+        return True
+
+    def load_checkpoint(self, load_dir, tag=None, load_optimizer_states=True,
+                        load_lr_scheduler_states=True):
+        """Rebuild stage params from the per-layer files; optimizer state
+        restores when the stage partitioning matches (otherwise fresh,
+        with a warning — the reference has the same constraint)."""
+        import os
+        import pickle
+        from deepspeed_tpu.utils.logging import logger
+        if tag is None:
+            latest = os.path.join(load_dir, "latest")
+            if not os.path.isfile(latest):
+                logger.warning(f"no 'latest' file at {latest}; nothing loaded")
+                return None, {}
+            with open(latest) as f:
+                tag = f.read().strip()
+        ckpt_dir = os.path.join(load_dir, str(tag))
+        with open(os.path.join(ckpt_dir, "mp_rank_00_model_states.pt"),
+                  "rb") as f:
+            meta = pickle.load(f)
+
+        for s, st in enumerate(self.stages):
+            new_params = dict(st.params)
+            for li, spec in enumerate(st.specs):
+                if isinstance(spec, TiedLayerSpec):
+                    path = os.path.join(ckpt_dir,
+                                        f"tied_{spec.key}-model_states.pt")
+                    key = f"tied_{spec.key}"
+                else:
+                    gi = self.pm.parts[s] + li
+                    path = self.pm.ckpt_layer_path(ckpt_dir, gi)
+                    key = f"layer_{li}"
+                    if key not in new_params:
+                        continue
+                with open(path, "rb") as f:
+                    sub = pickle.load(f)
+                new_params[key] = jax.device_put(
+                    jax.tree.map(jnp.asarray, sub), st.device)
+            st.params = new_params
+
+        self.global_steps = meta.get("global_steps", 0)
+        self.skipped_steps = meta.get("skipped_steps", 0)
+        ss = meta.get("scale_state")
+        if ss is not None:
+            from deepspeed_tpu.runtime.fp16.loss_scaler import LossScaleState
+            self._scale_state = LossScaleState(
+                loss_scale=jnp.float32(ss["loss_scale"]),
+                good_steps=jnp.int32(ss["good_steps"]),
+                hysteresis=jnp.int32(ss["hysteresis"]))
+        if load_lr_scheduler_states and self.lr_scheduler is not None and \
+                meta.get("lr_scheduler") is not None:
+            self.lr_scheduler.load_state_dict(meta["lr_scheduler"])
+
+        if load_optimizer_states:
+            if meta.get("parts") != list(self.pm.parts):
+                logger.warning(
+                    f"[pipe] checkpoint partitioning {meta.get('parts')} != "
+                    f"current {list(self.pm.parts)}; optimizer state NOT "
+                    f"restored (params repartitioned from layer files)")
+            else:
+                for s in range(self.S):
+                    opt_path = os.path.join(
+                        ckpt_dir,
+                        f"zero_pp_rank_{s}_mp_rank_00_optim_states.pt")
+                    with open(opt_path, "rb") as f:
+                        sd = pickle.load(f)
+                    self.opt_states[s] = jax.device_put(
+                        jax.tree.map(jnp.asarray, sd["optimizer_state_dict"]),
+                        self.stages[s].device)
+        log_dist(f"[pipe] loaded checkpoint {ckpt_dir}", ranks=[0])
+        return ckpt_dir, meta.get("client_state", {})
 
     # ----------------------------------------------------------- inspection
     def stage_params(self):

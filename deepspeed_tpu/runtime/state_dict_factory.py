@@ -410,6 +410,10 @@ def megatron_to_gpt2_params(client_sd: Dict[str, Any], config,
                   "bias": np.asarray(lookup(f"{src}.bias"))}
 
     wte = np.asarray(lookup("word_embeddings.weight"), np.float32)
+    assert wte.shape[0] <= config.padded_vocab, (
+        f"checkpoint vocab {wte.shape[0]} exceeds the model's padded "
+        f"vocab {config.padded_vocab} (vocab_size {config.vocab_size}); "
+        f"the checkpoint was trained with a larger vocabulary")
     if wte.shape[0] < config.padded_vocab:
         wte = np.pad(wte, [(0, config.padded_vocab - wte.shape[0]), (0, 0)])
     p["wte"] = wte

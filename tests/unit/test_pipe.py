@@ -371,3 +371,120 @@ def test_pipeline_eval_batch_matches_sequential():
     after = [np.asarray(jax.tree.leaves(p)[0]) for p in eng.stage_params()]
     for a, b in zip(after, before):
         np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# round-3 engine-parity features: dp>=2 ReduceGrads, fp16 loss scaling,
+# LR schedules, per-layer checkpoint save/load (VERDICT r2 item 3)
+# ---------------------------------------------------------------------------
+
+
+def test_pipe_dp2_matches_dp1():
+    """dp=2 columns + averaged ReduceGrads == dp=1 on the same global
+    batch (grad linearity), which the oracle tests tie to sequential."""
+    pm = PipelineModule(_lm_specs(4), num_stages=2, loss_fn=_ce_loss,
+                        partition_method="uniform")
+    e1 = PipelineEngine(pm, _lm_batch(), num_microbatches=4, seed=3)
+    e2 = PipelineEngine(pm, _lm_batch(), num_microbatches=2, seed=3, dp=2)
+    batches = [_lm_batch(s + 1, bs=8) for s in range(4)]
+    l1 = [float(e1.train_batch(b)) for b in batches]
+    l2 = [float(e2.train_batch(b)) for b in batches]
+    np.testing.assert_allclose(l1, l2, rtol=2e-5)
+
+
+def test_pipe_fp16_overflow_skips_and_halves_scale():
+    pm = PipelineModule(_lm_specs(2), num_stages=2, loss_fn=_ce_loss,
+                        partition_method="uniform")
+    eng = PipelineEngine(pm, _lm_batch(), num_microbatches=2, seed=4,
+                         compute_dtype=jnp.float16,
+                         dynamic_loss_scale=True,
+                         initial_scale=2.0 ** 24, hysteresis=1)
+    before = jax.tree.map(np.asarray, eng.stages[0].params)
+    eng.train_batch(_lm_batch(1))
+    assert eng.skipped_steps == 1
+    assert eng.loss_scale == 2.0 ** 23
+    after = jax.tree.map(np.asarray, eng.stages[0].params)
+    for a, b in zip(jax.tree.leaves(before), jax.tree.leaves(after)):
+        np.testing.assert_array_equal(a, b)
+    # scale decays until steps apply
+    for _ in range(30):
+        eng.train_batch(_lm_batch(1))
+        if eng.global_steps - eng.skipped_steps > 0:
+            break
+    assert eng.global_steps - eng.skipped_steps > 0, "never recovered"
+
+
+def test_pipe_lr_schedule_through_initialize():
+    import deepspeed_tpu
+    pm = PipelineModule(_lm_specs(2), num_stages=2, loss_fn=_ce_loss,
+                        partition_method="uniform")
+    eng, _, _, sched = deepspeed_tpu.initialize(
+        model=pm,
+        config={"train_batch_size": 8,
+                "gradient_accumulation_steps": 2,
+                "train_micro_batch_size_per_gpu": 4,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                "scheduler": {"type": "WarmupLR",
+                              "params": {"warmup_min_lr": 0.0,
+                                         "warmup_max_lr": 1e-2,
+                                         "warmup_num_steps": 10}}},
+        sample_batch=_lm_batch())
+    assert sched is not None
+    lrs = []
+    for s in range(3):
+        lrs.append(eng.get_lr()[0])
+        eng.train_batch(_lm_batch(s))
+    assert lrs[0] < lrs[1] < lrs[2] <= 1e-2, lrs
+
+
+def test_pipe_initialize_rejects_zero():
+    import deepspeed_tpu
+    pm = PipelineModule(_lm_specs(2), num_stages=2, loss_fn=_ce_loss,
+                        partition_method="uniform")
+    with pytest.raises(Exception, match="ZeRO"):
+        deepspeed_tpu.initialize(
+            model=pm,
+            config={"train_batch_size": 8,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                    "zero_optimization": {"stage": 1}},
+            sample_batch=_lm_batch())
+
+
+def test_pipe_checkpoint_save_load_resume_parity(tmp_path):
+    pm = PipelineModule(_lm_specs(4), num_stages=2, loss_fn=_ce_loss,
+                        partition_method="uniform")
+    a = PipelineEngine(pm, _lm_batch(), num_microbatches=2, seed=5)
+    for s in range(3):
+        a.train_batch(_lm_batch(s))
+    a.save_checkpoint(str(tmp_path), tag="ck")
+    import os
+    # per-layer file naming parity (reference ckpt_layer_path)
+    assert os.path.exists(tmp_path / "ck" / "layer_01-model_states.pt")
+    assert os.path.exists(tmp_path / "ck" / "tied_embed-model_states.pt")
+    assert os.path.exists(
+        tmp_path / "ck" / "zero_pp_rank_1_mp_rank_00_optim_states.pt")
+
+    b = PipelineEngine(pm, _lm_batch(), num_microbatches=2, seed=99)
+    b.load_checkpoint(str(tmp_path), tag="ck")
+    assert b.global_steps == 3
+    la = [float(a.train_batch(_lm_batch(10 + s))) for s in range(2)]
+    lb = [float(b.train_batch(_lm_batch(10 + s))) for s in range(2)]
+    np.testing.assert_allclose(la, lb, rtol=1e-6)
+
+
+def test_pipe_checkpoint_repartition(tmp_path):
+    """A checkpoint written with 2 stages loads into a 3-stage engine
+    (global-layer-indexed files), matching eval losses."""
+    pm2 = PipelineModule(_lm_specs(4), num_stages=2, loss_fn=_ce_loss,
+                         partition_method="uniform")
+    a = PipelineEngine(pm2, _lm_batch(), num_microbatches=2, seed=6)
+    a.train_batch(_lm_batch(0))
+    a.save_checkpoint(str(tmp_path), tag="rp")
+
+    pm3 = PipelineModule(_lm_specs(4), num_stages=3, loss_fn=_ce_loss,
+                         partition_method="uniform")
+    b = PipelineEngine(pm3, _lm_batch(), num_microbatches=2, seed=7)
+    b.load_checkpoint(str(tmp_path), tag="rp")
+    xb = _lm_batch(3)
+    np.testing.assert_allclose(float(a.eval_batch(xb)),
+                               float(b.eval_batch(xb)), rtol=1e-5)
