@@ -1,0 +1,9 @@
+from deepspeed_tpu.elasticity.elasticity import (  # noqa: F401
+    ElasticityError,
+    ElasticityConfigError,
+    ElasticityIncompatibleWorldSize,
+    ElasticityConfig,
+    compute_elastic_config,
+    elasticity_enabled,
+    ensure_immutable_elastic_config,
+)

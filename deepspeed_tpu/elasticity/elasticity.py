@@ -7,12 +7,22 @@ elasticity (v0.1) is scheduling-time only in the reference too
 (SURVEY.md §5.3)."""
 
 import json
+import math
+import os
+import re
+
+from deepspeed_tpu.utils.logging import logger
 
 ELASTICITY = "elasticity"
 ENABLED = "enabled"
 ENABLED_DEFAULT = False
 LATEST_ELASTICITY_VERSION = 0.1
 MINIMUM_DEEPSPEED_VERSION = "0.3.8"
+IGNORE_NON_ELASTIC_BATCH_INFO = "ignore_non_elastic_batch_info"
+IGNORE_NON_ELASTIC_BATCH_INFO_DEFAULT = False
+# Env var through which a resource scheduler communicates the elastic config
+# it used when sizing the job (reference elasticity/constants.py).
+DEEPSPEED_ELASTICITY_CONFIG = "DEEPSPEED_ELASTICITY_CONFIG"
 
 
 class ElasticityError(Exception):
@@ -45,8 +55,29 @@ class ElasticityConfig:
             "max_train_batch_size", 2000)
         self.micro_batches = param_dict.get("micro_batch_sizes",
                                             [2, 4, 6])
+        if not isinstance(self.micro_batches, list):
+            raise ElasticityConfigError(
+                f"Elasticity expected micro_batch_sizes to be a list of "
+                f"micro batches, instead is: {type(self.micro_batches)}, "
+                f"containing: {self.micro_batches}")
+        if not all(isinstance(m, int) and not isinstance(m, bool)
+                   for m in self.micro_batches):
+            raise ElasticityConfigError(
+                "Elasticity expected micro_batch_sizes to only contain a "
+                f"list of integers, instead contains: {self.micro_batches}")
+        if not all(m > 0 for m in self.micro_batches):
+            raise ElasticityConfigError(
+                "Elasticity expected micro_batch_sizes to only contain "
+                f"positive integers, instead contains: {self.micro_batches}")
+        if not self.micro_batches:
+            raise ElasticityConfigError(
+                "Elasticity expected micro_batch_sizes to be non-empty")
         self.min_gpus = param_dict.get("min_gpus", 1)
         self.max_gpus = param_dict.get("max_gpus", 10000)
+        if self.min_gpus < 1 or self.max_gpus < 1:
+            raise ElasticityConfigError(
+                "Elasticity min/max chip counts must be > 0, "
+                f"given min_gpus: {self.min_gpus}, max_gpus: {self.max_gpus}")
         self.min_time = param_dict.get("min_time", 0)
         self.version = param_dict.get("version", LATEST_ELASTICITY_VERSION)
         self.prefer_larger_batch_size = param_dict.get("prefer_larger_batch",
@@ -119,10 +150,70 @@ def _get_compatible_gpus_v01(micro_batches, max_acceptable_batch_size,
     if not all(mb <= max_acceptable_batch_size for mb in micro_batches):
         raise ValueError(
             f"All micro batches must be <= {max_acceptable_batch_size}")
+    # Bases: each micro batch AND their LCM (reference heuristic :155-160).
+    lcm = micro_batches[0]
+    for mb in micro_batches[1:]:
+        lcm = lcm * mb // math.gcd(lcm, mb)
+    base_list = list(micro_batches) + [lcm]
     candidate_batch_sizes = get_candidate_batch_sizes(
-        micro_batches, max_acceptable_batch_size)
+        base_list, max_acceptable_batch_size)
     return get_best_candidates(candidate_batch_sizes, micro_batches,
                                min_gpus, max_gpus, prefer_larger)
+
+
+def elasticity_enabled(ds_config):
+    """reference elasticity.py:187."""
+    if ELASTICITY not in ds_config:
+        return False
+    return ds_config[ELASTICITY].get(ENABLED, ENABLED_DEFAULT)
+
+
+def _version_tuple(v):
+    """Leading numeric release segment of a version string; tolerates
+    PEP440 suffixes ('0.3.8rc1', '0.4.0+cuda')."""
+    m = re.match(r"(\d+(?:\.\d+)*)", str(v))
+    if not m:
+        raise ElasticityError(f"Unparseable version string: {v!r}")
+    t = tuple(int(x) for x in m.group(1).split("."))
+    while t and t[-1] == 0:   # 0.1.0 == 0.1
+        t = t[:-1]
+    return t
+
+
+def _compatible_ds_version_check(target_deepspeed_version):
+    """Target version must be >= MINIMUM_DEEPSPEED_VERSION
+    (reference :171-185)."""
+    if target_deepspeed_version is None:
+        return True
+    if _version_tuple(target_deepspeed_version) < \
+            _version_tuple(MINIMUM_DEEPSPEED_VERSION):
+        raise ElasticityError(
+            f"Target deepspeed version of {target_deepspeed_version} is not "
+            f"compatible with minimum version {MINIMUM_DEEPSPEED_VERSION} "
+            "supporting elasticity.")
+    return True
+
+
+def ensure_immutable_elastic_config(runtime_elastic_config_dict):
+    """Check the runtime elastic config matches the one the resource
+    scheduler used when sizing the job (reference :193-224): the scheduler
+    publishes its copy in the ``DEEPSPEED_ELASTICITY_CONFIG`` env var."""
+    if DEEPSPEED_ELASTICITY_CONFIG not in os.environ:
+        logger.warning(
+            "Unable to find DEEPSPEED_ELASTICITY_CONFIG environment "
+            "variable, cannot guarantee resource scheduler will scale this "
+            "job using compatible chip counts.")
+        return
+    scheduler = ElasticityConfig(
+        json.loads(os.environ[DEEPSPEED_ELASTICITY_CONFIG]))
+    runtime = ElasticityConfig(runtime_elastic_config_dict)
+    for field in ("max_acceptable_batch_size", "micro_batches", "version"):
+        sched_v, run_v = getattr(scheduler, field), getattr(runtime, field)
+        if sched_v != run_v:
+            raise ElasticityConfigError(
+                f"Elastic config '{field}={sched_v}' seen by resource "
+                f"scheduler does not match config passed to runtime "
+                f"{field}={run_v}")
 
 
 def compute_elastic_config(ds_config, target_deepspeed_version=None,
@@ -131,8 +222,26 @@ def compute_elastic_config(ds_config, target_deepspeed_version=None,
     reference :226."""
     if isinstance(ds_config, str):
         ds_config = json.loads(ds_config)
-    elastic_config_dict = ds_config.get(ELASTICITY, {})
+    if not isinstance(ds_config, dict):
+        raise ValueError(
+            f"Expected ds_config to be a dictionary but received a "
+            f"{type(ds_config)}, containing: {ds_config}")
+    if ELASTICITY not in ds_config:
+        raise ElasticityConfigError(
+            f"'{ELASTICITY}' is missing from config json, please add it if "
+            "running an elastic training job.")
+    elastic_config_dict = ds_config[ELASTICITY]
+    if not elastic_config_dict.get(ENABLED, ENABLED_DEFAULT):
+        raise ElasticityConfigError(
+            "Elasticity is disabled, please enable it ('enabled':true) if "
+            "running an elastic training job.")
     elastic_config = ElasticityConfig(elastic_config_dict)
+    if _version_tuple(elastic_config.version) > \
+            _version_tuple(LATEST_ELASTICITY_VERSION):
+        raise ElasticityConfigError(
+            f"Attempting to run elasticity version {elastic_config.version} "
+            f"but runtime only supports up to {LATEST_ELASTICITY_VERSION}")
+    _compatible_ds_version_check(target_deepspeed_version)
 
     final_batch_size, valid_gpus = _get_compatible_gpus_v01(
         micro_batches=elastic_config.micro_batches,
@@ -140,6 +249,7 @@ def compute_elastic_config(ds_config, target_deepspeed_version=None,
         min_gpus=elastic_config.min_gpus,
         max_gpus=elastic_config.max_gpus,
         prefer_larger=elastic_config.prefer_larger_batch_size)
+    final_batch_size = int(final_batch_size)
 
     if world_size > 0:
         if world_size not in valid_gpus:

@@ -46,6 +46,23 @@ class LinearStack(nn.Module):
         return jnp.mean((x - y) ** 2)
 
 
+class EmbeddingModel(nn.Module):
+    """Embedding table + head — the sparse-gradient fixture (analogue of
+    the reference's nn.Embedding(sparse=True) models in test sparse
+    allreduce paths). The table's grad touches only the batch's token
+    rows."""
+    vocab: int
+    dim: int
+
+    @nn.compact
+    def __call__(self, batch):
+        ids, y = batch["input_ids"], batch["targets"]
+        x = nn.Embed(self.vocab, self.dim, name="wte")(ids)
+        x = x.mean(axis=1)
+        x = nn.Dense(self.dim)(x)
+        return jnp.mean((x - y) ** 2)
+
+
 def random_dataset(total_samples, hidden_dim, seed=0, dtype=np.float32):
     """(x, y) pairs of gaussian vectors (reference random_dataset)."""
     rng = np.random.default_rng(seed)

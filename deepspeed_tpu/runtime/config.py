@@ -224,9 +224,61 @@ class DeepSpeedConfig:
                 data_parallel_size = 1
         self.world_size = data_parallel_size
 
+        self._apply_elasticity(self._param_dict)
         self._initialize_params(self._param_dict)
         self._configure_train_batch_size()
         self._do_sanity_check()
+
+    def _apply_elasticity(self, pd):
+        """When elasticity is enabled, take control of the batch parameters
+        before triangulation (reference config.py:813-872): compute the
+        elastic (final_batch_size, micro_batch) for this world size and
+        override train_batch_size / micro_batch / gas in the param dict."""
+        from deepspeed_tpu.elasticity import (compute_elastic_config,
+                                              elasticity_enabled,
+                                              ensure_immutable_elastic_config)
+        from deepspeed_tpu.elasticity.elasticity import (
+            ELASTICITY, IGNORE_NON_ELASTIC_BATCH_INFO,
+            IGNORE_NON_ELASTIC_BATCH_INFO_DEFAULT)
+
+        if not elasticity_enabled(pd):
+            return
+        logger.info("DeepSpeed elasticity support enabled")
+        final_batch_size, valid_gpus, micro_batch_size = \
+            compute_elastic_config(ds_config=pd, world_size=self.world_size)
+        elastic_dict = pd[ELASTICITY]
+
+        ensure_immutable_elastic_config(elastic_dict)
+
+        if not elastic_dict.get(IGNORE_NON_ELASTIC_BATCH_INFO,
+                                IGNORE_NON_ELASTIC_BATCH_INFO_DEFAULT):
+            batch_params = [C.TRAIN_BATCH_SIZE,
+                            C.TRAIN_MICRO_BATCH_SIZE_PER_GPU,
+                            C.GRADIENT_ACCUMULATION_STEPS]
+            if any(t in pd for t in batch_params):
+                from deepspeed_tpu.elasticity import ElasticityConfigError
+                raise ElasticityConfigError(
+                    "One or more batch related parameters were found in your "
+                    f"ds_config ({C.TRAIN_BATCH_SIZE}, "
+                    f"{C.TRAIN_MICRO_BATCH_SIZE_PER_GPU}, and/or "
+                    f"{C.GRADIENT_ACCUMULATION_STEPS}). These parameters "
+                    "*will not be used* since elastic training is enabled, "
+                    "which takes control of these parameters. If you want to "
+                    "suppress this error (the parameters will be silently "
+                    f"ignored) please set '{IGNORE_NON_ELASTIC_BATCH_INFO}'"
+                    ":true in your elasticity config.")
+
+        gradient_accu_steps = final_batch_size // (micro_batch_size *
+                                                   self.world_size)
+        for key, new in ((C.TRAIN_BATCH_SIZE, final_batch_size),
+                         (C.TRAIN_MICRO_BATCH_SIZE_PER_GPU, micro_batch_size),
+                         (C.GRADIENT_ACCUMULATION_STEPS, gradient_accu_steps)):
+            if key in pd:
+                logger.warning(
+                    f"[Elasticity] overriding {key}: {pd[key]} -> {new}")
+            pd[key] = new
+        logger.info(f"[Elasticity] valid chip counts: {valid_gpus}")
+        self.elastic_valid_world_sizes = valid_gpus
 
     # -- parsing ------------------------------------------------------------
 

@@ -78,6 +78,21 @@ def _cast_tree(tree, dtype):
         tree)
 
 
+def _default_sparse_ids_fn(batch):
+    """Token ids whose embedding rows the batch touches (reference: the
+    indices of the torch sparse embedding grad)."""
+    if isinstance(batch, dict):
+        for k in ("input_ids", "ids", "tokens"):
+            if k in batch:
+                return batch[k]
+        raise ValueError(
+            "sparse_gradients: could not find token ids in the batch dict "
+            f"(keys {list(batch)}); pass sparse_ids_fn=... to initialize()")
+    if isinstance(batch, (tuple, list)):
+        return batch[0]
+    return batch
+
+
 class DeepSpeedEngine:
     """See module docstring. Constructed via ``deepspeed_tpu.initialize``."""
 
@@ -98,6 +113,8 @@ class DeepSpeedEngine:
                  mp_rules=None,
                  batch_spec=None,
                  dont_change_device=False,
+                 sparse_embedding_rules=None,
+                 sparse_ids_fn=None,
                  seed=42):
         import deepspeed_tpu.comm as dist
         dist.init_distributed(verbose=False)
@@ -172,6 +189,50 @@ class DeepSpeedEngine:
 
         # ---- optimizer (reference _configure_basic_optimizer, :1163) ------
         self.optimizer = self._configure_optimizer()
+
+        # ---- sparse embedding gradients (reference engine.py:2196-2268:
+        # "sparse_gradients": true ships (indices, values) rows instead of
+        # the dense [V, D] embedding grad over the DP group). Like the
+        # reference — where only modules explicitly constructed sparse
+        # (nn.Embedding(sparse=True)) produce sparse grads — the tables
+        # must be DECLARED via sparse_embedding_rules: a declared table's
+        # gradient must be supported on the batch's token rows only (an
+        # untied lookup table indexed by sparse_ids_fn(batch)). Tied
+        # LM-head tables or position/type tables have dense (or
+        # differently-indexed) grads and must NOT be declared.
+        self._sparse_grad_rules = tuple(sparse_embedding_rules or ())
+        self._sparse_ids_fn = sparse_ids_fn or _default_sparse_ids_fn
+        self._sparse_grads = (bool(self.config.sparse_gradients_enabled)
+                              and self.dp_world_size > 1
+                              and not self._onebit_dist)
+        if self._sparse_grads and not self._sparse_grad_rules:
+            logger.warning(
+                "sparse_gradients is enabled but no sparse embedding "
+                "tables are declared; pass sparse_embedding_rules=[...] "
+                "to initialize() (regexes over param paths of untied, "
+                "input-id-indexed lookup tables). Falling back to dense "
+                "gradient reduction.")
+            self._sparse_grads = False
+        if self._sparse_grads:
+            bad = []
+            if self.zero_stage >= 2:
+                # stage>=2 grads live reduce-scattered — the reference has
+                # the same envelope (sparse handled only on the
+                # buffered_allreduce_fallback path, engine.py:1648)
+                bad.append(f"zero stage {self.zero_stage} (need <= 1)")
+            if self.mp_world_size != 1:
+                bad.append("model parallelism (embedding may be sharded)")
+            if self._batch_spec is not None:
+                bad.append("custom batch_spec (need the batch dim sharded "
+                           "over the data axis)")
+            if groups.get_expert_parallel_world_size() != 1:
+                bad.append("expert parallelism (shard_map maps only the "
+                           "data axis)")
+            if groups.get_pipe_parallel_world_size() != 1:
+                bad.append("pipeline parallelism")
+            if bad:
+                raise ValueError("sparse_gradients is incompatible with: "
+                                 + "; ".join(bad))
 
         # ---- lr schedule (reference _configure_lr_scheduler, :790) --------
         self.lr_scheduler, self._lr_fn, self._base_lr = self._configure_lr_scheduler()
@@ -510,9 +571,33 @@ class DeepSpeedEngine:
         if self._offload:
             self._offload_opt = self._make_offload_optimizer()
 
+        if self._sparse_grads:
+            self._sparse_mask = self._build_sparse_mask(params)
+            if not any(self._sparse_mask):
+                logger.warning(
+                    "sparse_gradients enabled but no parameter matched "
+                    f"{self._sparse_grad_rules}; falling back to dense")
+                self._sparse_grads = False
+
         self._build_step_fns()
         self._pending_loss = None
         self._last_grad_norm = None
+
+    def _build_sparse_mask(self, params):
+        """Flat boolean mask over the param leaves: True = embedding table
+        whose grad travels the sparse path (name matches
+        sparse_embedding_rules and it is a >=2-D table)."""
+        import re
+        pats = [re.compile(p) for p in self._sparse_grad_rules]
+        flat, _ = jax.tree_util.tree_flatten_with_path(params)
+        mask = []
+        for path, leaf in flat:
+            name = "/".join(
+                str(getattr(k, "key", getattr(k, "idx", getattr(k, "name", k))))
+                for k in path)
+            mask.append(leaf.ndim >= 2 and
+                        any(p.search(name) for p in pats))
+        return mask
 
     # -------------------------------------------------------- compiled steps
     def _batch_sharding(self, batch):
@@ -548,6 +633,59 @@ class DeepSpeedEngine:
         loss = self.loss_fn(out, batch) if self.loss_fn is not None else out
         return jnp.asarray(loss, jnp.float32)
 
+    def _make_sparse_vg(self):
+        """(params, batch, rng, theta, scale) -> (scaled_loss, grads) with
+        EXPLICIT DP reduction under shard_map: dense grads pmean over the
+        data axis, embedding-table grads as an all-gather of the batch's
+        (token-id, row) pairs + scatter-add — the reference
+        ``sparse_allreduce_bucket`` dataflow (engine.py:2196-2268). Wire
+        cost per table: dp*k*(D+1) elements instead of dp*V*D."""
+        try:
+            from jax import shard_map
+        except ImportError:  # pre-0.8 jax
+            from jax.experimental.shard_map import shard_map
+        import functools
+        from deepspeed_tpu.runtime.sparse_tensor import sparse_all_reduce
+        axis = groups.DATA_AXIS
+        mask = self._sparse_mask
+        ids_fn = self._sparse_ids_fn
+
+        def body(params, batch, rng, theta, scale):
+            rrng = jax.random.fold_in(rng, jax.lax.axis_index(axis))
+
+            def scaled_loss(p):
+                loss = self._compute_loss(p, batch, rrng, theta)
+                return loss * scale
+
+            sloss, grads = jax.value_and_grad(scaled_loss)(params)
+            ids = jnp.asarray(ids_fn(batch), jnp.int32).reshape(-1)
+            # dedup once (table-independent) so the row gather +
+            # scatter-add doesn't double count repeated tokens; padding
+            # slots get an out-of-range index (dropped by the scatter)
+            # and zeroed values
+            pad = jnp.iinfo(jnp.int32).max
+            uniq = jnp.unique(ids, size=ids.size, fill_value=pad)
+            flat, tdef = jax.tree_util.tree_flatten(grads)
+            out = []
+            for g, is_emb in zip(flat, mask):
+                if is_emb:
+                    vocab = g.shape[0]
+                    uids = jnp.where(uniq == pad, vocab, uniq)
+                    valid = uids < vocab
+                    vals = jnp.take(g, jnp.where(valid, uids, 0), axis=0)
+                    vals = vals * valid.reshape(
+                        (-1,) + (1,) * (g.ndim - 1)).astype(g.dtype)
+                    out.append(sparse_all_reduce(uids, vals, g.shape, axis,
+                                                 op="mean"))
+                else:
+                    out.append(jax.lax.pmean(g, axis))
+            return (jax.lax.pmean(sloss, axis),
+                    jax.tree_util.tree_unflatten(tdef, out))
+
+        smap = functools.partial(shard_map, mesh=self.mesh)
+        return smap(body, in_specs=(P(), P(axis), P(), P(), P()),
+                    out_specs=(P(), P()), check_vma=False)
+
     def _build_step_fns(self):
         if self._onebit_dist:
             self._build_onebit_step_fns()
@@ -555,12 +693,19 @@ class DeepSpeedEngine:
         gas = self.gradient_accumulation_steps()
         cfg = self.config
 
-        def micro_step(state, batch, rng, pld_theta):
-            def scaled_loss(p):
-                loss = self._compute_loss(p, batch, rng, pld_theta)
-                return loss * state.scale.loss_scale / gas
+        if self._sparse_grads:
+            value_and_grad = self._make_sparse_vg()
+        else:
+            def value_and_grad(params, batch, rng, theta, scale):
+                def scaled_loss(p):
+                    loss = self._compute_loss(p, batch, rng, theta)
+                    return loss * scale
+                return jax.value_and_grad(scaled_loss)(params)
 
-            sloss, grads = jax.value_and_grad(scaled_loss)(state.params)
+        def micro_step(state, batch, rng, pld_theta):
+            sloss, grads = value_and_grad(
+                state.params, batch, rng, pld_theta,
+                state.scale.loss_scale / gas)
             grads = self._grad_constraint(grads)
             acc = jax.tree.map(jnp.add, state.acc_grads, grads)
             loss = sloss * gas / state.scale.loss_scale
@@ -634,11 +779,9 @@ class DeepSpeedEngine:
             traffic per step; acc_grads passes through untouched (it is
             all-zeros between steps by invariant, and the donated buffer
             aliases through at zero cost)."""
-            def scaled_loss(p):
-                loss = self._compute_loss(p, batch, rng, pld_theta)
-                return loss * state.scale.loss_scale
-
-            sloss, grads = jax.value_and_grad(scaled_loss)(state.params)
+            sloss, grads = value_and_grad(
+                state.params, batch, rng, pld_theta,
+                state.scale.loss_scale)
             grads = self._grad_constraint(grads)
             loss = sloss / state.scale.loss_scale
             state, grads, grad_norm, finite = grad_epilogue(state, grads)

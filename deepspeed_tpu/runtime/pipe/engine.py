@@ -55,10 +55,7 @@ class _Mailbox:
         return self._box.pop(key)
 
 
-def _cast_tree(tree, dtype):
-    return jax.tree.map(
-        lambda x: x.astype(dtype)
-        if jnp.issubdtype(x.dtype, jnp.floating) else x, tree)
+from deepspeed_tpu.runtime.engine import _cast_tree  # noqa: E402
 
 
 class _StageRunner:
@@ -269,6 +266,10 @@ class PipelineEngine:
 
         def grad_stats(g):
             leaves = jax.tree.leaves(g)
+            if not leaves:
+                # a stage may own no once-counted grads (e.g. only a
+                # non-first copy of a tied layer)
+                return jnp.bool_(True), jnp.float32(0.0)
             finite = jnp.all(jnp.stack(
                 [jnp.isfinite(leaf).all() for leaf in leaves]))
             sumsq = sum(jnp.sum(leaf.astype(jnp.float32) ** 2)

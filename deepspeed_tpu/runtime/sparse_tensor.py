@@ -64,8 +64,10 @@ def sparse_all_reduce(indices, values, dense_shape, axis_name, op="mean"):
     all_idx = lax.all_gather(indices, axis_name)     # [world, k]
     all_val = lax.all_gather(values, axis_name)      # [world, k, D]
     dense = jnp.zeros(dense_shape, values.dtype)
+    # mode="drop": callers may pad indices with dense_shape[0] (out of
+    # bounds) to keep the nnz count static under jit
     dense = dense.at[all_idx.reshape(-1)].add(
-        all_val.reshape((-1,) + all_val.shape[2:]))
+        all_val.reshape((-1,) + all_val.shape[2:]), mode="drop")
     if op == "mean":
         dense = dense / world
     return dense

@@ -1,0 +1,203 @@
+"""Elasticity: candidate-batch math + config-time application.
+
+Ports the reference tests/unit/test_elastic.py matrix (basic 10k config,
+version gates, invalid configs, world-size micro-batch selection) plus the
+config-ctor application the reference does at runtime/config.py:813-872.
+"""
+
+import copy
+
+import pytest
+
+from deepspeed_tpu.elasticity import (ElasticityConfigError, ElasticityError,
+                                      ElasticityIncompatibleWorldSize,
+                                      compute_elastic_config)
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+DS_VERSION = "0.6.0"
+
+base_ds_config = {
+    "elasticity": {
+        "enabled": True,
+        "max_train_batch_size": 10000,
+        "micro_batch_sizes": [8, 12, 16, 17],
+        "min_gpus": 32,
+        "max_gpus": 1500,
+        "min_time": 20,
+        "version": 0.1,
+    }
+}
+
+
+def _config():
+    return copy.deepcopy(base_ds_config)
+
+
+def test_basic_10k():
+    ds_config = _config()
+    final_batch_size, valid_gpus = compute_elastic_config(
+        ds_config=ds_config, target_deepspeed_version=DS_VERSION)
+    for gpu_num in valid_gpus:
+        assert final_batch_size % gpu_num == 0
+        batch_per_gpu = final_batch_size // gpu_num
+        assert any(batch_per_gpu % mb == 0
+                   for mb in ds_config["elasticity"]["micro_batch_sizes"])
+    assert len(valid_gpus) == 23
+    assert final_batch_size == 9792
+
+
+def test_old_version():
+    with pytest.raises(ElasticityError):
+        compute_elastic_config(ds_config=_config(),
+                               target_deepspeed_version="0.2")
+
+
+def test_disabled():
+    ds_config = _config()
+    ds_config["elasticity"]["enabled"] = False
+    with pytest.raises(ElasticityError):
+        compute_elastic_config(ds_config=ds_config,
+                               target_deepspeed_version=DS_VERSION)
+
+
+def test_valid_world_size():
+    final_batch_size, valid_gpus, mbsize = compute_elastic_config(
+        ds_config=_config(), target_deepspeed_version=DS_VERSION,
+        world_size=64)
+    assert mbsize == 17
+
+
+def test_invalid_world_size():
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        compute_elastic_config(ds_config=_config(),
+                               target_deepspeed_version=DS_VERSION,
+                               world_size=128)
+
+
+def test_future_elastic_version():
+    ds_config = _config()
+    ds_config["elasticity"]["version"] = "0.2"
+    with pytest.raises(ElasticityError):
+        compute_elastic_config(ds_config=ds_config,
+                               target_deepspeed_version=DS_VERSION)
+
+
+def test_missing_max_batch():
+    ds_config = _config()
+    del ds_config["elasticity"]["max_train_batch_size"]
+    with pytest.raises(ElasticityError):
+        compute_elastic_config(ds_config=ds_config,
+                               target_deepspeed_version=DS_VERSION)
+
+
+def test_missing_micro_batch():
+    ds_config = _config()
+    del ds_config["elasticity"]["micro_batch_sizes"]
+    with pytest.raises(ElasticityError):
+        compute_elastic_config(ds_config=ds_config,
+                               target_deepspeed_version=DS_VERSION)
+
+
+def test_empty_config():
+    with pytest.raises(ElasticityError):
+        compute_elastic_config(ds_config={"elasticity": {"enabled": True}},
+                               target_deepspeed_version=DS_VERSION)
+
+
+@pytest.mark.parametrize(
+    "key, value",
+    [("micro_batch_sizes", [1, 4, -1, 2, -10]),
+     ("min_gpus", -1),
+     ("max_gpus", -1),
+     ("micro_batch_sizes", 5),
+     ("micro_batch_sizes", ["a", None, 0.5]),
+     ("micro_batch_sizes", [2, 0.5, 4])])
+def test_invalid_config_values(key, value):
+    ds_config = _config()
+    ds_config["elasticity"][key] = value
+    with pytest.raises(ElasticityError):
+        compute_elastic_config(ds_config=ds_config,
+                               target_deepspeed_version=DS_VERSION)
+
+
+def test_proper_mbsz():
+    ds_config = _config()
+    ds_config["elasticity"]["max_train_batch_size"] = 32
+    ds_config["elasticity"]["micro_batch_sizes"] = [1, 2, 3, 7]
+    ds_config["elasticity"]["min_gpus"] = 1
+    final_batch_size, valid_gpus, mbsize = compute_elastic_config(
+        ds_config=ds_config, target_deepspeed_version=DS_VERSION,
+        world_size=7)
+    assert mbsize == 3
+
+
+# -- config-ctor application (reference runtime/config.py:813-872) ----------
+
+ELASTIC_BLOCK = {
+    "enabled": True,
+    "max_train_batch_size": 4,
+    "micro_batch_sizes": [1, 2, 3, 4],
+    "min_gpus": 1,
+    "max_gpus": 4,
+    "min_time": 20,
+    "version": 0.1,
+}
+
+
+def test_non_elastic_batch_params():
+    """Explicit batch params + elasticity (without the override flag) must
+    fail at config construction."""
+    config_dict = {
+        "train_batch_size": 2,
+        "optimizer": {"type": "Lamb", "params": {"lr": 0.00015}},
+        "gradient_clipping": 1.0,
+        "elasticity": dict(ELASTIC_BLOCK),
+    }
+    with pytest.raises(ElasticityConfigError):
+        DeepSpeedConfig(config_dict, data_parallel_size=2)
+
+
+def test_non_elastic_batch_params_w_override():
+    config_dict = {
+        "train_batch_size": 2,
+        "optimizer": {"type": "Lamb", "params": {"lr": 0.00015}},
+        "gradient_clipping": 1.0,
+        "elasticity": dict(ELASTIC_BLOCK,
+                           ignore_non_elastic_batch_info=True),
+    }
+    cfg = DeepSpeedConfig(config_dict, data_parallel_size=2)
+    # Elasticity takes control of the batch parameters: train batch is the
+    # computed elastic batch (12: the LCM base scaled under max 4 loses to
+    # the LCM itself on chip-count coverage), not the user's 2.
+    assert cfg.train_batch_size == 12
+    assert cfg.train_micro_batch_size_per_gpu * \
+        cfg.gradient_accumulation_steps * 2 == cfg.train_batch_size
+    assert cfg.elastic_valid_world_sizes == [1, 2, 3, 4]
+
+
+def test_elastic_config_applied_batch():
+    """No user batch params at all: elasticity fully determines them."""
+    config_dict = {"elasticity": dict(ELASTIC_BLOCK)}
+    cfg = DeepSpeedConfig(config_dict, data_parallel_size=1)
+    assert cfg.train_batch_size == 12
+    assert cfg.train_batch_size % cfg.train_micro_batch_size_per_gpu == 0
+
+
+def test_scheduler_config_mismatch(monkeypatch):
+    """DEEPSPEED_ELASTICITY_CONFIG disagreement must fail fast."""
+    import json
+    scheduler_view = dict(ELASTIC_BLOCK, max_train_batch_size=8)
+    monkeypatch.setenv("DEEPSPEED_ELASTICITY_CONFIG",
+                       json.dumps(scheduler_view))
+    with pytest.raises(ElasticityConfigError):
+        DeepSpeedConfig({"elasticity": dict(ELASTIC_BLOCK)},
+                        data_parallel_size=1)
+
+
+def test_scheduler_config_match(monkeypatch):
+    import json
+    monkeypatch.setenv("DEEPSPEED_ELASTICITY_CONFIG",
+                       json.dumps(ELASTIC_BLOCK))
+    cfg = DeepSpeedConfig({"elasticity": dict(ELASTIC_BLOCK)},
+                          data_parallel_size=1)
+    assert cfg.train_batch_size == 12
