@@ -209,7 +209,12 @@ def ensure_immutable_elastic_config(runtime_elastic_config_dict):
     runtime = ElasticityConfig(runtime_elastic_config_dict)
     for field in ("max_acceptable_batch_size", "micro_batches", "version"):
         sched_v, run_v = getattr(scheduler, field), getattr(runtime, field)
-        if sched_v != run_v:
+        if field == "version":
+            # tolerate float-vs-string JSON representations ('0.1' vs 0.1)
+            mismatch = _version_tuple(sched_v) != _version_tuple(run_v)
+        else:
+            mismatch = sched_v != run_v
+        if mismatch:
             raise ElasticityConfigError(
                 f"Elastic config '{field}={sched_v}' seen by resource "
                 f"scheduler does not match config passed to runtime "
@@ -250,6 +255,13 @@ def compute_elastic_config(ds_config, target_deepspeed_version=None,
         max_gpus=elastic_config.max_gpus,
         prefer_larger=elastic_config.prefer_larger_batch_size)
     final_batch_size = int(final_batch_size)
+    if not valid_gpus:
+        raise ElasticityConfigError(
+            "No valid chip counts satisfy the elasticity config "
+            f"(max_train_batch_size={elastic_config.max_acceptable_batch_size}, "
+            f"micro_batch_sizes={elastic_config.micro_batches}, "
+            f"min_gpus={elastic_config.min_gpus}, "
+            f"max_gpus={elastic_config.max_gpus})")
 
     if world_size > 0:
         if world_size not in valid_gpus:

@@ -30,18 +30,39 @@ class InferenceEngine:
     def __init__(self, model, mp_size=1, mpu=None, checkpoint=None,
                  dtype=None, injection_dict=None, replace_method="auto",
                  quantization_setting=None, replace_with_kernel_inject=False,
-                 params=None, mp_rules=None, apply_fn=None):
+                 params=None, mp_rules=None, apply_fn=None,
+                 ep_size=1, moe=False, moe_experts=1, moe_type="standard"):
         self.module = model
         self.mp_world_size = mp_size
         self.checkpoint = checkpoint
         self.dtype = dtype or jnp.bfloat16
         self.injection_dict = injection_dict
         self.quantization_setting = quantization_setting
+        # MoE inference (reference inference/engine.py:146
+        # _create_ep_parallel_group + moe_inference.py): the expert axis
+        # joins the inference mesh and the stacked expert params shard over
+        # it — the all-to-all dispatch then rides the same mesh axis as in
+        # training. moe_experts (the reference's per-group expert counts)
+        # is informational here: the expert tables themselves carry their
+        # count; the mesh only needs ep_size.
+        self.moe = bool(moe) or ep_size > 1
+        self.ep_size = ep_size
+        self.moe_experts = moe_experts
+        self.moe_type = moe_type
 
         if not groups.mesh_is_initialized():
-            groups.initialize(mp_size=mp_size, mpu=mpu)
+            groups.initialize(ep_size=ep_size, mp_size=mp_size, mpu=mpu)
         self.mesh = groups.get_mesh()
         self.mp_rules = mp_rules or ModelParallelRules()
+        if self.moe:
+            from deepspeed_tpu.moe.layer import moe_sharding_rules
+            existing = {pat.pattern for pat, _ in self.mp_rules.rules}
+            extra = [(pat, spec) for pat, spec in moe_sharding_rules()
+                     if pat not in existing]
+            if extra:
+                self.mp_rules = ModelParallelRules(
+                    [(pat.pattern, spec) for pat, spec in
+                     self.mp_rules.rules] + extra)
 
         if params is None and checkpoint is not None:
             params = self._load_checkpoint(checkpoint)
@@ -174,6 +195,11 @@ class InferenceEngine:
         return p if isinstance(p, dict) and "params" in p else {"params": p}
 
     def _sample(self, last, rng, temperature):
+        # Megatron-style padded vocab: rows >= vocab_size exist only for
+        # tile alignment and must never be sampled
+        vs = getattr(getattr(self.module, "config", None), "vocab_size", None)
+        if vs is not None and vs < last.shape[-1]:
+            last = last[..., :vs]
         if temperature > 0:
             return jax.random.categorical(rng, last / temperature, axis=-1
                                           ).astype(jnp.int32)

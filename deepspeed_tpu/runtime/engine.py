@@ -588,16 +588,12 @@ class DeepSpeedEngine:
         whose grad travels the sparse path (name matches
         sparse_embedding_rules and it is a >=2-D table)."""
         import re
+        from deepspeed_tpu.runtime.zero.partition import _path_str
         pats = [re.compile(p) for p in self._sparse_grad_rules]
         flat, _ = jax.tree_util.tree_flatten_with_path(params)
-        mask = []
-        for path, leaf in flat:
-            name = "/".join(
-                str(getattr(k, "key", getattr(k, "idx", getattr(k, "name", k))))
-                for k in path)
-            mask.append(leaf.ndim >= 2 and
-                        any(p.search(name) for p in pats))
-        return mask
+        return [leaf.ndim >= 2 and
+                any(p.search(_path_str(path)) for p in pats)
+                for path, leaf in flat]
 
     # -------------------------------------------------------- compiled steps
     def _batch_sharding(self, batch):
