@@ -117,7 +117,18 @@ class InferenceEngine:
                 "this flax/pickle checkpoint loads UNQUANTIZED", ranks=[0])
         sd = load_checkpoint_file(path)
         if isinstance(sd, dict) and "module" in sd:
-            sd = sd["module"]
+            module_sd = sd["module"]
+            if sd.get("has_moe_layers"):
+                # per-expert file layout (engine _save_moe_checkpoint
+                # analogue): re-stack layer_{L}_expert_{E} files
+                import os
+                from deepspeed_tpu.runtime.checkpoint_io import \
+                    restore_moe_experts
+                module_sd = restore_moe_experts(
+                    os.path.dirname(str(path)), module_sd,
+                    sd.get("moe_layer_prefixes", []),
+                    expert_counts=sd.get("moe_expert_counts"))
+            sd = module_sd
         if isinstance(sd, dict):
             from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel
             from deepspeed_tpu.runtime.state_dict_factory import (

@@ -106,3 +106,125 @@ def restore_tree(template, files_payloads: List[Dict[str, dict]],
 def load_payload(path: str) -> Dict[str, dict]:
     with open(path, "rb") as f:
         return pickle.load(f)
+
+
+# ---------------------------------------------------------------------------
+# MoE expert file layout (reference engine.py:2780 _save_moe_checkpoint /
+# :2381 _get_expert_ckpt_name): each MoE layer's experts are saved one file
+# per GLOBAL expert id as
+# ``layer_{L}_expert_{E}_mp_rank_{MP:02d}_model_states.pt``, and the
+# model-states file keeps only the non-expert ("non-moe") state. Here the
+# stacked [E, ...] expert leaves are sliced per expert on save and
+# re-stacked on load.
+# ---------------------------------------------------------------------------
+
+MOE_EXPERT_KEY = "deepspeed_experts"
+
+
+def moe_expert_file(tag_dir, layer_id, expert_id, mp_rank=0):
+    import os
+    return os.path.join(
+        tag_dir,
+        f"layer_{layer_id}_expert_{expert_id}_mp_rank_{mp_rank:02d}"
+        "_model_states.pt")
+
+
+def _walk_paths(tree, prefix=()):
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            yield from _walk_paths(v, prefix + (str(k),))
+    else:
+        yield "/".join(prefix), tree
+
+
+def split_moe_state(params_np):
+    """(non_moe_tree, prefixes, experts) where ``experts`` maps
+    layer_id -> {path_under_model: stacked [E, ...] array} and
+    ``prefixes`` orders the MoE layers (the reference's named_modules walk
+    order becomes sorted path order)."""
+    by_prefix = {}
+    for path, leaf in _walk_paths(params_np):
+        if MOE_EXPERT_KEY in path.split("/"):
+            prefix = path.split("/" + MOE_EXPERT_KEY)[0]
+            by_prefix.setdefault(prefix, {})[path] = leaf
+    prefixes = sorted(by_prefix)
+
+    def strip(tree):
+        if isinstance(tree, dict):
+            return {k: strip(v) for k, v in tree.items()
+                    if str(k) != MOE_EXPERT_KEY}
+        return tree
+
+    return strip(params_np), prefixes, [by_prefix[p] for p in prefixes]
+
+
+def save_moe_experts(tag_dir, params_np, mp_rank=0):
+    """Write the per-expert files; returns (non_moe_tree, prefixes,
+    expert_counts) for the model-states metadata. Stale expert files from
+    a previous save of the same tag are removed first (re-saving a fixed
+    tag with fewer experts must not leave orphans for restore to glob)."""
+    import glob as _glob
+    import os
+    non_moe, prefixes, experts = split_moe_state(params_np)
+    if experts:
+        for f in _glob.glob(os.path.join(
+                tag_dir, "layer_*_expert_*_model_states.pt")):
+            os.remove(f)
+    counts = []
+    for lid, layer in enumerate(experts):
+        num = next(iter(layer.values())).shape[0]
+        counts.append(num)
+        for eid in range(num):
+            sd = {path: np.asarray(leaf[eid]) for path, leaf in layer.items()}
+            with open(moe_expert_file(tag_dir, lid, eid, mp_rank), "wb") as f:
+                pickle.dump(sd, f)
+    return non_moe, prefixes, counts
+
+
+def restore_moe_experts(tag_dir, module_np, prefixes, mp_rank=0,
+                        expert_counts=None):
+    """Re-stack the per-expert files into the module tree (inverse of
+    save_moe_experts). ``module_np`` is the stripped non-moe tree; returns
+    a tree with the ``deepspeed_experts`` subtrees back in place.
+
+    Expert ids must be contiguous from 0 (a missing file would otherwise
+    silently index-shift every later expert); when ``expert_counts`` (from
+    the checkpoint metadata) is given, the file count must match it."""
+    import glob as _glob
+    import os
+    import re
+
+    for lid in range(len(prefixes)):
+        pat = os.path.join(
+            tag_dir, f"layer_{lid}_expert_*_mp_rank_{mp_rank:02d}"
+            "_model_states.pt")
+        files = _glob.glob(pat)
+        if not files:
+            raise FileNotFoundError(
+                f"MoE checkpoint is missing expert files: {pat}")
+        by_eid = sorted(
+            (int(re.search(r"_expert_(\d+)_", os.path.basename(f)).group(1)),
+             f) for f in files)
+        eids = [e for e, _ in by_eid]
+        if eids != list(range(len(eids))):
+            raise FileNotFoundError(
+                f"MoE checkpoint layer {lid} has non-contiguous expert "
+                f"files (ids {eids}); a partial checkpoint would silently "
+                "index-shift experts")
+        if expert_counts is not None and len(eids) != expert_counts[lid]:
+            raise FileNotFoundError(
+                f"MoE checkpoint layer {lid} has {len(eids)} expert files "
+                f"but the checkpoint metadata records "
+                f"{expert_counts[lid]} experts")
+        payloads = []
+        for _, f in by_eid:
+            with open(f, "rb") as fh:
+                payloads.append(pickle.load(fh))
+        for path in payloads[0]:
+            stacked = np.stack([p[path] for p in payloads], axis=0)
+            node = module_np
+            parts = path.split("/")
+            for k in parts[:-1]:
+                node = node.setdefault(k, {})
+            node[parts[-1]] = stacked
+    return module_np

@@ -1194,8 +1194,20 @@ class DeepSpeedEngine:
             for x in jax.tree.leaves(self.state.params))
         model_np = (jax.tree.map(np.asarray, jax.device_get(self.state.params))
                     if fully_addressable else None)
+        # MoE expert params get the reference's per-expert file layout
+        # (engine.py:2780 _save_moe_checkpoint): one
+        # layer_{L}_expert_{E}_mp_rank_XX file per global expert, with the
+        # non-moe state in the model-states file
+        moe_prefixes, moe_counts = [], []
+        if model_np is not None and isinstance(model_np, dict):
+            model_np, moe_prefixes, moe_counts = \
+                checkpoint_io.save_moe_experts(
+                    os.path.join(save_dir, str(tag)), model_np)
         sd = {
             "module": model_np,
+            "has_moe_layers": bool(moe_prefixes),
+            "moe_layer_prefixes": moe_prefixes,
+            "moe_expert_counts": moe_counts,
             "global_steps": self.global_steps,
             "global_samples": self.global_samples,
             "skipped_steps": self.skipped_steps,
@@ -1259,7 +1271,13 @@ class DeepSpeedEngine:
         zero_payloads = [pickle.load(open(p, "rb")) for p in zero_paths]
 
         if sd.get("module") is not None:
-            params = jax.device_put(sd["module"], self.param_shardings)
+            module_np = sd["module"]
+            if sd.get("has_moe_layers"):
+                module_np = checkpoint_io.restore_moe_experts(
+                    os.path.join(load_dir, str(tag)), module_np,
+                    sd.get("moe_layer_prefixes", []),
+                    expert_counts=sd.get("moe_expert_counts"))
+            params = jax.device_put(module_np, self.param_shardings)
         else:
             # reassemble sharded params from the per-process files
             params = checkpoint_io.restore_tree(

@@ -12,6 +12,10 @@ config below must reproduce it:
   envelope that still catches optimizer-level bugs.
 """
 
+import pytest as _pytest
+
+pytestmark = _pytest.mark.slow  # compile-heavy: excluded from the fast tier
+
 import json
 import os
 

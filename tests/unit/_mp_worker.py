@@ -1,0 +1,83 @@
+"""Worker process for the multi-process distributed test.
+
+Launched by test_multiprocess.py with DS_COORDINATOR_ADDRESS /
+DS_NUM_PROCESSES / DS_PROCESS_ID set — the analogue of one rank spawned by
+the reference's @distributed_test fixture (tests/unit/common.py:57). Each
+process owns 2 virtual CPU devices; jax.distributed glues them into one
+4-device mesh, exercising the REAL multi-process branches:
+_globalize_batch (make_array_from_process_local_data), the multihost
+barrier, and multi-process checkpoint save/load.
+"""
+
+import json
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=2")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.environ.get("DS_REPO", "/root/repo"))
+
+import numpy as np  # noqa: E402
+
+import deepspeed_tpu  # noqa: E402
+import deepspeed_tpu.comm as dist  # noqa: E402
+from deepspeed_tpu.models.simple import SimpleModel, sample_batch  # noqa: E402
+
+
+def main():
+    out_dir = sys.argv[1]
+    dist.init_distributed()          # env-driven jax.distributed rendezvous
+    rank = dist.get_rank()
+    assert dist.get_process_count() == 2, dist.get_process_count()
+    assert jax.device_count() == 4, jax.device_count()
+
+    hidden = 16
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=hidden, nlayers=2),
+        config={
+            "train_batch_size": 8,
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+            "zero_optimization": {"stage": 1},
+        },
+        sample_batch=sample_batch(2, hidden))
+    assert engine.dp_world_size == 4
+
+    # Each process feeds only ITS slice of the global batch — the
+    # deepspeed_io per-process slicing contract; _globalize_batch must
+    # assemble the global jax.Array from the process-local shards.
+    rng = np.random.default_rng(7)
+    losses = []
+    for _ in range(3):
+        gx = rng.standard_normal((8, hidden)).astype(np.float32)
+        gy = rng.standard_normal((8, hidden)).astype(np.float32)
+        lo, hi = rank * 4, rank * 4 + 4
+        loss = engine.train_batch(batch=(gx[lo:hi], gy[lo:hi]))
+        losses.append(float(loss))
+
+    dist.barrier()
+    ck = os.path.join(out_dir, "ck")
+    engine.save_checkpoint(ck, tag="mp")
+    dist.barrier()
+    engine.load_checkpoint(ck, tag="mp")
+
+    # one more step after resume
+    gx = rng.standard_normal((8, hidden)).astype(np.float32)
+    gy = rng.standard_normal((8, hidden)).astype(np.float32)
+    lo, hi = rank * 4, rank * 4 + 4
+    losses.append(float(engine.train_batch(batch=(gx[lo:hi], gy[lo:hi]))))
+    dist.barrier()
+
+    with open(os.path.join(out_dir, f"losses_{rank}.json"), "w") as f:
+        json.dump(losses, f)
+    print(f"worker {rank} OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -105,3 +105,100 @@ def test_assemble_detects_missing_shards():
                                   np.ones((2, 4), np.float32))]}}
     with pytest.raises(ValueError, match="incomplete"):
         checkpoint_io.assemble([payload])
+
+
+# ------------------------------------------------------- MoE expert files
+class TestMoEExpertLayout:
+    """Reference engine.py:2780 _save_moe_checkpoint file layout: one
+    layer_{L}_expert_{E}_mp_rank_XX_model_states.pt per global expert,
+    non-moe state in the model-states tree."""
+
+    def _params(self):
+        import numpy as np
+        return {
+            "h_0": {"moe": {"deepspeed_moe": {"deepspeed_experts": {
+                "fc1": {"kernel": np.arange(24, dtype=np.float32
+                                            ).reshape(4, 3, 2),
+                        "bias": np.ones((4, 2), np.float32)}}}},
+                    "attn": {"kernel": np.zeros((3, 3), np.float32)}},
+            "wte": {"embedding": np.zeros((8, 3), np.float32)},
+        }
+
+    def test_split_save_restore_roundtrip(self, tmp_path):
+        import numpy as np
+        from deepspeed_tpu.runtime import checkpoint_io as cio
+        params = self._params()
+        non_moe, prefixes, counts = cio.save_moe_experts(str(tmp_path), params)
+        assert prefixes == ["h_0/moe/deepspeed_moe"]
+        assert counts == [4]
+        # non-moe tree has no expert subtree but keeps everything else
+        assert "deepspeed_experts" not in non_moe["h_0"]["moe"][
+            "deepspeed_moe"]
+        assert "attn" in non_moe["h_0"]
+        # one file per global expert
+        import os
+        for eid in range(4):
+            assert os.path.exists(
+                cio.moe_expert_file(str(tmp_path), 0, eid))
+        restored = cio.restore_moe_experts(str(tmp_path), non_moe, prefixes)
+        k = restored["h_0"]["moe"]["deepspeed_moe"]["deepspeed_experts"][
+            "fc1"]["kernel"]
+        np.testing.assert_array_equal(k, params["h_0"]["moe"][
+            "deepspeed_moe"]["deepspeed_experts"]["fc1"]["kernel"])
+
+    def test_missing_expert_file_raises(self, tmp_path):
+        import pytest
+        from deepspeed_tpu.runtime import checkpoint_io as cio
+        non_moe, prefixes, counts = cio.save_moe_experts(
+            str(tmp_path), self._params())
+        import os
+        os.remove(cio.moe_expert_file(str(tmp_path), 0, 0))
+        os.remove(cio.moe_expert_file(str(tmp_path), 0, 1))
+        os.remove(cio.moe_expert_file(str(tmp_path), 0, 2))
+        os.remove(cio.moe_expert_file(str(tmp_path), 0, 3))
+        with pytest.raises(FileNotFoundError):
+            cio.restore_moe_experts(str(tmp_path), non_moe, prefixes)
+
+    def test_partial_missing_expert_file_raises(self, tmp_path):
+        """A gap in the expert ids must fail loudly, not index-shift."""
+        import os
+        import pytest
+        from deepspeed_tpu.runtime import checkpoint_io as cio
+        non_moe, prefixes, counts = cio.save_moe_experts(
+            str(tmp_path), self._params())
+        os.remove(cio.moe_expert_file(str(tmp_path), 0, 1))
+        with pytest.raises(FileNotFoundError, match="non-contiguous"):
+            cio.restore_moe_experts(str(tmp_path), non_moe, prefixes)
+
+    def test_expert_count_mismatch_raises(self, tmp_path):
+        import os
+        import pytest
+        from deepspeed_tpu.runtime import checkpoint_io as cio
+        non_moe, prefixes, counts = cio.save_moe_experts(
+            str(tmp_path), self._params())
+        os.remove(cio.moe_expert_file(str(tmp_path), 0, 3))
+        with pytest.raises(FileNotFoundError, match="metadata records"):
+            cio.restore_moe_experts(str(tmp_path), non_moe, prefixes,
+                                    expert_counts=counts)
+
+    def test_stale_files_removed_on_resave(self, tmp_path):
+        """Re-saving the same tag with fewer experts must not leave
+        orphan files for restore to glob."""
+        import glob
+        import os
+        import numpy as np
+        from deepspeed_tpu.runtime import checkpoint_io as cio
+        cio.save_moe_experts(str(tmp_path), self._params())
+        small = self._params()
+        ex = small["h_0"]["moe"]["deepspeed_moe"]["deepspeed_experts"]
+        ex["fc1"]["kernel"] = ex["fc1"]["kernel"][:2]
+        ex["fc1"]["bias"] = ex["fc1"]["bias"][:2]
+        non_moe, prefixes, counts = cio.save_moe_experts(str(tmp_path), small)
+        assert counts == [2]
+        files = glob.glob(os.path.join(str(tmp_path), "layer_*_expert_*"))
+        assert len(files) == 2
+        restored = cio.restore_moe_experts(str(tmp_path), non_moe, prefixes,
+                                           expert_counts=counts)
+        k = restored["h_0"]["moe"]["deepspeed_moe"]["deepspeed_experts"][
+            "fc1"]["kernel"]
+        assert k.shape[0] == 2

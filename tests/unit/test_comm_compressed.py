@@ -7,6 +7,10 @@ match it bit-for-bit, and its measured bytes entering collectives must be
 an order of magnitude below the exact fp32 allreduce.
 """
 
+import pytest as _pytest
+
+pytestmark = _pytest.mark.slow  # compile-heavy: excluded from the fast tier
+
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -2,6 +2,10 @@
 reference's test_cuda_forward.py / test_cuda_backward.py kernel-parity
 sweeps). Runs the Pallas kernels in interpret mode on CPU."""
 
+import pytest as _pytest
+
+pytestmark = _pytest.mark.slow  # compile-heavy: excluded from the fast tier
+
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -2,6 +2,10 @@
 test_cuda_forward/backward.py and tests/perf/adam_test.py correctness
 half). All kernels run in interpret mode on CPU."""
 
+import pytest as _pytest
+
+pytestmark = _pytest.mark.slow  # compile-heavy: excluded from the fast tier
+
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -2,6 +2,10 @@
 stages and with tensor parallelism (the BASELINE.json GPT-2 configs at toy
 scale — mirrors tests/model/Megatron_GPT2 loss-parity intent)."""
 
+import pytest as _pytest
+
+pytestmark = _pytest.mark.slow  # compile-heavy: excluded from the fast tier
+
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -8,6 +8,10 @@ csrc/transformer/inference/csrc/pt_binding.cpp:829), (iii) the decode
 attention op must match the masked dense oracle.
 """
 
+import pytest as _pytest
+
+pytestmark = _pytest.mark.slow  # compile-heavy: excluded from the fast tier
+
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -8,6 +8,10 @@ all-to-all rides the mesh at decode time, and training checkpoints load
 straight into the expert-parallel inference engine.
 """
 
+import pytest as _pytest
+
+pytestmark = _pytest.mark.slow  # compile-heavy: excluded from the fast tier
+
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -3,6 +3,10 @@ test_topology.py), schedule invariants (test_pipe_schedule.py), partition
 math, and SPMD GPipe parity vs sequential execution (the analogue of
 test_pipe.py's pipe-vs-sequential loss comparison)."""
 
+import pytest as _pytest
+
+pytestmark = _pytest.mark.slow  # compile-heavy: excluded from the fast tier
+
 import flax.linen as nn
 import jax
 import jax.numpy as jnp

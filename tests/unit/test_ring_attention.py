@@ -1,6 +1,10 @@
 """Ring attention / Ulysses sequence parallelism: exact parity with full
 attention over an 8-device sequence-sharded mesh."""
 
+import pytest as _pytest
+
+pytestmark = _pytest.mark.slow  # compile-heavy: excluded from the fast tier
+
 import jax
 import jax.numpy as jnp
 import numpy as np

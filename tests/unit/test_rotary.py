@@ -1,6 +1,10 @@
 """Rotary embeddings (ops/transformer/rotary.py — the reference
 apply_rotary_pos_emb surface) and the small fused inference parity ops."""
 
+import pytest as _pytest
+
+pytestmark = _pytest.mark.slow  # compile-heavy: excluded from the fast tier
+
 import dataclasses
 
 import jax

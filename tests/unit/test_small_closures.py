@@ -3,6 +3,10 @@
 (VERDICT round 1 "What's missing" #8 and "What's weak" #8.)
 """
 
+import pytest as _pytest
+
+pytestmark = _pytest.mark.slow  # compile-heavy: excluded from the fast tier
+
 import functools
 
 import jax

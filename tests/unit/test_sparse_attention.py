@@ -2,6 +2,10 @@
 test_sparse_attention.py compares triton sparse ops against dense
 matmul/softmax with the layout expanded to an element mask)."""
 
+import pytest as _pytest
+
+pytestmark = _pytest.mark.slow  # compile-heavy: excluded from the fast tier
+
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -7,6 +7,10 @@ mp ranks with the loader, merge it back, and feed the result through the
 InferenceEngine — every stage must reproduce the original tensors.
 """
 
+import pytest as _pytest
+
+pytestmark = _pytest.mark.slow  # compile-heavy: excluded from the fast tier
+
 import os
 import pickle
 

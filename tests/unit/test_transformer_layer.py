@@ -3,6 +3,10 @@ analogue of the reference's test_cuda_forward.py / test_cuda_backward.py
 (DeepSpeedTransformerLayer vs vendored HF BERT layer, tolerance-swept) —
 plus BERT end-to-end training and the inference engine."""
 
+import pytest as _pytest
+
+pytestmark = _pytest.mark.slow  # compile-heavy: excluded from the fast tier
+
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
