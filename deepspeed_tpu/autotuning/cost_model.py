@@ -1,0 +1,92 @@
+"""Cost model for the model-based tuner.
+
+Rebuild of deepspeed/autotuning/tuner/cost_model.py:11
+(``XGBoostCostModel``). XGBoost is not in this image, so the model is a
+closed-form ridge regression on degree-2 polynomial features — plenty for
+the handful of numeric config dims the tuner ranks (the reference also
+only RANKS configs; absolute accuracy is irrelevant)."""
+
+from typing import Dict, List
+
+import numpy as np
+
+
+def flatten_config(cfg: Dict, prefix="") -> Dict[str, object]:
+    """Flatten a nested config dict keeping numeric AND string leaves
+    (reference autotuning/utils.py flatten; strings one-hot later —
+    offload devices etc. are legitimate tuning dims)."""
+    out = {}
+    for k, v in cfg.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out.update(flatten_config(v, prefix=key + "."))
+        elif isinstance(v, bool):
+            out[key] = float(v)
+        elif isinstance(v, (int, float)):
+            out[key] = float(v)
+        elif isinstance(v, str):
+            out[key] = v
+    return out
+
+
+def featurize(configs: List[Dict], keys: List[str] = None):
+    """configs -> (X, keys): numeric feature matrix. String-valued dims
+    become one-hot indicator columns ('key=value'), so categorical knobs
+    (e.g. offload_optimizer.device) are visible to the cost model."""
+    flats = [flatten_config(c) for c in configs]
+    if keys is None:
+        raw = sorted(set().union(*[set(f) for f in flats]))
+        keys = []
+        for k in raw:
+            vals = {f[k] for f in flats if k in f}
+            if any(isinstance(v, str) for v in vals):
+                keys.extend(f"{k}={v}" for v in sorted(map(str, vals)))
+            else:
+                keys.append(k)
+
+    def val(f, key):
+        if "=" in key:
+            k, _, v = key.partition("=")
+            if k in f:
+                return 1.0 if str(f[k]) == v else 0.0
+            return 0.0
+        x = f.get(key, 0.0)
+        return float(x) if not isinstance(x, str) else 0.0
+
+    X = np.array([[val(f, k) for k in keys] for f in flats], np.float64)
+    return X, keys
+
+
+class RidgeCostModel:
+    """fit(X, y) / predict(X) with degree-2 polynomial expansion and L2
+    regularisation; y is normalised like the reference (max-scaled)."""
+
+    def __init__(self, l2: float = 1e-3):
+        self.l2 = l2
+        self.w = None
+        self._mu = None
+        self._sigma = None
+
+    def _expand(self, X):
+        n, d = X.shape
+        cols = [np.ones((n, 1)), X]
+        for i in range(d):
+            for j in range(i, d):
+                cols.append((X[:, i] * X[:, j])[:, None])
+        return np.concatenate(cols, axis=1)
+
+    def fit(self, X, y):
+        X = np.asarray(X, np.float64)
+        y = np.asarray(y, np.float64)
+        y = y / max(float(np.max(np.abs(y))), 1e-9)
+        self._mu = X.mean(axis=0)
+        self._sigma = np.where(X.std(axis=0) > 0, X.std(axis=0), 1.0)
+        P = self._expand((X - self._mu) / self._sigma)
+        A = P.T @ P + self.l2 * np.eye(P.shape[1])
+        self.w = np.linalg.solve(A, P.T @ y)
+
+    def predict(self, X):
+        assert self.w is not None, "fit() before predict()"
+        X = np.asarray(X, np.float64)
+        P = self._expand((X - self._mu) / self._sigma)
+        return P @ self.w

@@ -1,0 +1,117 @@
+"""Experiment scheduler (reference autotuning/scheduler.py:35
+``ResourceManager``).
+
+The reference fans experiment jobs out over a node pool, polls for
+completion, and reads each experiment's metric file. The TPU-native
+equivalent keeps the same lifecycle — queue experiments, run up to
+``num_slots`` concurrently, collect a scalar metric per experiment — with
+two runner styles:
+
+* an in-process callable (``run_fn(exp) -> float``) — the default for
+  single-host tuning where the engine is cheap to rebuild;
+* a subprocess command template — the analogue of the reference launching
+  ``deepspeed ...`` per experiment: each experiment gets a directory with
+  its ``ds_config.json``; the command runs with DS_AUTOTUNING_EXP_DIR set
+  and writes ``metric.json`` (``{"throughput": N}``) there.
+"""
+
+import json
+import os
+import subprocess
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class Experiment:
+    def __init__(self, exp_id: int, config: Dict):
+        self.exp_id = exp_id
+        self.config = config
+        self.metric: Optional[float] = None
+        self.error: Optional[str] = None
+        self.done = False
+
+    def __repr__(self):
+        return (f"Experiment({self.exp_id}, metric={self.metric}, "
+                f"done={self.done})")
+
+
+class ResourceManager:
+    def __init__(self,
+                 run_fn: Optional[Callable[[Dict], float]] = None,
+                 cmd_template: Optional[List[str]] = None,
+                 exps_dir: str = "autotuning_exps",
+                 num_slots: int = 1,
+                 metric_key: str = "throughput",
+                 timeout: float = 3600.0):
+        assert (run_fn is None) != (cmd_template is None), (
+            "pass exactly one of run_fn (in-process) or cmd_template "
+            "(subprocess)")
+        self.run_fn = run_fn
+        self.cmd_template = cmd_template
+        self.exps_dir = exps_dir
+        self.num_slots = max(1, num_slots)
+        self.metric_key = metric_key
+        self.timeout = timeout
+        self.experiments: List[Experiment] = []
+
+    def schedule_experiments(self, configs: List[Dict]) -> List[Experiment]:
+        start = len(self.experiments)
+        exps = [Experiment(start + i, cfg) for i, cfg in enumerate(configs)]
+        self.experiments.extend(exps)
+        return exps
+
+    # ------------------------------------------------------------- running
+    def _run_subprocess(self, exp: Experiment) -> float:
+        exp_dir = os.path.join(self.exps_dir, f"exp_{exp.exp_id}")
+        os.makedirs(exp_dir, exist_ok=True)
+        with open(os.path.join(exp_dir, "ds_config.json"), "w") as f:
+            json.dump(exp.config, f, indent=2)
+        env = dict(os.environ, DS_AUTOTUNING_EXP_DIR=exp_dir)
+        proc = subprocess.run(self.cmd_template, env=env,
+                              capture_output=True, text=True,
+                              timeout=self.timeout)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"experiment {exp.exp_id} failed (rc={proc.returncode}): "
+                f"{proc.stderr[-2000:]}")
+        with open(os.path.join(exp_dir, "metric.json")) as f:
+            return float(json.load(f)[self.metric_key])
+
+    def _worker(self, queue: List[Experiment], lock: threading.Lock):
+        while True:
+            with lock:
+                if not queue:
+                    return
+                exp = queue.pop(0)
+            try:
+                if self.run_fn is not None:
+                    exp.metric = float(self.run_fn(exp.config))
+                else:
+                    exp.metric = self._run_subprocess(exp)
+            except Exception as e:  # failed experiments stay metric=None
+                exp.error = str(e)
+                logger.warning(f"experiment {exp.exp_id} failed: {e}")
+            exp.done = True
+
+    def run(self) -> List[Experiment]:
+        """Run all scheduled-but-not-done experiments; returns them."""
+        todo = [e for e in self.experiments if not e.done]
+        lock = threading.Lock()
+        if self.run_fn is not None and self.num_slots > 1:
+            logger.warning(
+                "in-process experiments share one device; forcing "
+                "num_slots=1 (use cmd_template for parallel slots)")
+        slots = 1 if self.run_fn is not None else self.num_slots
+        threads = [threading.Thread(target=self._worker, args=(todo, lock))
+                   for _ in range(min(slots, max(1, len(todo))))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return self.experiments
+
+    def best(self) -> Optional[Experiment]:
+        done = [e for e in self.experiments if e.metric is not None]
+        return max(done, key=lambda e: e.metric) if done else None

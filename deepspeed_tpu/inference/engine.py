@@ -116,6 +116,11 @@ class InferenceEngine:
                 "checkpoint JSONs (the weight names drive the grouping); "
                 "this flax/pickle checkpoint loads UNQUANTIZED", ranks=[0])
         sd = load_checkpoint_file(path)
+        # Megatron checkpoints record their QKV head layout version on the
+        # OUTER dict (state_dict_factory get_checkpoint_version); keep it
+        # across the module unwrap for the policy conversion below
+        ckpt_version = (sd.get("checkpoint_version", 0)
+                        if isinstance(sd, dict) else 0)
         if isinstance(sd, dict) and "module" in sd:
             module_sd = sd["module"]
             if sd.get("has_moe_layers"):
@@ -130,12 +135,18 @@ class InferenceEngine:
                     expert_counts=sd.get("moe_expert_counts"))
             sd = module_sd
         if isinstance(sd, dict):
-            from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel
-            from deepspeed_tpu.runtime.state_dict_factory import (
-                hf_gpt2_to_params, is_hf_gpt2_state_dict)
-            if isinstance(self.module, GPT2LMHeadModel) and \
-                    is_hf_gpt2_state_dict(sd):
-                return hf_gpt2_to_params(sd, self.module.config)
+            # replace_method='auto': detect HF/Megatron checkpoint naming
+            # and convert through the matching injection policy
+            # (module_inject.CHECKPOINT_POLICIES)
+            from deepspeed_tpu.module_inject import detect_checkpoint_policy
+            pol = detect_checkpoint_policy(sd)
+            if pol is not None and hasattr(self.module, "config"):
+                target_cls = type(pol.target_model(self.module.config))
+                if isinstance(self.module, target_cls):
+                    log_dist(f"injection policy '{pol.name}' converting "
+                             "checkpoint", ranks=[0])
+                    return pol.convert(sd, self.module.config,
+                                       checkpoint_version=ckpt_version)
         return sd
 
     def _apply_weight_quantization(self, module_sd):

@@ -1,0 +1,21 @@
+"""Shared model-family helpers."""
+
+import jax
+import jax.numpy as jnp
+
+
+def masked_next_token_ce(shift_logits, shift_labels):
+    """Fused next-token cross-entropy: logsumexp-minus-gold with the
+    ignore_index=-100 masking convention.
+
+    This is the perf-critical CE form (no second [B, S, V] fp32 array is
+    materialised, unlike log_softmax+gather); both GPT-2 and GPT-J route
+    through here so numerical/masking fixes land once. Inputs are already
+    shifted: ``shift_logits[b, s]`` predicts ``shift_labels[b, s]``."""
+    shift_logits = shift_logits.astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(shift_logits, axis=-1)
+    gold = jnp.take_along_axis(
+        shift_logits, jnp.maximum(shift_labels, 0)[..., None],
+        axis=-1)[..., 0]
+    valid = (shift_labels >= 0).astype(jnp.float32)
+    return ((lse - gold) * valid).sum() / jnp.maximum(valid.sum(), 1.0)

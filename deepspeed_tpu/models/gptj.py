@@ -1,0 +1,164 @@
+"""GPT-J model family (flax) — the injection target for HF GPT-J layers.
+
+The reference handles GPT-J via kernel injection
+(deepspeed/module_inject/replace_policy.py:147 ``GPTJLayerPolicy``:
+rotary_dim + mlp_after_attn=False into DeepSpeedTransformerInference).
+Here the TPU-native equivalent is a flax model built on this package's
+ops (flash attention + partial rotary): ``hf_gptj_to_params`` maps an HF
+``GPTJForCausalLM`` state dict onto it, logits-parity tested against
+transformers.
+
+Architecture (HF modeling_gptj.py): no learned positions (partial rotary
+on the leading ``rotary_dim`` features, interleaved-pair convention),
+q/k/v/out projections without bias, PARALLEL residual
+(x + attn(ln(x)) + mlp(ln(x))), untied biased LM head.
+"""
+
+import dataclasses
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.ops.transformer.attention import attention
+from deepspeed_tpu.ops.transformer.rotary import apply_rotary_pos_emb
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTJConfig:
+    vocab_size: int = 50400
+    n_positions: int = 2048
+    n_embd: int = 4096
+    n_layer: int = 28
+    n_head: int = 16
+    rotary_dim: int = 64
+    n_inner: Optional[int] = None       # default 4*n_embd
+    layer_norm_epsilon: float = 1e-5
+    use_flash: bool = True
+
+    @property
+    def inner(self):
+        return self.n_inner or 4 * self.n_embd
+
+
+class GPTJBlock(nn.Module):
+    config: GPTJConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        B, S, E = x.shape
+        H, D = cfg.n_head, E // cfg.n_head
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, name="ln_1")(x)
+
+        qkv = nn.Dense(3 * E, use_bias=False, name="qkv")(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, S, H, D).transpose(0, 2, 1, 3)
+        k = k.reshape(B, S, H, D).transpose(0, 2, 1, 3)
+        v = v.reshape(B, S, H, D).transpose(0, 2, 1, 3)
+        q, k = apply_rotary_pos_emb(q, k, rotary_dim=cfg.rotary_dim)
+        attn_out = attention(q, k, v, causal=True, use_flash=cfg.use_flash)
+        attn_out = attn_out.transpose(0, 2, 1, 3).reshape(B, S, E)
+        attn_out = nn.Dense(E, use_bias=False, name="out_proj")(attn_out)
+
+        m = nn.Dense(cfg.inner, name="fc_in")(h)
+        m = nn.gelu(m, approximate=True)
+        m = nn.Dense(E, name="fc_out")(m)
+
+        # parallel residual (mlp_after_attn=False in the reference policy)
+        return x + attn_out + m
+
+
+class GPTJForCausalLM(nn.Module):
+    """Causal LM; returns mean next-token CE, or logits with
+    ``return_logits=True`` (InferenceEngine recompute-generate protocol)."""
+    config: GPTJConfig
+
+    @nn.compact
+    def __call__(self, batch, return_logits: bool = False):
+        cfg = self.config
+        if isinstance(batch, (tuple, list)):
+            input_ids, labels = batch[0], (batch[1] if len(batch) > 1
+                                           else None)
+        else:
+            input_ids = batch["input_ids"]
+            labels = batch.get("labels") if isinstance(batch, dict) else None
+
+        wte = self.param("wte", nn.initializers.normal(0.02),
+                         (cfg.vocab_size, cfg.n_embd))
+        x = wte[input_ids]
+        for i in range(cfg.n_layer):
+            x = GPTJBlock(cfg, name=f"h_{i}")(x)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, name="ln_f")(x)
+        if return_logits:
+            return nn.Dense(cfg.vocab_size, name="lm_head")(x)
+        # slice before the head matmul (gpt2.py loss convention: the last
+        # position predicts nothing) and share the fused masked CE
+        from deepspeed_tpu.models.common import masked_next_token_ce
+        shift_labels = (input_ids if labels is None else labels)[:, 1:]
+        shift_logits = nn.Dense(cfg.vocab_size, name="lm_head")(x[:, :-1])
+        return masked_next_token_ce(shift_logits, shift_labels)
+
+
+def gptj_tp_rules():
+    """Megatron-style TP rules for the GPT-J blocks (column-shard qkv +
+    fc_in, row-shard out_proj + fc_out) — the tensor-slicing half of the
+    reference GPTJLayerPolicy."""
+    from jax.sharding import PartitionSpec as P
+    return [
+        (r"h_\d+/qkv/kernel", P(None, "model")),
+        (r"h_\d+/fc_in/kernel", P(None, "model")),
+        (r"h_\d+/fc_in/bias", P("model")),
+        (r"h_\d+/out_proj/kernel", P("model", None)),
+        (r"h_\d+/fc_out/kernel", P("model", None)),
+    ]
+
+
+def is_hf_gptj_state_dict(sd) -> bool:
+    """HF GPT-J naming: transformer.h.N.attn.q_proj (no .attention. level,
+    unlike GPT-Neo) + rotary (no wpe)."""
+    keys = list(sd)
+    return (any(".attn.q_proj.weight" in k for k in keys)
+            and not any(".attn.attention." in k for k in keys))
+
+
+def hf_gptj_to_params(state_dict, config: GPTJConfig):
+    """Map an HF ``GPTJForCausalLM`` state dict onto :class:`GPTJForCausalLM`
+    params. torch Linear stores [out, in] -> transpose to flax [in, out];
+    q/k/v concatenate into the fused qkv kernel."""
+    from deepspeed_tpu.runtime.state_dict_factory import (_hf_get,
+                                                          _hf_layer_count)
+
+    def get(name):
+        return _hf_get(state_dict, name)
+
+    ckpt_layers = _hf_layer_count(state_dict)
+    assert ckpt_layers == config.n_layer, (
+        f"checkpoint has {ckpt_layers} layers, config says "
+        f"n_layer={config.n_layer}")
+
+    p = {"wte": get("wte.weight"),
+         "ln_f": {"scale": get("ln_f.weight"), "bias": get("ln_f.bias")},
+         "lm_head": {"kernel": np.asarray(state_dict["lm_head.weight"],
+                                          np.float32).T,
+                     "bias": np.asarray(state_dict["lm_head.bias"],
+                                        np.float32)}}
+    for i in range(config.n_layer):
+        pre = f"h.{i}"
+        qkv = np.concatenate(
+            [get(f"{pre}.attn.q_proj.weight").T,
+             get(f"{pre}.attn.k_proj.weight").T,
+             get(f"{pre}.attn.v_proj.weight").T], axis=1)
+        p[f"h_{i}"] = {
+            "ln_1": {"scale": get(f"{pre}.ln_1.weight"),
+                     "bias": get(f"{pre}.ln_1.bias")},
+            "qkv": {"kernel": qkv},
+            "out_proj": {"kernel": get(f"{pre}.attn.out_proj.weight").T},
+            "fc_in": {"kernel": get(f"{pre}.mlp.fc_in.weight").T,
+                      "bias": get(f"{pre}.mlp.fc_in.bias")},
+            "fc_out": {"kernel": get(f"{pre}.mlp.fc_out.weight").T,
+                       "bias": get(f"{pre}.mlp.fc_out.bias")},
+        }
+    return p

@@ -135,3 +135,175 @@ def tensor_slicing_rules(policies=None):
         except Exception as e:
             logger.warning(f"policy {p}: tp_rules unavailable ({e})")
     return rules
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint-level policies for HF / Megatron architectures (reference
+# replace_policy.py:44 HFBertLayerPolicy, :103 GPTNEOLayerPolicy,
+# :147 GPTJLayerPolicy, MegatronLayerPolicy, HFGPT2LayerPolicy).
+#
+# The reference policies read attention/mlp/layernorm weights out of an
+# eager HF module and hand them to the fused CUDA inference layer. The
+# flax analogue is a STATE-DICT transform: each policy detects its
+# architecture's checkpoint naming, converts the weights into this
+# package's TPU layer params, and supplies the TP PartitionSpec rules.
+# ---------------------------------------------------------------------------
+
+
+class CheckpointPolicy:
+    """Detect + convert one architecture's checkpoint into TPU params."""
+
+    name: str = "base"
+
+    @staticmethod
+    def matches(sd) -> bool:
+        raise NotImplementedError
+
+    @staticmethod
+    def convert(sd, config, **ctx):
+        """``ctx`` carries conversion context (e.g. checkpoint_version for
+        Megatron layouts); policies ignore keys they don't use."""
+        raise NotImplementedError
+
+    @staticmethod
+    def target_model(config):
+        raise NotImplementedError
+
+    @staticmethod
+    def tp_rules():
+        return []
+
+
+class HFGPT2LayerPolicy(CheckpointPolicy):
+    """reference replace_policy.py HFGPT2LayerPolicy."""
+    name = "hf-gpt2"
+
+    @staticmethod
+    def matches(sd):
+        from deepspeed_tpu.runtime.state_dict_factory import \
+            is_hf_gpt2_state_dict
+        return is_hf_gpt2_state_dict(sd)
+
+    @staticmethod
+    def convert(sd, config, **ctx):
+        from deepspeed_tpu.runtime.state_dict_factory import hf_gpt2_to_params
+        return hf_gpt2_to_params(sd, config)
+
+    @staticmethod
+    def target_model(config):
+        from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel
+        return GPT2LMHeadModel(config)
+
+    @staticmethod
+    def tp_rules():
+        from deepspeed_tpu.models.gpt2 import gpt2_tp_rules
+        return gpt2_tp_rules()
+
+
+class GPTNEOLayerPolicy(CheckpointPolicy):
+    """reference replace_policy.py:103 — separate un-biased q/k/v,
+    UNSCALED attention (folded into the q kernel by the converter)."""
+    name = "hf-gptneo"
+
+    @staticmethod
+    def matches(sd):
+        from deepspeed_tpu.runtime.state_dict_factory import \
+            is_hf_gptneo_state_dict
+        return is_hf_gptneo_state_dict(sd)
+
+    @staticmethod
+    def convert(sd, config, **ctx):
+        from deepspeed_tpu.runtime.state_dict_factory import \
+            hf_gptneo_to_params
+        return hf_gptneo_to_params(sd, config)
+
+    @staticmethod
+    def target_model(config):
+        from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel
+        return GPT2LMHeadModel(config)
+
+    @staticmethod
+    def tp_rules():
+        from deepspeed_tpu.models.gpt2 import gpt2_tp_rules
+        return gpt2_tp_rules()
+
+
+class GPTJLayerPolicy(CheckpointPolicy):
+    """reference replace_policy.py:147 — rotary_dim, parallel residual
+    (mlp_after_attn=False), un-biased projections, biased untied head."""
+    name = "hf-gptj"
+
+    @staticmethod
+    def matches(sd):
+        from deepspeed_tpu.models.gptj import is_hf_gptj_state_dict
+        return is_hf_gptj_state_dict(sd)
+
+    @staticmethod
+    def convert(sd, config, **ctx):
+        from deepspeed_tpu.models.gptj import hf_gptj_to_params
+        return hf_gptj_to_params(sd, config)
+
+    @staticmethod
+    def target_model(config):
+        from deepspeed_tpu.models.gptj import GPTJForCausalLM
+        return GPTJForCausalLM(config)
+
+    @staticmethod
+    def tp_rules():
+        from deepspeed_tpu.models.gptj import gptj_tp_rules
+        return gptj_tp_rules()
+
+
+class MegatronLayerPolicy(CheckpointPolicy):
+    """reference replace_policy.py MegatronLayerPolicy: fused QKV with
+    version-dependent head layouts (handled by megatron_to_gpt2_params'
+    checkpoint_version logic)."""
+    name = "megatron"
+
+    @staticmethod
+    def matches(sd):
+        return any("attention.query_key_value.weight" in k for k in sd)
+
+    @staticmethod
+    def convert(sd, config, checkpoint_version=0, **ctx):
+        from deepspeed_tpu.runtime.state_dict_factory import \
+            megatron_to_gpt2_params
+        return megatron_to_gpt2_params(
+            sd, config, checkpoint_version=checkpoint_version)
+
+    @staticmethod
+    def target_model(config):
+        from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel
+        return GPT2LMHeadModel(config)
+
+    @staticmethod
+    def tp_rules():
+        from deepspeed_tpu.models.gpt2 import gpt2_tp_rules
+        return gpt2_tp_rules()
+
+
+CHECKPOINT_POLICIES = [HFGPT2LayerPolicy, GPTNEOLayerPolicy,
+                       GPTJLayerPolicy, MegatronLayerPolicy]
+
+
+def detect_checkpoint_policy(sd):
+    """Auto-detect which architecture a state dict belongs to (the
+    replace_method='auto' analogue, reference replace_module.py)."""
+    for pol in CHECKPOINT_POLICIES:
+        try:
+            if pol.matches(sd):
+                return pol
+        except Exception:
+            continue
+    return None
+
+
+def convert_hf_checkpoint(sd, config, **ctx):
+    """Detect + convert in one call; returns (params, policy) or raises.
+    ``ctx`` (e.g. checkpoint_version=...) is forwarded to the policy."""
+    pol = detect_checkpoint_policy(sd)
+    if pol is None:
+        raise ValueError(
+            "unrecognised checkpoint format: no injection policy matched "
+            f"(known: {[p.name for p in CHECKPOINT_POLICIES]})")
+    return pol.convert(sd, config, **ctx), pol

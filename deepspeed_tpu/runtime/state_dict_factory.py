@@ -463,6 +463,23 @@ def is_hf_gpt2_state_dict(sd: Dict[str, Any]) -> bool:
     return any("attn.c_attn.weight" in k for k in sd)
 
 
+def _hf_get(state_dict, name):
+    """Fetch a tensor accepting either bare or 'transformer.'-prefixed HF
+    keys (shared by the hf_*_to_params converters)."""
+    for k in (name, f"transformer.{name}"):
+        if k in state_dict:
+            return np.asarray(state_dict[k], np.float32)
+    raise KeyError(name)
+
+
+def _hf_layer_count(state_dict) -> int:
+    """Number of transformer layers recorded in an HF state dict (keys
+    'h.N.*' / 'transformer.h.N.*')."""
+    return 1 + max(
+        (int(k.split("h.")[1].split(".")[0]) for k in state_dict
+         if ".h." in k or k.startswith("h.")), default=-1)
+
+
 def hf_gpt2_to_params(state_dict: Dict[str, Any], config) -> Dict:
     """Map a HuggingFace GPT-2 state dict (torch ``GPT2LMHeadModel``
     naming) onto this package's flax params — the HF half of the
@@ -472,16 +489,11 @@ def hf_gpt2_to_params(state_dict: Dict[str, Any], config) -> Dict:
     E = config.n_embd
 
     def get(name):
-        for k in (name, f"transformer.{name}"):
-            if k in state_dict:
-                return np.asarray(state_dict[k], np.float32)
-        raise KeyError(name)
+        return _hf_get(state_dict, name)
 
     # fail fast on config/checkpoint mismatch (a silent drop of extra
     # layers or a short wpe would serve wrong-but-plausible logits)
-    ckpt_layers = 1 + max(
-        (int(k.split("h.")[1].split(".")[0]) for k in state_dict
-         if ".h." in k or k.startswith("h.")), default=-1)
+    ckpt_layers = _hf_layer_count(state_dict)
     assert ckpt_layers == config.n_layer, (
         f"checkpoint has {ckpt_layers} transformer layers but the model "
         f"config says n_layer={config.n_layer}")
@@ -558,3 +570,80 @@ def gpt2_params_to_megatron(params: Dict, config) -> Dict[str, Any]:
         sd[f"{pre}.mlp.dense_4h_to_h.bias"] = np.asarray(
             blk["mlp"]["proj"]["bias"])
     return sd
+
+
+def is_hf_gptneo_state_dict(sd: Dict[str, Any]) -> bool:
+    """HF GPT-Neo naming: transformer.h.N.attn.attention.q_proj."""
+    return any(".attn.attention.q_proj.weight" in k for k in sd)
+
+
+def hf_gptneo_to_params(state_dict: Dict[str, Any], config) -> Dict:
+    """Map an HF ``GPTNeoForCausalLM`` state dict onto this package's flax
+    ``GPT2LMHeadModel`` params (the GPTNEOLayerPolicy analogue,
+    reference module_inject/replace_policy.py:103).
+
+    Differences from GPT-2 handled here:
+    * torch ``nn.Linear`` weights are [out, in] (transpose — HF GPT-2 uses
+      Conv1D which is already [in, out]);
+    * separate un-biased q/k/v projections -> fused qkv kernel with a zero
+      bias;
+    * GPT-Neo does NOT scale attention scores; our attention always
+      multiplies by 1/sqrt(head_dim), so sqrt(head_dim) is folded into the
+      q columns (the scale_attention=False of the reference policy).
+
+    NOTE GPT-Neo alternates global/local(window-256) attention layers; the
+    converted model computes full causal attention everywhere, which is
+    only equivalent while sequences stay within the local window.
+    """
+    E = config.n_embd
+    D = E // config.n_head
+
+    if config.n_positions > 256:
+        logger.warning(
+            "GPT-Neo checkpoints may contain local-attention (window-256) "
+            "layers that this conversion approximates with full causal "
+            f"attention; with n_positions={config.n_positions} > 256, "
+            "sequences beyond the window will diverge from the HF model.")
+
+    def get(name):
+        return _hf_get(state_dict, name)
+
+    ckpt_layers = _hf_layer_count(state_dict)
+    assert ckpt_layers == config.n_layer, (
+        f"checkpoint has {ckpt_layers} transformer layers but the model "
+        f"config says n_layer={config.n_layer}")
+
+    p: Dict[str, Any] = {}
+    wte = get("wte.weight")
+    assert wte.shape[0] <= config.padded_vocab, (
+        f"checkpoint vocab {wte.shape[0]} exceeds padded_vocab "
+        f"{config.padded_vocab}")
+    if wte.shape[0] < config.padded_vocab:
+        wte = np.pad(wte, [(0, config.padded_vocab - wte.shape[0]), (0, 0)])
+    p["wte"] = wte
+    p["wpe"] = get("wpe.weight")
+    assert p["wpe"].shape[0] >= config.n_positions
+    p["ln_f"] = {"scale": get("ln_f.weight"), "bias": get("ln_f.bias")}
+    for i in range(config.n_layer):
+        pre = f"h.{i}"
+        att = f"{pre}.attn.attention"
+        q = get(f"{att}.q_proj.weight").T * np.sqrt(D).astype(np.float32)
+        k = get(f"{att}.k_proj.weight").T
+        v = get(f"{att}.v_proj.weight").T
+        p[f"h_{i}"] = {
+            "ln_1": {"scale": get(f"{pre}.ln_1.weight"),
+                     "bias": get(f"{pre}.ln_1.bias")},
+            "ln_2": {"scale": get(f"{pre}.ln_2.weight"),
+                     "bias": get(f"{pre}.ln_2.bias")},
+            "attn": {
+                "qkv": {"kernel": np.concatenate([q, k, v], axis=1),
+                        "bias": np.zeros((3 * E,), np.float32)},
+                "proj": {"kernel": get(f"{att}.out_proj.weight").T,
+                         "bias": get(f"{att}.out_proj.bias")}},
+            "mlp": {
+                "fc": {"kernel": get(f"{pre}.mlp.c_fc.weight").T,
+                       "bias": get(f"{pre}.mlp.c_fc.bias")},
+                "proj": {"kernel": get(f"{pre}.mlp.c_proj.weight").T,
+                         "bias": get(f"{pre}.mlp.c_proj.bias")}},
+        }
+    return p

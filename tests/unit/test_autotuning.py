@@ -81,3 +81,172 @@ def test_engine_tensorboard_integration(tmp_path):
     # events flushed to disk (tb event file or csv)
     files = [str(p) for p in (tmp_path / "tb").rglob("*")]
     assert any(os.path.isfile(f) for f in files)
+
+
+# -------------------------------------------------- cost model + tuners
+def test_cost_model_ranks_quadratic_surface():
+    """RidgeCostModel must learn to rank configs on a curved throughput
+    surface (the XGBoostCostModel 'rank' objective analogue)."""
+    from deepspeed_tpu.autotuning.cost_model import RidgeCostModel, featurize
+    rng = np.random.default_rng(0)
+    configs = [{"micro": float(m), "stage": float(s)}
+               for m in (1, 2, 4, 8, 16) for s in (0, 1, 2, 3)]
+    X, keys = featurize(configs)
+
+    def true_perf(m, s):  # peak at micro=8, mild stage penalty
+        return -(m - 8.0) ** 2 - 3.0 * s + 100.0
+
+    y = np.array([true_perf(c["micro"], c["stage"]) for c in configs])
+    model = RidgeCostModel()
+    model.fit(X, y + rng.normal(0, 0.1, y.shape))
+    pred = model.predict(X)
+    assert int(np.argmax(pred)) == int(np.argmax(y))
+
+
+def test_cost_model_tuner_converges():
+    """CostModelTuner should find the best config in clearly fewer trials
+    than exhaustive grid for a smooth surface."""
+    from deepspeed_tpu.autotuning.autotuner import CostModelTuner
+    configs = [{"train_micro_batch_size_per_gpu": m,
+                "zero_optimization": {"stage": s}}
+               for m in (1, 2, 4, 8, 16, 32) for s in (0, 1, 2, 3)]
+
+    def perf(c):
+        m = c["train_micro_batch_size_per_gpu"]
+        s = c["zero_optimization"]["stage"]
+        return -(m - 8) ** 2 - 3 * s + 100.0
+
+    best_true = max(configs, key=perf)
+    tuner = CostModelTuner(configs, seed=1)
+    seen_best = None
+    for _ in range(12):          # half the 24-config space
+        cfg = tuner.next()
+        if cfg is None:
+            break
+        p = perf(cfg)
+        tuner.update(cfg, p)
+        if seen_best is None or p > seen_best[0]:
+            seen_best = (p, cfg)
+    assert seen_best[1] == best_true
+
+
+def test_autotuner_tuning_space_dims(tmp_path):
+    """Extra dotted-path search dims land in the trial configs."""
+    from deepspeed_tpu.autotuning.autotuner import Autotuner
+    at = Autotuner(make_engine=None, make_batch=None,
+                   base_config={}, micro_batch_sizes=[1, 2],
+                   zero_stages=[0],
+                   tuning_space={
+                       "activation_checkpointing.partition_activations":
+                           [False, True]},
+                   results_dir=str(tmp_path))
+    exps = at._build_experiments(dp_world=4)
+    assert len(exps) == 4  # 2 micro x 2 remat
+    flags = {e["activation_checkpointing"]["partition_activations"]
+             for e in exps}
+    assert flags == {False, True}
+    assert all(e["train_batch_size"] ==
+               4 * e["train_micro_batch_size_per_gpu"] for e in exps)
+
+
+# ------------------------------------------------------------- scheduler
+def test_resource_manager_in_process():
+    from deepspeed_tpu.autotuning.scheduler import ResourceManager
+    rm = ResourceManager(run_fn=lambda cfg: cfg["x"] * 2.0)
+    rm.schedule_experiments([{"x": 1}, {"x": 5}, {"x": 3}])
+    rm.run()
+    assert all(e.done for e in rm.experiments)
+    assert rm.best().config == {"x": 5}
+    assert rm.best().metric == 10.0
+
+
+def test_resource_manager_subprocess(tmp_path):
+    """The reference's launch-a-job-per-experiment scheme: each experiment
+    dir gets ds_config.json; the command writes metric.json."""
+    import sys
+    from deepspeed_tpu.autotuning.scheduler import ResourceManager
+    script = (
+        "import json, os; d=os.environ['DS_AUTOTUNING_EXP_DIR'];"
+        "cfg=json.load(open(os.path.join(d,'ds_config.json')));"
+        "json.dump({'throughput': cfg['x']*3.0},"
+        "open(os.path.join(d,'metric.json'),'w'))")
+    rm = ResourceManager(cmd_template=[sys.executable, "-c", script],
+                         exps_dir=str(tmp_path), num_slots=2)
+    rm.schedule_experiments([{"x": 2}, {"x": 7}, {"x": 4}])
+    rm.run()
+    assert [e.metric for e in rm.experiments] == [6.0, 21.0, 12.0]
+    assert rm.best().metric == 21.0
+
+
+def test_resource_manager_failed_experiment():
+    from deepspeed_tpu.autotuning.scheduler import ResourceManager
+
+    def run(cfg):
+        if cfg["x"] == 2:
+            raise RuntimeError("oom")
+        return float(cfg["x"])
+
+    rm = ResourceManager(run_fn=run)
+    rm.schedule_experiments([{"x": 2}, {"x": 9}])
+    rm.run()
+    assert rm.experiments[0].metric is None
+    assert rm.experiments[0].error
+    assert rm.best().metric == 9.0
+
+
+def test_cost_model_sees_categorical_dims():
+    """String tuning dims (offload device) must be distinguishable."""
+    from deepspeed_tpu.autotuning.cost_model import RidgeCostModel, featurize
+    configs = [{"zero_optimization": {"offload_optimizer": {"device": d}},
+                "train_micro_batch_size_per_gpu": m}
+               for d in ("none", "cpu") for m in (1, 2, 4)]
+    X, keys = featurize(configs)
+    # the two devices produce DIFFERENT rows at equal micro-batch
+    assert not np.allclose(X[0], X[3])
+    y = np.array([100.0 if c["zero_optimization"]["offload_optimizer"][
+        "device"] == "none" else 10.0 for c in configs])
+    model = RidgeCostModel()
+    model.fit(X, y)
+    pred = model.predict(X)
+    assert pred[:3].mean() > pred[3:].mean()
+
+
+def test_gridsearch_visits_all_stages(tmp_path):
+    """Per-stage early stop: a saturated stage must not starve later
+    stages (regression counter resets per stage)."""
+    from deepspeed_tpu.autotuning.autotuner import Autotuner
+    calls = []
+
+    perf = {(0, 1): 50.0, (0, 2): 40.0, (0, 4): 30.0, (0, 8): 20.0,
+            (1, 1): 60.0, (1, 2): 80.0, (1, 4): 70.0, (1, 8): 65.0}
+
+    class FakeEngine:
+        def __init__(self, cfg):
+            self.cfg = cfg
+
+        def train_batch(self, batch=None):
+            import time
+            key = (self.cfg["zero_optimization"]["stage"],
+                   self.cfg["train_micro_batch_size_per_gpu"])
+            time.sleep(0.2 / perf[key])
+            return 0.0
+
+        @property
+        def state(self):
+            class S:
+                params = np.zeros(())
+            return S()
+
+    def make_engine(cfg):
+        calls.append((cfg["zero_optimization"]["stage"],
+                      cfg["train_micro_batch_size_per_gpu"]))
+        return FakeEngine(cfg)
+
+    at = Autotuner(make_engine, lambda bs: None, base_config={},
+                   micro_batch_sizes=[1, 2, 4, 8], zero_stages=[0, 1],
+                   tuner_type="gridsearch", early_stop=2,
+                   steps_per_trial=1, results_dir=str(tmp_path))
+    best = at.tune()
+    stages_tried = {s for s, _ in calls}
+    assert stages_tried == {0, 1}, calls
+    assert best["zero_optimization"]["stage"] == 1

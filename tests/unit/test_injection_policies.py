@@ -1,0 +1,171 @@
+"""Injection-policy logits parity vs HuggingFace transformers.
+
+Ports the verification idea of the reference's module_inject tests: for
+each architecture policy (reference replace_policy.py:44/:103/:147),
+convert a randomly-initialised HF torch model's state dict through the
+policy and require logits parity between the torch forward and this
+package's TPU layer stack.
+"""
+
+import pytest as _pytest
+
+pytestmark = _pytest.mark.slow  # loads torch + compiles: slow tier
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from deepspeed_tpu.module_inject import (GPTJLayerPolicy, GPTNEOLayerPolicy,
+                                         MegatronLayerPolicy,
+                                         convert_hf_checkpoint,
+                                         detect_checkpoint_policy)
+
+B, S = 2, 12
+
+
+def _logits_close(ours, theirs, rtol=2e-4, atol=2e-4):
+    np.testing.assert_allclose(np.asarray(ours, np.float32),
+                               np.asarray(theirs, np.float32),
+                               rtol=rtol, atol=atol)
+
+
+def test_gptneo_policy_logits_parity():
+    from deepspeed_tpu.models.gpt2 import GPT2Config
+    hf_cfg = transformers.GPTNeoConfig(
+        vocab_size=128, max_position_embeddings=64, hidden_size=32,
+        num_layers=2, num_heads=4, intermediate_size=128,
+        attention_types=[[["global"], 2]], attention_dropout=0.0,
+        embed_dropout=0.0, resid_dropout=0.0)
+    torch.manual_seed(0)
+    hf = transformers.GPTNeoForCausalLM(hf_cfg).eval()
+    sd = hf.state_dict()
+
+    pol = detect_checkpoint_policy(sd)
+    assert pol is GPTNEOLayerPolicy
+
+    cfg = GPT2Config(vocab_size=128, n_positions=64, n_embd=32, n_layer=2,
+                     n_head=4, use_flash=False, dropout=0.0)
+    params, pol2 = convert_hf_checkpoint(sd, cfg)
+    assert pol2 is GPTNEOLayerPolicy
+
+    ids = np.random.default_rng(0).integers(0, 128, (B, S))
+    with torch.no_grad():
+        theirs = hf(torch.tensor(ids)).logits.numpy()
+
+    model = pol.target_model(cfg)
+    ours = model.apply({"params": params},
+                       {"input_ids": jnp.asarray(ids, jnp.int32)},
+                       return_logits=True)
+    _logits_close(ours[..., :128], theirs)
+
+
+def test_gptj_policy_logits_parity():
+    from deepspeed_tpu.models.gptj import GPTJConfig
+    hf_cfg = transformers.GPTJConfig(
+        vocab_size=128, n_positions=64, n_embd=32, n_layer=2, n_head=4,
+        rotary_dim=4, attn_pdrop=0.0, embd_pdrop=0.0, resid_pdrop=0.0)
+    torch.manual_seed(0)
+    hf = transformers.GPTJForCausalLM(hf_cfg).eval()
+    sd = hf.state_dict()
+
+    pol = detect_checkpoint_policy(sd)
+    assert pol is GPTJLayerPolicy
+
+    cfg = GPTJConfig(vocab_size=128, n_positions=64, n_embd=32, n_layer=2,
+                     n_head=4, rotary_dim=4, use_flash=False)
+    params, _ = convert_hf_checkpoint(sd, cfg)
+
+    ids = np.random.default_rng(1).integers(0, 128, (B, S))
+    with torch.no_grad():
+        theirs = hf(torch.tensor(ids)).logits.numpy()
+
+    model = pol.target_model(cfg)
+    ours = model.apply({"params": params},
+                       {"input_ids": jnp.asarray(ids, jnp.int32)},
+                       return_logits=True)
+    _logits_close(ours, theirs)
+
+
+def test_megatron_policy_roundtrip_logits():
+    """Megatron policy: convert a megatron-layout state dict produced from
+    our own params and require identical logits (the QKV-layout handling
+    is covered by test_state_dict_factory; here the POLICY path)."""
+    from deepspeed_tpu.models.gpt2 import GPT2Config
+    from deepspeed_tpu.runtime.state_dict_factory import \
+        gpt2_params_to_megatron
+    cfg = GPT2Config(vocab_size=128, n_positions=64, n_embd=32, n_layer=2,
+                     n_head=4, use_flash=False, dropout=0.0)
+    model = MegatronLayerPolicy.target_model(cfg)
+    ids = np.random.default_rng(2).integers(0, 128, (B, S))
+    params = model.init(jax.random.PRNGKey(0),
+                        {"input_ids": jnp.asarray(ids, jnp.int32)})["params"]
+    sd = gpt2_params_to_megatron(params, cfg)
+
+    assert detect_checkpoint_policy(sd) is MegatronLayerPolicy
+    params2 = MegatronLayerPolicy.convert(sd, cfg)
+
+    a = model.apply({"params": params},
+                    {"input_ids": jnp.asarray(ids, jnp.int32)},
+                    return_logits=True)
+    b = model.apply({"params": params2},
+                    {"input_ids": jnp.asarray(ids, jnp.int32)},
+                    return_logits=True)
+    _logits_close(a, b, rtol=1e-5, atol=1e-5)
+
+
+def test_gptj_generate_via_inference_engine():
+    """The injected GPT-J model drives the InferenceEngine generate path."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gptj import GPTJConfig
+    hf_cfg = transformers.GPTJConfig(
+        vocab_size=128, n_positions=64, n_embd=32, n_layer=2, n_head=4,
+        rotary_dim=4, attn_pdrop=0.0, embd_pdrop=0.0, resid_pdrop=0.0)
+    torch.manual_seed(0)
+    hf = transformers.GPTJForCausalLM(hf_cfg).eval()
+    cfg = GPTJConfig(vocab_size=128, n_positions=64, n_embd=32, n_layer=2,
+                     n_head=4, rotary_dim=4, use_flash=False)
+    params, pol = convert_hf_checkpoint(hf.state_dict(), cfg)
+    eng = deepspeed_tpu.init_inference(pol.target_model(cfg), params=params,
+                                       dtype=jnp.float32)
+    p = jnp.asarray(np.random.default_rng(3).integers(0, 128, (2, 6)),
+                    jnp.int32)
+    out = eng.generate(p, max_new_tokens=4)
+    assert out.shape == (2, 10)
+    assert int(np.asarray(out).max()) < 128
+
+
+def test_engine_passes_megatron_checkpoint_version(tmp_path, monkeypatch):
+    """The auto-detect load path must forward the OUTER dict's
+    checkpoint_version to the Megatron conversion (QKV head layouts
+    differ across versions)."""
+    import pickle
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Config
+    from deepspeed_tpu.runtime.state_dict_factory import \
+        gpt2_params_to_megatron
+
+    cfg = GPT2Config(vocab_size=128, n_positions=64, n_embd=32, n_layer=2,
+                     n_head=4, use_flash=False, dropout=0.0)
+    model = MegatronLayerPolicy.target_model(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        {"input_ids": jnp.zeros((1, 4), jnp.int32)})["params"]
+    sd = gpt2_params_to_megatron(params, cfg)
+    ck = tmp_path / "meg.pt"
+    with open(ck, "wb") as f:
+        pickle.dump({"module": sd, "checkpoint_version": 2.0}, f)
+
+    seen = {}
+    orig = MegatronLayerPolicy.convert
+
+    def spy(sd_, config, checkpoint_version=0):
+        seen["version"] = checkpoint_version
+        return orig(sd_, config, checkpoint_version=checkpoint_version)
+
+    monkeypatch.setattr(MegatronLayerPolicy, "convert", staticmethod(spy))
+    deepspeed_tpu.init_inference(model, checkpoint=str(ck),
+                                 dtype=jnp.float32)
+    assert seen["version"] == 2.0
