@@ -241,60 +241,79 @@ class Autotuner:
             dp_world = jax.device_count()
 
         exps = self._build_experiments(dp_world)
-        budget = self.max_trials or len(exps)
         best = None
-        regressions = 0
 
-        if self.tuner_type == "model_based":
-            tuner = CostModelTuner(exps)
-            seq = iter(tuner.next, None)
-        else:
-            # grid/random: order the flat experiment list; the scalar
-            # tuner classes only provide ordering policy
-            order = (GridSearchTuner(exps).order()
-                     if self.tuner_type == "gridsearch"
-                     else RandomTuner(exps).order())
-            tuner = None
-            seq = iter(order)
-
-        trials = 0
-        last_stage = None
-        stage_best = None
-        for cfg in seq:
-            if trials >= budget:
-                break
-            trials += 1
-            stage = cfg["zero_optimization"]["stage"]
-            if tuner is None and stage != last_stage:
-                # ordered (stage-major) search: the regression counter is
-                # per-stage so a saturated stage never starves later ones
-                regressions = 0
-                stage_best = None
-                last_stage = stage
+        def measure(cfg):
             tput = self._run_trial(cfg)
-            if tuner is not None:
-                tuner.update(cfg, tput)
-            rec = {"zero_stage": stage,
+            rec = {"zero_stage": cfg["zero_optimization"]["stage"],
                    "micro_batch": cfg["train_micro_batch_size_per_gpu"],
                    "samples_per_sec": tput,
                    "config": cfg}
             self.records.append(rec)
             logger.info(f"trial zero={rec['zero_stage']} "
                         f"micro={rec['micro_batch']} -> {tput}")
-            if tput is None:
-                continue
-            if best is None or tput > best[0]:
-                best = (tput, cfg)
-            if stage_best is None or tput > stage_best:
-                stage_best = tput
-                regressions = 0
-            else:
-                regressions += 1
-                if tuner is None and regressions >= self.early_stop:
-                    # skip the rest of THIS stage's experiments
-                    seq = iter([c for c in seq
-                                if c["zero_optimization"]["stage"] != stage])
+            return tput
+
+        if self.tuner_type == "model_based":
+            # guided search: a default budget well below the full product
+            # (the point of the cost model), plus a global consecutive-
+            # regression stop
+            budget = self.max_trials or min(
+                len(exps), max(CostModelTuner.INIT_NUM + 4,
+                               (len(exps) + 1) // 2))
+            tuner = CostModelTuner(exps)
+            regressions = 0
+            for _ in range(budget):
+                cfg = tuner.next()
+                if cfg is None:
+                    break
+                tput = measure(cfg)
+                tuner.update(cfg, tput)
+                if tput is None:
                     continue
+                if best is None or tput > best[0]:
+                    best = (tput, cfg)
+                    regressions = 0
+                else:
+                    regressions += 1
+                    if regressions >= self.early_stop * 2:
+                        break
+        else:
+            # ordered (stage-major) search with a PER-STAGE regression
+            # counter: a saturated stage is skipped without starving
+            # later stages
+            order = (GridSearchTuner(exps).order()
+                     if self.tuner_type == "gridsearch"
+                     else RandomTuner(exps).order())
+            budget = self.max_trials or len(exps)
+            trials = 0
+            last_stage = None
+            stage_best = None
+            regressions = 0
+            skip_stages = set()
+            for cfg in order:
+                if trials >= budget:
+                    break
+                stage = cfg["zero_optimization"]["stage"]
+                if stage in skip_stages:
+                    continue
+                if stage != last_stage:
+                    regressions = 0
+                    stage_best = None
+                    last_stage = stage
+                trials += 1
+                tput = measure(cfg)
+                if tput is None:
+                    continue
+                if best is None or tput > best[0]:
+                    best = (tput, cfg)
+                if stage_best is None or tput > stage_best:
+                    stage_best = tput
+                    regressions = 0
+                else:
+                    regressions += 1
+                    if regressions >= self.early_stop:
+                        skip_stages.add(stage)
 
         os.makedirs(self.results_dir, exist_ok=True)
         with open(os.path.join(self.results_dir, "results.json"), "w") as f:

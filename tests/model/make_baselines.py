@@ -18,20 +18,37 @@ def main():
     jax.config.update("jax_platforms", "cpu")  # baselines are CPU-pinned
     from tests.model import oracle
 
-    out = {
-        "config": {"model": oracle.TINY, "batch_size": oracle.BATCH_SIZE,
-                   "seq_len": oracle.SEQ_LEN, "lr": oracle.LR,
-                   "seed": oracle.SEED, "optimizer": "adam(0.9,0.999,1e-8)",
-                   "platform": "cpu-fp32"},
-        "losses": oracle.golden_curve(steps=20),
+    base = os.path.join(os.path.dirname(__file__), "baselines")
+    os.makedirs(base, exist_ok=True)
+    goldens = {
+        "gpt2_tiny_fp32_adam.json": (
+            {"model": oracle.TINY, "optimizer": "adam(0.9,0.999,1e-8)"},
+            lambda: oracle.golden_curve(steps=20)),
+        # BASELINE.json configs #3/#4/#5
+        "bert_tiny_fp32_lamb.json": (
+            {"model": oracle.TINY_BERT,
+             "optimizer": "lamb(0.9,0.999,1e-6,coeff 0.01..10)"},
+            lambda: oracle.golden_curve_bert_lamb(steps=20)),
+        "gpt2_moe_tiny_fp32_adam.json": (
+            {"model": oracle.TINY_MOE, "optimizer": "adam(0.9,0.999,1e-8)",
+             "rngs": "engine protocol (fold_in(seed, step); gating=fold 7)"},
+            lambda: oracle.golden_curve_moe(steps=20)),
+        "gpt2_pp2_tiny_fp32_adam.json": (
+            {"model": oracle.TINY_3D, "optimizer": "adam(0.9,0.999,1e-8)"},
+            lambda: oracle.golden_curve_3d(steps=20)),
     }
-    path = os.path.join(os.path.dirname(__file__), "baselines",
-                        "gpt2_tiny_fp32_adam.json")
-    os.makedirs(os.path.dirname(path), exist_ok=True)
-    with open(path, "w") as f:
-        json.dump(out, f, indent=1)
-    print(f"wrote {path}: first={out['losses'][0]:.6f} "
-          f"last={out['losses'][-1]:.6f}")
+    for name, (desc, fn) in goldens.items():
+        out = {
+            "config": dict(desc, batch_size=oracle.BATCH_SIZE,
+                           seq_len=oracle.SEQ_LEN, seed=oracle.SEED,
+                           platform="cpu-fp32"),
+            "losses": fn(),
+        }
+        path = os.path.join(base, name)
+        with open(path, "w") as f:
+            json.dump(out, f, indent=1)
+        print(f"wrote {path}: first={out['losses'][0]:.6f} "
+              f"last={out['losses'][-1]:.6f}")
 
 
 if __name__ == "__main__":

@@ -66,3 +66,125 @@ def golden_curve(steps=20, lr=LR, seed=SEED, b1=0.9, b2=0.999, eps=1e-8):
         params, mu, nu, step, loss = train_step(params, mu, nu, step, batch)
         losses.append(float(loss))
     return losses
+
+
+# ---------------------------------------------------------------------------
+# BASELINE.json configs #3/#4/#5 goldens (VERDICT r2 weak #7): tiny
+# BERT+LAMB, tiny MoE-GPT, tiny 3D (pp). Same philosophy: training math
+# written out by hand, no deepspeed_tpu.runtime imports.
+# ---------------------------------------------------------------------------
+
+TINY_BERT = dict(vocab_size=512, hidden_size=64, num_hidden_layers=2,
+                 num_attention_heads=4, intermediate_size=256,
+                 max_position_embeddings=128)
+TINY_MOE = dict(TINY, moe_num_experts=4, moe_k=1)
+TINY_3D = dict(TINY, pp_stages=2)
+LAMB_LR = 1e-3
+
+
+def make_bert_batches(steps, batch_size=BATCH_SIZE, seq_len=SEQ_LEN,
+                      vocab=TINY_BERT["vocab_size"]):
+    from deepspeed_tpu.models.bert import synthetic_mlm_batch
+    return [synthetic_mlm_batch(batch_size, seq_len, vocab, seed=1000 + s)
+            for s in range(steps)]
+
+
+def _hand_adam_curve(model, batches, lr=LR, seed=SEED, b1=0.9, b2=0.999,
+                     eps=1e-8, rngs_fn=None):
+    """fp32 hand-rolled Adam curve for any loss-returning flax model.
+    ``rngs_fn(step) -> rngs dict`` replicates the engine's per-step rng
+    protocol for stochastic models (MoE RTS gating)."""
+    init_rngs = {"params": jax.random.PRNGKey(seed)}
+    params = model.init(init_rngs, batches[0])["params"]
+    mu = jax.tree.map(jnp.zeros_like, params)
+    nu = jax.tree.map(jnp.zeros_like, params)
+
+    @jax.jit
+    def train_step(params, mu, nu, step, batch, rngs):
+        def loss_fn(p):
+            kw = {"rngs": rngs} if rngs else {}
+            return model.apply({"params": p}, batch, **kw)
+
+        loss, g = jax.value_and_grad(loss_fn)(params)
+        step = step + 1
+        mu = jax.tree.map(lambda m, gg: b1 * m + (1 - b1) * gg, mu, g)
+        nu = jax.tree.map(lambda v, gg: b2 * v + (1 - b2) * gg * gg, nu, g)
+        c1 = 1.0 - b1 ** step.astype(jnp.float32)
+        c2 = 1.0 - b2 ** step.astype(jnp.float32)
+        params = jax.tree.map(
+            lambda p, m, v: p - lr * (m / c1) / (jnp.sqrt(v / c2) + eps),
+            params, mu, nu)
+        return params, mu, nu, step, loss
+
+    step = jnp.zeros([], jnp.int32)
+    losses = []
+    for i, batch in enumerate(batches):
+        rngs = rngs_fn(i) if rngs_fn else None
+        params, mu, nu, step, loss = train_step(params, mu, nu, step,
+                                                batch, rngs)
+        losses.append(float(loss))
+    return losses
+
+
+def golden_curve_bert_lamb(steps=20, lr=LAMB_LR, seed=SEED, b1=0.9,
+                           b2=0.999, eps=1e-6, min_coeff=0.01,
+                           max_coeff=10.0):
+    """Tiny BERT MLM + hand-rolled LAMB (the FusedLamb algorithm: Adam
+    moments + per-tensor trust ratio clamped to [min, max])."""
+    from deepspeed_tpu.models.bert import BertConfig, BertForPreTraining
+    model = BertForPreTraining(BertConfig(**TINY_BERT))
+    batches = make_bert_batches(steps)
+    params = model.init(jax.random.PRNGKey(seed), batches[0])["params"]
+    mu = jax.tree.map(jnp.zeros_like, params)
+    nu = jax.tree.map(jnp.zeros_like, params)
+
+    @jax.jit
+    def train_step(params, mu, nu, step, batch):
+        loss, g = jax.value_and_grad(
+            lambda p: model.apply({"params": p}, batch))(params)
+        step = step + 1
+        bc1 = 1.0 - b1 ** step.astype(jnp.float32)
+        bc2 = 1.0 - b2 ** step.astype(jnp.float32)
+        mu = jax.tree.map(lambda m, gg: b1 * m + (1 - b1) * gg, mu, g)
+        nu = jax.tree.map(lambda v, gg: b2 * v + (1 - b2) * gg * gg, nu, g)
+
+        def upd(p, m, v):
+            u = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
+            w_norm = jnp.linalg.norm(p.reshape(-1))
+            u_norm = jnp.linalg.norm(u.reshape(-1))
+            ratio = jnp.where((w_norm > 0) & (u_norm > 0),
+                              jnp.clip(w_norm / u_norm, min_coeff,
+                                       max_coeff), jnp.float32(1.0))
+            return p - lr * ratio * u
+
+        params = jax.tree.map(upd, params, mu, nu)
+        return params, mu, nu, step, loss
+
+    step = jnp.zeros([], jnp.int32)
+    losses = []
+    for batch in batches:
+        params, mu, nu, step, loss = train_step(params, mu, nu, step, batch)
+        losses.append(float(loss))
+    return losses
+
+
+def moe_rngs(step, seed=SEED):
+    """The engine's per-micro-step rng protocol (engine._next_rng +
+    _compute_loss): rng = fold_in(PRNGKey(seed), micro_step);
+    gating = fold_in(rng, 7). gas=1 -> micro_step == step."""
+    rng = jax.random.fold_in(jax.random.PRNGKey(seed), step)
+    return {"dropout": rng, "gating": jax.random.fold_in(rng, 7)}
+
+
+def golden_curve_moe(steps=20):
+    """Tiny MoE-GPT2 (4 experts, top-1, RTS) + hand-rolled Adam."""
+    model = GPT2LMHeadModel(GPT2Config(**TINY_MOE))
+    return _hand_adam_curve(model, make_batches(steps), rngs_fn=moe_rngs)
+
+
+def golden_curve_3d(steps=20):
+    """Tiny GPT-2 with pp_stages=2 (the SPMD GPipe program) + hand-rolled
+    Adam. Single-device math: the pipe constraint no-ops off-mesh, so the
+    same curve must emerge from any pp x dp x ZeRO-1 mesh layout."""
+    model = GPT2LMHeadModel(GPT2Config(**TINY_3D))
+    return _hand_adam_curve(model, make_batches(steps))

@@ -172,3 +172,130 @@ def test_cifar_cnn_zero0_fp32_matches_oracle():
     np.testing.assert_allclose(engine_losses, oracle_losses, rtol=1e-4,
                                atol=1e-4)
     assert engine_losses[-1] < engine_losses[0]
+
+
+# ---------------------------------------------------------------------------
+# BASELINE.json configs #3/#4/#5 (VERDICT r2 weak #7)
+# ---------------------------------------------------------------------------
+
+def _golden_named(name):
+    with open(os.path.join(os.path.dirname(__file__), "baselines",
+                           name)) as f:
+        return json.load(f)["losses"]
+
+
+class TestBertLamb:
+    """Config #3: tiny BERT + (Fused)Lamb vs the hand-rolled LAMB oracle."""
+
+    def _run(self, fused, n_devices=None, **over):
+        from deepspeed_tpu.models.bert import BertConfig, BertForPreTraining
+        groups.destroy()
+        import jax
+        devs = jax.devices()[:n_devices] if n_devices else None
+        groups.initialize(devices=devs)
+        dp = groups.get_data_parallel_world_size()
+        cfg = {
+            "train_batch_size": oracle.BATCH_SIZE,
+            "train_micro_batch_size_per_gpu": oracle.BATCH_SIZE // dp,
+            "steps_per_print": 10 ** 9,
+            "optimizer": {"type": "Lamb",
+                          "params": {"lr": oracle.LAMB_LR, "fused": fused}},
+        }
+        cfg.update(over)
+        batches = oracle.make_bert_batches(20)
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=BertForPreTraining(BertConfig(**oracle.TINY_BERT)),
+            config=cfg, sample_batch=batches[0], seed=oracle.SEED)
+        return [float(engine.train_batch(batch=b)) for b in batches]
+
+    def test_lamb_matches_golden(self):
+        losses = self._run(fused=False)
+        np.testing.assert_allclose(losses, _golden_named(
+            "bert_tiny_fp32_lamb.json"), rtol=1e-4, atol=1e-4)
+
+    def test_fused_lamb_matches_golden(self):
+        losses = self._run(fused=True)
+        np.testing.assert_allclose(losses, _golden_named(
+            "bert_tiny_fp32_lamb.json"), rtol=1e-4, atol=1e-4)
+
+    def test_lamb_zero1_matches_golden(self):
+        losses = self._run(fused=False,
+                           zero_optimization={"stage": 1})
+        np.testing.assert_allclose(losses, _golden_named(
+            "bert_tiny_fp32_lamb.json"), rtol=1e-4, atol=1e-4)
+
+
+class TestMoEGpt:
+    """Config #4: tiny MoE-GPT2 (4 experts, top-1, RTS) vs the oracle with
+    the engine rng protocol."""
+
+    def _run(self, ep_size, n_devices=None):
+        import jax
+        from deepspeed_tpu.moe.layer import moe_sharding_rules
+        from deepspeed_tpu.runtime.zero.partition import ModelParallelRules
+        groups.destroy()
+        devs = jax.devices()[:n_devices] if n_devices else None
+        groups.initialize(ep_size=ep_size, devices=devs)
+        dp = groups.get_data_parallel_world_size()
+        cfg = {
+            "train_batch_size": oracle.BATCH_SIZE,
+            "train_micro_batch_size_per_gpu": oracle.BATCH_SIZE // dp,
+            "steps_per_print": 10 ** 9,
+            "optimizer": {"type": "Adam", "params": {"lr": oracle.LR}},
+        }
+        batches = oracle.make_batches(20)
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=GPT2LMHeadModel(GPT2Config(**oracle.TINY_MOE)),
+            config=cfg, sample_batch=batches[0], seed=oracle.SEED,
+            mp_rules=ModelParallelRules(moe_sharding_rules()))
+        return [float(engine.train_batch(batch=b)) for b in batches]
+
+    def test_moe_matches_golden(self):
+        losses = self._run(ep_size=1, n_devices=1)
+        np.testing.assert_allclose(losses, _golden_named(
+            "gpt2_moe_tiny_fp32_adam.json"), rtol=1e-4, atol=1e-4)
+
+    def test_moe_ep4_matches_golden(self):
+        """Expert-parallel (ep=4 over the dp dim): same math, sharded
+        experts + all-to-all."""
+        losses = self._run(ep_size=4)
+        np.testing.assert_allclose(losses, _golden_named(
+            "gpt2_moe_tiny_fp32_adam.json"), rtol=1e-4, atol=1e-4)
+
+
+class Test3DPipe:
+    """Config #5: tiny GPT-2 with pp_stages=2 over pipe x data (ZeRO-1)
+    vs the single-device oracle on the same GPipe program."""
+
+    def _run(self, pp_size, zero_stage, n_devices=8):
+        import jax
+        from deepspeed_tpu.models.gpt2 import gpt2_pp_rules
+        from deepspeed_tpu.runtime.zero.partition import ModelParallelRules
+        groups.destroy()
+        groups.initialize(pp_size=pp_size,
+                          devices=jax.devices()[:n_devices])
+        dp = groups.get_data_parallel_world_size()
+        cfg = {
+            "train_batch_size": oracle.BATCH_SIZE,
+            "train_micro_batch_size_per_gpu": oracle.BATCH_SIZE // dp,
+            "steps_per_print": 10 ** 9,
+            "optimizer": {"type": "Adam", "params": {"lr": oracle.LR}},
+            "zero_optimization": {"stage": zero_stage},
+        }
+        batches = oracle.make_batches(20)
+        rules = ModelParallelRules(gpt2_pp_rules())
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=GPT2LMHeadModel(GPT2Config(**oracle.TINY_3D)),
+            config=cfg, sample_batch=batches[0], seed=oracle.SEED,
+            mp_rules=rules)
+        return [float(engine.train_batch(batch=b)) for b in batches]
+
+    def test_pp2_dp4_zero1_matches_golden(self):
+        losses = self._run(pp_size=2, zero_stage=1)
+        np.testing.assert_allclose(losses, _golden_named(
+            "gpt2_pp2_tiny_fp32_adam.json"), rtol=1e-4, atol=1e-4)
+
+    def test_pp2_dp4_zero0_matches_golden(self):
+        losses = self._run(pp_size=2, zero_stage=0)
+        np.testing.assert_allclose(losses, _golden_named(
+            "gpt2_pp2_tiny_fp32_adam.json"), rtol=1e-4, atol=1e-4)

@@ -217,8 +217,12 @@ def test_gridsearch_visits_all_stages(tmp_path):
     from deepspeed_tpu.autotuning.autotuner import Autotuner
     calls = []
 
-    perf = {(0, 1): 50.0, (0, 2): 40.0, (0, 4): 30.0, (0, 8): 20.0,
-            (1, 1): 60.0, (1, 2): 80.0, (1, 4): 70.0, (1, 8): 65.0}
+    # measured tput ~ micro_batch * perf[key] (bigger batch / same sleep),
+    # so stage 0 REGRESSES twice after micro=1 (50 -> 40 -> 20): early
+    # stop must skip (0, 8) yet still explore stage 1, whose micro=2 is
+    # the global best (90)
+    perf = {(0, 1): 50.0, (0, 2): 20.0, (0, 4): 5.0, (0, 8): 2.0,
+            (1, 1): 60.0, (1, 2): 45.0, (1, 4): 10.0, (1, 8): 5.0}
 
     class FakeEngine:
         def __init__(self, cfg):
@@ -249,4 +253,9 @@ def test_gridsearch_visits_all_stages(tmp_path):
     best = at.tune()
     stages_tried = {s for s, _ in calls}
     assert stages_tried == {0, 1}, calls
+    # early stop actually skipped the tail of stage 0...
+    assert (0, 8) not in calls, calls
+    # ...but stage 1 was fully explored up to ITS early stop
+    assert (1, 2) in calls, calls
     assert best["zero_optimization"]["stage"] == 1
+    assert best["train_micro_batch_size_per_gpu"] == 2
