@@ -60,13 +60,6 @@ def _retry(fn, what, attempts=4, sleep_s=10.0):
             time.sleep(sleep_s)
 
 
-def model_flops_per_token(cfg, seq_len):
-    """6*N_active + attention term, the standard training-FLOPs model."""
-    n = cfg.num_params()
-    # 6ND for matmuls + 12*L*E*S for attention scores/values
-    return 6 * n + 12 * cfg.n_layer * cfg.n_embd * seq_len
-
-
 def main():
     _enable_compile_cache()
 
@@ -80,6 +73,9 @@ def main():
         "gpt2": (16, 1024, 20, 0),          # 125M
         "gpt2-medium": (8, 1024, 10, 1),    # 350M
         "gpt2-xl": (4, 1024, 5, 3),         # 1.5B: needs ZeRO-3 (+offload)
+        # the reference's 64-TFLOPS headline config: BERT-large MLM,
+        # seq 128, (Fused)Lamb (docs/_tutorials/bert-pretraining.md:387)
+        "bert-large": (64, 128, 20, 0),
     }
     on_tpu = jax.default_backend() == "tpu"
     peak_tflops = float(os.environ.get("BENCH_PEAK_TFLOPS", "197"))
@@ -91,15 +87,35 @@ def main():
         if name not in bench_shapes:
             raise SystemExit(f"BENCH_MODEL must be one of "
                              f"{sorted(bench_shapes)}, got {name!r}")
-        cfg = PRESETS[name]
         batch_size, seq_len, steps, default_zero = bench_shapes[name]
         zero_stage = int(os.environ.get("BENCH_ZERO", str(default_zero)))
+        batch_size = int(os.environ.get("BENCH_BS", str(batch_size)))
     else:  # CPU smoke fallback so the bench always emits a line
         name = "gpt2-toy"
-        cfg = GPT2Config(vocab_size=2048, n_positions=256, n_embd=128,
-                         n_layer=2, n_head=4)
         batch_size, seq_len, steps = 2, 128, 3
         zero_stage = 0
+
+    if name == "bert-large":
+        from deepspeed_tpu.models.bert import (PRESETS as BERT_PRESETS,
+                                               BertForPreTraining,
+                                               synthetic_mlm_batch)
+        cfg = BERT_PRESETS["bert-large"]
+        model = BertForPreTraining(cfg)
+        optimizer = {"type": "Lamb", "params": {"lr": 1e-4, "fused": True}}
+
+        def make_batch(seed):
+            return synthetic_mlm_batch(batch_size, seq_len, cfg.vocab_size,
+                                       seed=seed)
+    else:
+        cfg = (PRESETS[name] if name in PRESETS else
+               GPT2Config(vocab_size=2048, n_positions=256, n_embd=128,
+                          n_layer=2, n_head=4))
+        model = GPT2LMHeadModel(cfg)
+        optimizer = {"type": "Adam", "params": {"lr": 1e-4}}
+
+        def make_batch(seed):
+            return synthetic_batch(batch_size, seq_len, cfg.vocab_size,
+                                   seed=seed)
 
     groups.destroy()
     groups.initialize()
@@ -108,7 +124,7 @@ def main():
         "train_micro_batch_size_per_gpu": batch_size // max(
             1, groups.get_data_parallel_world_size()),
         "steps_per_print": 10 ** 9,
-        "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+        "optimizer": optimizer,
         "zero_optimization": {"stage": zero_stage},
         "bf16": {"enabled": True},
     }
@@ -117,11 +133,12 @@ def main():
 
     engine, _, _, _ = _retry(
         lambda: deepspeed_tpu.initialize(
-            model=GPT2LMHeadModel(cfg), config=ds_config,
-            sample_batch=synthetic_batch(batch_size, seq_len, cfg.vocab_size)),
+            model=model, config=ds_config,
+            sample_batch=make_batch(0)),
         "engine init")
+    n_params = sum(x.size for x in jax.tree.leaves(engine.state.params))
 
-    batch = synthetic_batch(batch_size, seq_len, cfg.vocab_size, seed=1)
+    batch = make_batch(1)
 
     # jax.block_until_ready is NOT a reliable barrier through the axon
     # tunnel (it returned immediately in round 3, inflating TFLOPS 5x);
@@ -143,7 +160,10 @@ def main():
     dt = time.perf_counter() - t0
 
     tokens_per_s = batch_size * seq_len * steps / dt
-    tflops = tokens_per_s * model_flops_per_token(cfg, seq_len) / 1e12
+    n_layer = getattr(cfg, "n_layer", getattr(cfg, "num_hidden_layers", 0))
+    width = getattr(cfg, "n_embd", getattr(cfg, "hidden_size", 0))
+    flops_per_token = 6 * n_params + 12 * n_layer * width * seq_len
+    tflops = tokens_per_s * flops_per_token / 1e12
     n_chips = jax.device_count()
     tflops_per_chip = tflops / n_chips
 

@@ -97,13 +97,27 @@ def initialize(args=None,
             if _off["device"] == "nvme":
                 assert _off.get("nvme_path"), (
                     "offload_param.device='nvme' requires nvme_path")
+            # fail LOUDLY on config keys this engine does not implement
+            # (ADVICE r2: silently dropping them trains differently than
+            # the reference JSON asks for)
+            _unsupported = []
+            if (_cfg_dict.get("scheduler", {}) or {}).get("type"):
+                _unsupported.append("scheduler")
+            if _cfg_dict.get("gradient_clipping", 0):
+                _unsupported.append("gradient_clipping")
+            if (_cfg_dict.get("fp16", {}) or {}).get("enabled"):
+                _unsupported.append(
+                    "fp16 dynamic loss scaling (bf16 is supported)")
+            if _unsupported:
+                raise DeepSpeedConfigError(
+                    "the layered Zero3OffloadEngine does not implement: "
+                    + ", ".join(_unsupported)
+                    + "; remove these keys or use the monolithic engine "
+                    "(offload_optimizer instead of offload_param)")
             opt_params = _opt_cfg.get("params", {})
-            if (_cfg_dict.get("bf16", {}) or {}).get("enabled"):
-                _dtype = jnp.bfloat16
-            elif (_cfg_dict.get("fp16", {}) or {}).get("enabled"):
-                _dtype = jnp.float16
-            else:
-                _dtype = jnp.float32
+            _dtype = (jnp.bfloat16
+                      if (_cfg_dict.get("bf16", {}) or {}).get("enabled")
+                      else jnp.float32)
             engine = Zero3OffloadEngine(
                 model, kwargs["sample_batch"],
                 lr=opt_params.get("lr", 1e-3),

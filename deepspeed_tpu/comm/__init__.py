@@ -56,9 +56,19 @@ def init_distributed(dist_backend="xla",
     if _INITIALIZED:
         return
 
-    coordinator = coordinator_address or os.environ.get("DS_COORDINATOR_ADDRESS")
-    nprocs = num_processes if num_processes is not None else os.environ.get("DS_NUM_PROCESSES")
-    pid = process_id if process_id is not None else os.environ.get("DS_PROCESS_ID")
+    def env(*names):
+        for n in names:
+            if os.environ.get(n) is not None:
+                return os.environ[n]
+        return None
+
+    # DS_* set directly; JAX_* exported by the launcher (runner.py)
+    coordinator = coordinator_address or env("DS_COORDINATOR_ADDRESS",
+                                             "JAX_COORDINATOR_ADDRESS")
+    nprocs = num_processes if num_processes is not None else \
+        env("DS_NUM_PROCESSES", "JAX_PROCESS_COUNT")
+    pid = process_id if process_id is not None else \
+        env("DS_PROCESS_ID", "JAX_PROCESS_ID")
 
     if coordinator is not None and nprocs is not None and pid is not None:
         if verbose:

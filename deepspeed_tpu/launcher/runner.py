@@ -51,10 +51,13 @@ def parse_args(args=None):
                         dest="num_gpus")
     parser.add_argument("--master_port", type=int, default=29500)
     parser.add_argument("--master_addr", type=str, default="")
-    parser.add_argument("--launcher", type=str, default="local",
+    parser.add_argument("--launcher", type=str, default=None,
                         choices=["local", "ssh", "print"],
-                        help="local: run here; ssh: pdsh-style remote "
-                             "launch; print: emit the per-host commands")
+                        help="local: run here (multi-node hostfiles spawn "
+                             "every slot on THIS machine — explicit opt-in "
+                             "only); ssh: pdsh-style remote launch; print: "
+                             "emit the per-host commands. Default: local "
+                             "for single-node, error for multi-node.")
     parser.add_argument("--force_multi", action="store_true")
     parser.add_argument("user_script", type=str)
     parser.add_argument("user_args", nargs=argparse.REMAINDER)
@@ -166,15 +169,32 @@ def main(args=None):
         result.wait()
         sys.exit(result.returncode)
 
+    if args.launcher is None:
+        # fail fast: spawning a multi-node hostfile's workers on the
+        # driver by default would overload it and hang the rendezvous
+        raise ValueError(
+            "multi-node run needs an explicit --launcher: 'ssh' (remote "
+            "fan-out), 'print' (emit per-host commands), or 'local' "
+            "(spawn every slot on THIS machine — testing/multi-process "
+            "single host; pass --master_addr 127.0.0.1)")
+
     hosts = list(resource_pool.keys())
+    if args.launcher == "local":
+        # one jax process per SLOT, all on this machine
+        workers = [(host, slot) for host, slots in resource_pool.items()
+                   for slot in range(slots)]
+    else:
+        # one jax process per HOST (the TPU-pod topology: a host drives
+        # all its local chips)
+        workers = [(host, 0) for host in hosts]
     master = args.master_addr or hosts[0]
     env_base = {
         "JAX_COORDINATOR_ADDRESS": f"{master}:{args.master_port}",
-        "JAX_PROCESS_COUNT": str(len(hosts)),
+        "JAX_PROCESS_COUNT": str(len(workers)),
         "DS_WORLD_INFO": encode_world_info(resource_pool),
     }
     procs = []
-    for idx, host in enumerate(hosts):
+    for idx, (host, slot) in enumerate(workers):
         env = dict(env_base, JAX_PROCESS_ID=str(idx))
         envs = " ".join(f"{k}={v}" for k, v in env.items())
         remote = (f"{envs} {sys.executable} {args.user_script} "
@@ -183,9 +203,10 @@ def main(args=None):
             print(f"[{host}] {remote}")
         elif args.launcher == "ssh":
             procs.append(subprocess.Popen(["ssh", host, remote]))
-        else:
-            raise ValueError(
-                "multi-node with --launcher local; use ssh or print")
+        else:  # local
+            procs.append(subprocess.Popen(
+                [sys.executable, args.user_script] + args.user_args,
+                env=dict(os.environ, **env)))
     rc = 0
     for p in procs:
         p.wait()

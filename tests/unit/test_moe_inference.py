@@ -138,3 +138,40 @@ def test_moe_forward_all_to_all_on_mesh():
         lowered = eng._jit_forward.lower(eng.params, batch)
     text = lowered.compile().as_text()
     assert ("all-to-all" in text) or ("all-to-all" in text.replace("_", "-"))
+
+
+def test_deepspeed_moe_inference_layer_decode():
+    """The reference-named DeepSpeedMoEInference layer (API parity with
+    ops/transformer/inference/moe_inference.py) runs prefill + cached
+    one-token decode steps and matches the full-sequence forward."""
+    from deepspeed_tpu.ops.transformer.moe_inference import (
+        DeepSpeedMoEInference, DeepSpeedMoEInferenceConfig)
+
+    # drop_tokens=False: capacity = token count per call, so no token is
+    # ever dropped and the stepped decode must match the full forward
+    # exactly (with dropping, capacity varies with the call's S)
+    cfg = DeepSpeedMoEInferenceConfig(hidden_size=32, heads=4,
+                                      num_experts=4, drop_tokens=False,
+                                      use_flash=False)
+    layer = DeepSpeedMoEInference(cfg)
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.normal(jax.random.fold_in(rng, 1), (2, 6, 32),
+                          jnp.float32)
+
+    params = layer.init(rng, x)["params"]
+    full = layer.apply({"params": params}, x)            # no cache
+
+    # prefill on the first 4 positions, then decode 2 single tokens
+    out_pre, state = layer.apply({"params": params}, x[:, :4], decode=True,
+                                 mutable=["cache"])
+    outs = [out_pre]
+    cache = state["cache"]
+    for t in range(4, 6):
+        out_t, state = layer.apply({"params": params, "cache": cache},
+                                   x[:, t:t + 1], decode=True,
+                                   mutable=["cache"])
+        cache = state["cache"]
+        outs.append(out_t)
+    stepped = jnp.concatenate(outs, axis=1)
+    np.testing.assert_allclose(np.asarray(stepped), np.asarray(full),
+                               rtol=2e-4, atol=2e-4)

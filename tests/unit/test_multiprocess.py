@@ -69,3 +69,27 @@ def test_two_process_train_checkpoint(tmp_path):
     # training made progress and survived the checkpoint roundtrip
     assert l0[-1] < l0[0]
     assert (tmp_path / "ck" / "mp").exists()
+
+
+def test_launcher_driven_two_process(tmp_path):
+    """The `deepspeed` runner's multi-node path drives the same 2-process
+    rendezvous end-to-end (hostfile -> JAX_* env fan-out -> worker
+    jax.distributed init), with --launcher local keeping both workers on
+    this machine (reference launcher/runner.py multi-node flow)."""
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("worker-1 slots=1\nworker-2 slots=1\n")
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("PYTEST_CURRENT_TEST", None)
+    env["DS_REPO"] = REPO
+    proc = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.launcher.runner",
+         "--hostfile", str(hostfile), "--launcher", "local",
+         "--master_addr", "127.0.0.1", "--master_port", str(port),
+         WORKER, str(tmp_path)],
+        env=env, capture_output=True, text=True, timeout=480,
+        cwd=REPO)
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    l0 = json.load(open(tmp_path / "losses_0.json"))
+    l1 = json.load(open(tmp_path / "losses_1.json"))
+    assert l0 == l1 and len(l0) == 4

@@ -152,3 +152,27 @@ def test_file_checkpoint_roundtrip(tmp_path):
     assert client == {"epoch": 1}
     resumed = [float(fresh.train_batch(_batch(s + 70))) for s in range(2)]
     np.testing.assert_allclose(resumed, cont, rtol=1e-6)
+
+
+def test_offload_engine_rejects_unimplemented_config_keys():
+    """ADVICE r2: config keys the layered engine does not implement must
+    fail loudly, not silently change training behavior."""
+    import flax.linen as nn
+    import jax.numpy as jnp
+    import pytest
+
+    import deepspeed_tpu
+    from deepspeed_tpu.runtime.config import DeepSpeedConfigError
+
+    layers = [nn.Dense(8), lambda x, batch: jnp.mean((x - batch[1]) ** 2)]
+    cfg = {
+        "train_batch_size": 4,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 3,
+                              "offload_param": {"device": "cpu"}},
+        "scheduler": {"type": "WarmupLR", "params": {}},
+    }
+    with pytest.raises(DeepSpeedConfigError, match="scheduler"):
+        deepspeed_tpu.initialize(
+            model=layers, config=cfg,
+            sample_batch=(jnp.zeros((4, 8)), jnp.zeros((4, 8))))
