@@ -108,6 +108,8 @@ def initialize(args=None,
             if (_cfg_dict.get("fp16", {}) or {}).get("enabled"):
                 _unsupported.append(
                     "fp16 dynamic loss scaling (bf16 is supported)")
+            if _cfg_dict.get("sparse_gradients"):
+                _unsupported.append("sparse_gradients")
             if _unsupported:
                 raise DeepSpeedConfigError(
                     "the layered Zero3OffloadEngine does not implement: "
@@ -238,3 +240,21 @@ def add_config_arguments(parser):
     group.add_argument("--deepspeed_mpi", default=False, action="store_true",
                        help="Run via MPI")
     return parser
+
+
+# public module aliases (reference: deepspeed.zero, deepspeed.checkpointing)
+from deepspeed_tpu import zero  # noqa: E402,F401
+from deepspeed_tpu.runtime.activation_checkpointing import \
+    checkpointing  # noqa: E402,F401
+
+# top-level class exports (reference deepspeed/__init__.py:16-25)
+from deepspeed_tpu.runtime.pipe.engine import PipelineEngine  # noqa: E402,F401
+from deepspeed_tpu.runtime.pipe.module import (  # noqa: E402,F401
+    LayerSpec, PipelineModule, TiedLayerSpec)
+from deepspeed_tpu.inference.engine import InferenceEngine  # noqa: E402,F401
+from deepspeed_tpu.ops.transformer.transformer import (  # noqa: E402,F401
+    DeepSpeedTransformerConfig, DeepSpeedTransformerLayer)
+from deepspeed_tpu.module_inject import (  # noqa: E402,F401
+    replace_transformer_layer, revert_transformer_layer)
+from deepspeed_tpu.runtime.lr_schedules import (  # noqa: E402,F401
+    add_tuning_arguments)

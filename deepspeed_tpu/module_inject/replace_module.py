@@ -124,6 +124,36 @@ def replace_transformer_layer(orig_layer_impl, model, policy=None,
     return replace_module(model, policies=[policy] if policy else None)
 
 
+class _RevertPolicy(ReplacePolicy):
+    """Inverse of BertLayerPolicy: fused layer -> original layer class."""
+
+    def __init__(self, orig_layer_impl, preln=False):
+        from deepspeed_tpu.ops.transformer.transformer import \
+            DeepSpeedTransformerLayer
+        self.source_class = DeepSpeedTransformerLayer
+        self.orig_layer_impl = orig_layer_impl
+        self.preln = preln
+
+    def replacement(self, module):
+        c = module.config
+        return self.orig_layer_impl(
+            hidden_size=c.hidden_size,
+            num_heads=c.heads,
+            intermediate_size=c.intermediate,   # resolved (-1 -> 4*hidden)
+            pre_layer_norm=self.preln or c.pre_layer_norm)
+
+
+def revert_transformer_layer(orig_layer_impl, model, config=None,
+                             preln=False):
+    """Swap fused ``DeepSpeedTransformerLayer`` modules back to the
+    original layer class (reference replace_module.py:583), reusing the
+    replace_module tree walker. The fused layer's params live under the
+    same structure the wrapped original used, so re-initialised trees
+    remain checkpoint-compatible."""
+    return replace_module(model,
+                          policies=[_RevertPolicy(orig_layer_impl, preln)])
+
+
 def tensor_slicing_rules(policies=None):
     """Collect the TP PartitionSpec rules from all policies — the
     declarative form of ReplaceWithTensorSlicing (reference :41)."""

@@ -32,6 +32,7 @@ class DeepSpeedMoEInferenceConfig:
     drop_tokens: bool = True
     use_rts: bool = True
     moe_type: str = "standard"     # "residual" = MoS residual MoE
+    max_out_tokens: int = 2048     # KV-cache ceiling (reference knob)
     epsilon: float = 1e-5
     n_layer_for_init: int = 12     # proj init scale denominator
     kv_cache_dtype: str = "auto"
@@ -54,7 +55,8 @@ class DeepSpeedMoEInference(nn.Module):
         # the attention block reuses the flagship implementation; only the
         # fields it reads are populated
         attn_cfg = GPT2Config(
-            vocab_size=1, n_positions=2048, n_embd=cfg.hidden_size,
+            vocab_size=1, n_positions=cfg.max_out_tokens,
+            n_embd=cfg.hidden_size,
             n_layer=cfg.n_layer_for_init, n_head=cfg.heads,
             kv_cache_dtype=cfg.kv_cache_dtype, use_flash=cfg.use_flash)
         x = x + CausalSelfAttention(attn_cfg, name="attn")(

@@ -1,0 +1,68 @@
+"""Public ``deepspeed_tpu.zero`` surface (reference ``deepspeed.zero``:
+``Init`` at runtime/zero/partition_parameters.py:548, ``GatheredParameters``
+:1522, plus the config/estimator helpers).
+
+On TPU, parameters are born sharded DECLARATIVELY: the engine jits its
+state constructor with ZeRO out_shardings (runtime/engine.py), so there is
+no construction-time monkey-patching to do. ``Init`` therefore validates
+its arguments and records the offload intent (the ``remote_device``
+cpu/nvme path is the layered ``Zero3OffloadEngine``, selected by the
+``zero_optimization.offload_param`` config block); ``GatheredParameters``
+does real work — it materialises fully-gathered host copies of sharded
+``jax.Array`` trees, the analogue of the reference's allgather context.
+"""
+
+import contextlib
+
+import jax
+
+from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig  # noqa: F401
+from deepspeed_tpu.runtime.zero.partition import (  # noqa: F401
+    ModelParallelRules, build_opt_shardings, build_param_shardings,
+    estimate_zero_mem)
+from deepspeed_tpu.runtime.zero.param_offload import (  # noqa: F401
+    HostParamStore, Zero3OffloadEngine)
+from deepspeed_tpu.runtime.zero.tiling import TiledLinear  # noqa: F401
+from deepspeed_tpu.utils.logging import logger
+
+
+class Init:
+    """reference zero.Init context-manager surface. Under XLA the param
+    partitioning the reference performs imperatively happens at state
+    construction (declarative shardings), so entering the context is a
+    no-op; a cpu/nvme ``remote_device`` points at the layered offload
+    engine, which `initialize()` selects from the config."""
+
+    def __init__(self, module=None, data_parallel_group=None,
+                 mem_efficient_linear=True, remote_device=None,
+                 pin_memory=False, config=None, enabled=True,
+                 dtype=None, mpu=None):
+        self.remote_device = remote_device
+        self.enabled = enabled
+        if enabled and remote_device in ("cpu", "nvme"):
+            logger.info(
+                f"zero.Init(remote_device={remote_device!r}): pass "
+                "zero_optimization.offload_param.device in the config and "
+                "a layered model to initialize() — the Zero3OffloadEngine "
+                "streams layers from host/NVMe (param_offload.py)")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+@contextlib.contextmanager
+def GatheredParameters(params, modifier_rank=None, fwd_module=None,
+                       enabled=True):
+    """Yield fully-gathered HOST copies of a (possibly sharded) param tree
+    (reference partition_parameters.py:1522). jax.device_get resolves
+    every shard regardless of its placement; mutations inside the context
+    do NOT write back (the reference only writes back from modifier_rank
+    on exit — in the declarative model updates go through the engine's
+    state, so this context is read-only by design)."""
+    if not enabled:
+        yield params
+        return
+    yield jax.device_get(params)

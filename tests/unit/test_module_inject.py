@@ -69,3 +69,25 @@ def test_bert_policy_tp_rules_cover_attention_and_mlp():
     rules = BertLayerPolicy().tp_rules()
     patterns = " ".join(r[0] for r in rules)
     assert "query" in patterns or "qkv" in patterns or "attn" in patterns
+
+
+def test_revert_transformer_layer_roundtrip():
+    """replace -> revert restores the original layer class with matching
+    geometry (reference replace_module.py:583)."""
+    from deepspeed_tpu.module_inject import revert_transformer_layer
+
+    layer = bert.BertLayer(hidden_size=64, num_heads=4,
+                           intermediate_size=256)
+    model = _Wrapper(layer=layer)
+    swapped = replace_module(model)
+    assert isinstance(swapped.layer, DeepSpeedTransformerLayer)
+    reverted = revert_transformer_layer(bert.BertLayer, swapped)
+    assert isinstance(reverted.layer, bert.BertLayer)
+    assert reverted.layer.hidden_size == 64
+    assert reverted.layer.num_heads == 4
+    assert reverted.layer.intermediate_size == 256
+    # reverted model runs forward
+    x = jnp.ones((2, 8, 64))
+    params = reverted.init(jax.random.PRNGKey(0), x)
+    out = reverted.apply(params, x)
+    assert out.shape == x.shape
