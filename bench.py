@@ -117,6 +117,15 @@ def main():
             return synthetic_batch(batch_size, seq_len, cfg.vocab_size,
                                    seed=seed)
 
+    n_layer = getattr(cfg, "n_layer", None) or \
+        getattr(cfg, "num_hidden_layers", None)
+    width = getattr(cfg, "n_embd", None) or getattr(cfg, "hidden_size", None)
+    if not n_layer or not width:
+        raise SystemExit(
+            f"bench: config {type(cfg).__name__} exposes neither "
+            "n_layer/n_embd nor num_hidden_layers/hidden_size; the "
+            "attention FLOPs term would silently vanish")
+
     groups.destroy()
     groups.initialize()
     ds_config = {
@@ -160,14 +169,6 @@ def main():
     dt = time.perf_counter() - t0
 
     tokens_per_s = batch_size * seq_len * steps / dt
-    n_layer = getattr(cfg, "n_layer", None) or \
-        getattr(cfg, "num_hidden_layers", None)
-    width = getattr(cfg, "n_embd", None) or getattr(cfg, "hidden_size", None)
-    if not n_layer or not width:
-        raise SystemExit(
-            f"bench: config {type(cfg).__name__} exposes neither "
-            "n_layer/n_embd nor num_hidden_layers/hidden_size; the "
-            "attention FLOPs term would silently vanish")
     flops_per_token = 6 * n_params + 12 * n_layer * width * seq_len
     tflops = tokens_per_s * flops_per_token / 1e12
     n_chips = jax.device_count()

@@ -216,7 +216,6 @@ def init_inference(model, mp_size=1, mpu=None, checkpoint=None, dtype=None,
                    replace_with_kernel_inject=False, **kwargs):
     """Create an inference engine (reference: deepspeed.init_inference,
     deepspeed/__init__.py:220)."""
-    from deepspeed_tpu.inference.engine import InferenceEngine
     return InferenceEngine(model, mp_size=mp_size, mpu=mpu,
                            checkpoint=checkpoint, dtype=dtype,
                            injection_dict=injection_policy,

@@ -127,14 +127,19 @@ def replace_transformer_layer(orig_layer_impl, model, policy=None,
 class _RevertPolicy(ReplacePolicy):
     """Inverse of BertLayerPolicy: fused layer -> original layer class."""
 
-    def __init__(self, orig_layer_impl, preln=False):
+    def __init__(self, orig_layer_impl, preln=False, config=None):
         from deepspeed_tpu.ops.transformer.transformer import \
             DeepSpeedTransformerLayer
         self.source_class = DeepSpeedTransformerLayer
         self.orig_layer_impl = orig_layer_impl
         self.preln = preln
+        self.config = config
 
     def replacement(self, module):
+        if self.config is not None:
+            # reference pattern: the original layer takes one config
+            # object (replace_module.py:595 orig_layer_impl(config))
+            return self.orig_layer_impl(self.config)
         c = module.config
         return self.orig_layer_impl(
             hidden_size=c.hidden_size,
@@ -150,8 +155,8 @@ def revert_transformer_layer(orig_layer_impl, model, config=None,
     replace_module tree walker. The fused layer's params live under the
     same structure the wrapped original used, so re-initialised trees
     remain checkpoint-compatible."""
-    return replace_module(model,
-                          policies=[_RevertPolicy(orig_layer_impl, preln)])
+    return replace_module(
+        model, policies=[_RevertPolicy(orig_layer_impl, preln, config)])
 
 
 def tensor_slicing_rules(policies=None):

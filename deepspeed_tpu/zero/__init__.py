@@ -65,4 +65,9 @@ def GatheredParameters(params, modifier_rank=None, fwd_module=None,
     if not enabled:
         yield params
         return
+    if modifier_rank is not None:
+        logger.warning(
+            "GatheredParameters(modifier_rank=...): the yielded tree is a "
+            "detached host copy — mutations are NOT written back (update "
+            "weights through the engine state instead)")
     yield jax.device_get(params)

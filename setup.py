@@ -25,6 +25,7 @@ setup(
     entry_points={
         "console_scripts": [
             "deepspeed=deepspeed_tpu.launcher.runner:main",
+            "ds=deepspeed_tpu.launcher.runner:main",
             "ds_report=deepspeed_tpu.env_report:cli_main",
             "ds_elastic=deepspeed_tpu.elasticity.elastic_cli:main",
             "ds_ssh=deepspeed_tpu.launcher.ds_ssh:main",

@@ -1,0 +1,64 @@
+"""Inference decode benchmark — KV-cache generation throughput.
+
+The training bench (bench.py) covers the reference's training-kernel
+claims; this measures the inference side (the csrc/transformer/inference
+kernel surface): per-token latency of cached greedy decoding on one chip.
+
+Run on the TPU:  python tests/perf/decode_bench.py
+Env: DECODE_MODEL (gpt2|gpt2-medium), DECODE_BS, DECODE_PROMPT,
+DECODE_NEW (defaults 8 / 32 / 128 new tokens).
+Prints one JSON line: tokens/s and ms/token.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax
+import numpy as np
+
+
+def main():
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import PRESETS
+
+    name = os.environ.get("DECODE_MODEL", "gpt2-medium")
+    bs = int(os.environ.get("DECODE_BS", "8"))
+    prompt_len = int(os.environ.get("DECODE_PROMPT", "32"))
+    new_tokens = int(os.environ.get("DECODE_NEW", "128"))
+    cfg = PRESETS[name]
+
+    from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel
+    model = GPT2LMHeadModel(cfg)
+    import jax.numpy as jnp
+    ids = jnp.zeros((bs, prompt_len), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), {"input_ids": ids})["params"]
+    eng = deepspeed_tpu.init_inference(model, params=params)
+
+    prompt = jnp.asarray(np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (bs, prompt_len)), jnp.int32)
+
+    out = eng.generate(prompt, max_new_tokens=new_tokens)   # compile
+    jax.device_get(out[0, -1])
+    t0 = time.perf_counter()
+    reps = 3
+    for _ in range(reps):
+        out = eng.generate(prompt, max_new_tokens=new_tokens)
+    jax.device_get(out[0, -1])
+    dt = (time.perf_counter() - t0) / reps
+
+    total_new = bs * new_tokens
+    print(json.dumps({
+        "metric": f"{name} cached decode (bs={bs} prompt={prompt_len} "
+                  f"new={new_tokens}, bf16)",
+        "tokens_per_s": round(total_new / dt, 1),
+        "ms_per_token_step": round(dt / new_tokens * 1e3, 3),
+        "batch_latency_s": round(dt, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()
