@@ -101,3 +101,30 @@ def test_ds_ssh_single_string_shell_snippet(tmp_path, capfd):
                       "echo one two | tr ' ' '_'"])
     assert rc == 0
     assert "one_two" in capfd.readouterr().out
+
+
+def test_ds_report_runs():
+    """ds_report env/op report (reference bin/ds_report + env_report.py)."""
+    import io
+    from contextlib import redirect_stdout
+
+    from deepspeed_tpu import env_report
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        env_report.main()
+    out = buf.getvalue()
+    # op table mentions at least the adam + aio builders
+    assert "adam" in out.lower()
+    assert "async_io" in out.lower()
+
+
+def test_repeating_loader_cycles():
+    from deepspeed_tpu.runtime.dataloader import (DeepSpeedDataLoader,
+                                                  RepeatingLoader)
+    ds = [1, 2, 3, 4]
+    loader = DeepSpeedDataLoader(ds, batch_size=2)
+    rep = RepeatingLoader(loader)
+    got = [next(rep) for _ in range(5)]
+    assert len(got) == 5          # restarted past the 2-batch epoch
+    assert len(rep) == len(loader)
