@@ -37,6 +37,13 @@ class BertConfig:
     layer_norm_eps: float = 1e-12
     pre_layer_norm: bool = False       # classic BERT is post-LN
     remat: bool = False
+    # block-sparse attention (reference sparse_attention_utils.py
+    # replace_model_self_attention): None = dense fused layer; else one of
+    # fixed|variable|bigbird|bslongformer|dense with the block geometry
+    sparse_attention_mode: Optional[str] = None
+    sparse_block: int = 16
+    sparse_num_local_blocks: int = 4
+    sparse_num_global_blocks: int = 1
 
     @property
     def padded_vocab(self):
@@ -77,6 +84,69 @@ class BertLayer(nn.Module):
             x, mask, deterministic)
 
 
+class BertSparseLayer(nn.Module):
+    """Encoder layer whose self-attention is block-sparse — the model-side
+    substitution the reference performs with
+    sparse_attention_utils.replace_model_self_attention +
+    BertSparseSelfAttention. Classic post-LN arrangement."""
+    hidden_size: int
+    num_heads: int
+    intermediate_size: int
+    sparsity_mode: str = "fixed"
+    block: int = 16
+    num_local_blocks: int = 4
+    num_global_blocks: int = 1
+    layer_norm_eps: float = 1e-12
+    dropout: float = 0.0
+
+    def _sparsity_config(self):
+        from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
+            BigBirdSparsityConfig, BSLongformerSparsityConfig,
+            DenseSparsityConfig, FixedSparsityConfig,
+            VariableSparsityConfig)
+        mode = self.sparsity_mode
+        if mode == "fixed":
+            return FixedSparsityConfig(
+                num_heads=self.num_heads, block=self.block,
+                num_local_blocks=self.num_local_blocks,
+                num_global_blocks=self.num_global_blocks)
+        if mode == "bigbird":
+            return BigBirdSparsityConfig(num_heads=self.num_heads,
+                                         block=self.block)
+        if mode == "bslongformer":
+            return BSLongformerSparsityConfig(num_heads=self.num_heads,
+                                              block=self.block)
+        if mode == "variable":
+            return VariableSparsityConfig(num_heads=self.num_heads,
+                                          block=self.block)
+        if mode == "dense":
+            return DenseSparsityConfig(num_heads=self.num_heads,
+                                       block=self.block)
+        raise ValueError(f"unknown sparse attention mode {mode!r}")
+
+    @nn.compact
+    def __call__(self, x, mask=None, deterministic=True):
+        from deepspeed_tpu.ops.sparse_attention.sparse_self_attention import \
+            BertSparseSelfAttention
+        ctx = BertSparseSelfAttention(
+            hidden_size=self.hidden_size,
+            num_attention_heads=self.num_heads,
+            sparsity_config=self._sparsity_config(),
+            name="attention")(x, mask)
+        attn_out = nn.Dense(self.hidden_size, name="attn_out")(ctx)
+        if self.dropout > 0:
+            attn_out = nn.Dropout(self.dropout)(attn_out, deterministic)
+        x = nn.LayerNorm(epsilon=self.layer_norm_eps,
+                         name="attn_ln")(x + attn_out)
+        h = nn.Dense(self.intermediate_size, name="fc")(x)
+        h = nn.gelu(h, approximate=True)
+        h = nn.Dense(self.hidden_size, name="out")(h)
+        if self.dropout > 0:
+            h = nn.Dropout(self.dropout)(h, deterministic)
+        return nn.LayerNorm(epsilon=self.layer_norm_eps,
+                            name="out_ln")(x + h)
+
+
 class BertForPreTraining(nn.Module):
     """Embeddings + fused encoder stack + tied MLM head; returns the MLM
     cross-entropy (next-sentence head omitted — modern practice and the
@@ -110,18 +180,38 @@ class BertForPreTraining(nn.Module):
         if cfg.hidden_dropout_prob > 0:
             x = nn.Dropout(cfg.hidden_dropout_prob)(x, deterministic)
 
-        layer_cls = BertLayer
-        if cfg.remat:
-            layer_cls = nn.remat(BertLayer, static_argnums=(3,))
-        for i in range(cfg.num_hidden_layers):
-            x = layer_cls(hidden_size=cfg.hidden_size,
-                          num_heads=cfg.num_attention_heads,
-                          intermediate_size=cfg.intermediate_size,
-                          pre_layer_norm=cfg.pre_layer_norm,
-                          dropout=cfg.hidden_dropout_prob,
-                          attn_dropout=cfg.attention_probs_dropout_prob,
-                          layer_norm_eps=cfg.layer_norm_eps,
-                          name=f"layer_{i}")(x, mask, deterministic)
+        if cfg.sparse_attention_mode is not None:
+            assert cfg.attention_probs_dropout_prob == 0, (
+                "the block-sparse kernel has no attention-dropout input; "
+                "set attention_probs_dropout_prob=0 for sparse mode")
+            sparse_cls = BertSparseLayer
+            if cfg.remat:
+                sparse_cls = nn.remat(BertSparseLayer, static_argnums=(3,))
+            for i in range(cfg.num_hidden_layers):
+                x = sparse_cls(
+                    hidden_size=cfg.hidden_size,
+                    num_heads=cfg.num_attention_heads,
+                    intermediate_size=cfg.intermediate_size,
+                    sparsity_mode=cfg.sparse_attention_mode,
+                    block=cfg.sparse_block,
+                    num_local_blocks=cfg.sparse_num_local_blocks,
+                    num_global_blocks=cfg.sparse_num_global_blocks,
+                    layer_norm_eps=cfg.layer_norm_eps,
+                    dropout=cfg.hidden_dropout_prob,
+                    name=f"layer_{i}")(x, mask, deterministic)
+        else:
+            layer_cls = BertLayer
+            if cfg.remat:
+                layer_cls = nn.remat(BertLayer, static_argnums=(3,))
+            for i in range(cfg.num_hidden_layers):
+                x = layer_cls(hidden_size=cfg.hidden_size,
+                              num_heads=cfg.num_attention_heads,
+                              intermediate_size=cfg.intermediate_size,
+                              pre_layer_norm=cfg.pre_layer_norm,
+                              dropout=cfg.hidden_dropout_prob,
+                              attn_dropout=cfg.attention_probs_dropout_prob,
+                              layer_norm_eps=cfg.layer_norm_eps,
+                              name=f"layer_{i}")(x, mask, deterministic)
 
         # MLM transform + tied decoder (BertLMPredictionHead)
         h = nn.Dense(cfg.hidden_size, name="mlm_dense")(x)

@@ -19,8 +19,17 @@ from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
 _layout_cache = {}
 
 
+def _config_key(cfg: SparsityConfig):
+    """Content-based cache key: id()-keyed caching is unsafe when configs
+    are constructed per call (a freed id can be reused by a DIFFERENT
+    config, serving a stale layout)."""
+    return (type(cfg).__name__,
+            tuple(sorted((k, v) for k, v in vars(cfg).items()
+                         if isinstance(v, (int, float, str, bool)))))
+
+
 def get_layout(sparsity_config: SparsityConfig, seq_len: int):
-    key = (id(sparsity_config), seq_len)
+    key = (_config_key(sparsity_config), seq_len)
     if key not in _layout_cache:
         _layout_cache[key] = sparsity_config.make_layout(seq_len)
     return _layout_cache[key]
@@ -41,6 +50,13 @@ class SparseSelfAttention(nn.Module):
     def __call__(self, query, key, value, rpe=None, key_padding_mask=None,
                  attn_mask=None):
         assert query.dtype == key.dtype == value.dtype
+        if key_padding_mask is not None or attn_mask is not None:
+            # the Pallas kernel has no mask input yet; silently attending
+            # padding would be worse than failing
+            raise NotImplementedError(
+                "SparseSelfAttention: key_padding_mask/attn_mask are not "
+                "supported by the TPU block-sparse kernel; drop padding "
+                "host-side or use dense attention for padded batches")
         S = query.shape[2]
         cfg = self._config()
         layout = get_layout(cfg, S)
@@ -68,5 +84,6 @@ class BertSparseSelfAttention(nn.Module):
         v = v.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
         ctx = SparseSelfAttention(
             sparsity_config=self.sparsity_config or
-            FixedSparsityConfig(num_heads=nh), name="sparse_attn")(q, k, v)
+            FixedSparsityConfig(num_heads=nh), name="sparse_attn")(
+                q, k, v, key_padding_mask=attention_mask)
         return ctx.transpose(0, 2, 1, 3).reshape(B, S, H)

@@ -33,6 +33,10 @@ def main():
             {"model": oracle.TINY_MOE, "optimizer": "adam(0.9,0.999,1e-8)",
              "rngs": "engine protocol (fold_in(seed, step); gating=fold 7)"},
             lambda: oracle.golden_curve_moe(steps=20)),
+        "bert_sparse_tiny_fp32_adam.json": (
+            {"model": oracle.TINY_BERT_SPARSE,
+             "optimizer": "adam(0.9,0.999,1e-8)"},
+            lambda: oracle.golden_curve_bert_sparse_adam(steps=20)),
         "gpt2_pp2_tiny_fp32_adam.json": (
             {"model": oracle.TINY_3D, "optimizer": "adam(0.9,0.999,1e-8)"},
             lambda: oracle.golden_curve_3d(steps=20)),

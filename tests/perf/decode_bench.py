@@ -53,10 +53,14 @@ def main():
 
     dt = timed(new_tokens)
     # isolate steady-state decode: subtract a short-generation run so the
-    # amortised prefill cost drops out of the per-step figure
+    # amortised prefill cost drops out of the per-step figure (needs two
+    # distinct lengths; clamped non-negative against timing noise)
     short = max(1, new_tokens // 8)
-    dt_short = timed(short)
-    per_step_ms = (dt - dt_short) / (new_tokens - short) * 1e3
+    if short < new_tokens:
+        dt_short = timed(short)
+        per_step_ms = max(0.0, (dt - dt_short) / (new_tokens - short) * 1e3)
+    else:
+        per_step_ms = dt / new_tokens * 1e3
 
     total_new = bs * new_tokens
     print(json.dumps({
