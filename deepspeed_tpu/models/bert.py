@@ -128,19 +128,22 @@ class BertSparseLayer(nn.Module):
     def __call__(self, x, mask=None, deterministic=True):
         from deepspeed_tpu.ops.sparse_attention.sparse_self_attention import \
             BertSparseSelfAttention
-        ctx = BertSparseSelfAttention(
+        init = nn.initializers.normal(0.02)   # BERT convention, matching
+        ctx = BertSparseSelfAttention(          # the dense fused layer
             hidden_size=self.hidden_size,
             num_attention_heads=self.num_heads,
             sparsity_config=self._sparsity_config(),
             name="attention")(x, mask)
-        attn_out = nn.Dense(self.hidden_size, name="attn_out")(ctx)
+        attn_out = nn.Dense(self.hidden_size, kernel_init=init,
+                            name="attn_out")(ctx)
         if self.dropout > 0:
             attn_out = nn.Dropout(self.dropout)(attn_out, deterministic)
         x = nn.LayerNorm(epsilon=self.layer_norm_eps,
                          name="attn_ln")(x + attn_out)
-        h = nn.Dense(self.intermediate_size, name="fc")(x)
+        h = nn.Dense(self.intermediate_size, kernel_init=init,
+                     name="fc")(x)
         h = nn.gelu(h, approximate=True)
-        h = nn.Dense(self.hidden_size, name="out")(h)
+        h = nn.Dense(self.hidden_size, kernel_init=init, name="out")(h)
         if self.dropout > 0:
             h = nn.Dropout(self.dropout)(h, deterministic)
         return nn.LayerNorm(epsilon=self.layer_norm_eps,
@@ -184,6 +187,9 @@ class BertForPreTraining(nn.Module):
             assert cfg.attention_probs_dropout_prob == 0, (
                 "the block-sparse kernel has no attention-dropout input; "
                 "set attention_probs_dropout_prob=0 for sparse mode")
+            assert not cfg.pre_layer_norm, (
+                "BertSparseLayer is post-LN (classic BERT); pre_layer_norm "
+                "is not implemented for sparse mode")
             sparse_cls = BertSparseLayer
             if cfg.remat:
                 sparse_cls = nn.remat(BertSparseLayer, static_argnums=(3,))

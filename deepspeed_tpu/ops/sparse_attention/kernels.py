@@ -29,8 +29,8 @@ def _interpret():
     return jax.default_backend() != "tpu"
 
 
-def _fwd_kernel(layout_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
-                sm_scale, causal, block, seq):
+def _fwd_kernel(layout_ref, kpm_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                sm_scale, causal, block, seq, has_bias):
     qi = pl.program_id(1)
     q = q_ref[0]
     num_kv = seq // block
@@ -45,6 +45,11 @@ def _fwd_kernel(layout_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
             s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                     preferred_element_type=jnp.float32) \
                 * sm_scale
+            if has_bias:
+                # key-padding bias (0 = attend, ~-1e9 = masked): the
+                # online softmax self-corrects — masked contributions get
+                # weight exp(-1e9 - m_final) == 0 once a valid key raises m
+                s = s + kpm_ref[0, pl.ds(j * block, block), 0][None, :]
             if causal:
                 rows = qi * block + jax.lax.broadcasted_iota(
                     jnp.int32, (block, block), 0)
@@ -77,8 +82,9 @@ def _fwd_kernel(layout_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
         (m + jnp.log(l_safe))[:, None], (block, LANES))
 
 
-def _dq_kernel(layout_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-               dq_ref, *, sm_scale, causal, block, seq):
+def _dq_kernel(layout_ref, kpm_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+               delta_ref, dq_ref, *, sm_scale, causal, block, seq,
+               has_bias):
     qi = pl.program_id(1)
     q = q_ref[0]
     do = do_ref[0]
@@ -93,6 +99,8 @@ def _dq_kernel(layout_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                     preferred_element_type=jnp.float32) \
                 * sm_scale
+            if has_bias:
+                s = s + kpm_ref[0, pl.ds(j * block, block), 0][None, :]
             if causal:
                 rows = qi * block + jax.lax.broadcasted_iota(
                     jnp.int32, (block, block), 0)
@@ -115,8 +123,9 @@ def _dq_kernel(layout_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
-def _dkv_kernel(layout_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, *, sm_scale, causal, block, seq):
+def _dkv_kernel(layout_ref, kpm_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                delta_ref, dk_ref, dv_ref, *, sm_scale, causal, block, seq,
+                has_bias):
     kj = pl.program_id(1)
     k = k_ref[0]
     v = v_ref[0]
@@ -132,6 +141,8 @@ def _dkv_kernel(layout_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                     preferred_element_type=jnp.float32) \
                 * sm_scale
+            if has_bias:
+                s = s + kpm_ref[0, pl.ds(kj * block, block), 0][None, :]
             if causal:
                 rows = i * block + jax.lax.broadcasted_iota(
                     jnp.int32, (block, block), 0)
@@ -161,13 +172,17 @@ def _dkv_kernel(layout_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def block_sparse_attention(q, k, v, layout, block=None, causal=False,
-                           sm_scale=None):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def block_sparse_attention(q, k, v, layout, key_padding_bias=None,
+                           block=None, causal=False, sm_scale=None):
     """Attention restricted to the block layout.
 
-    q,k,v: [B, H, S, D]; layout: [H, S//block, S//block] int."""
-    out, _ = _bs_fwd(q, k, v, layout, block, causal, sm_scale)
+    q,k,v: [B, H, S, D]; layout: [H, S//block, S//block] int;
+    key_padding_bias: optional [B, S] ADDITIVE fp32 score bias
+    (0 = attend, ~-1e9 = masked key — the reference's
+    key_padding_mask_mode='add')."""
+    out, _ = _bs_fwd(q, k, v, layout, key_padding_bias, block, causal,
+                     sm_scale)
     return out
 
 
@@ -180,7 +195,22 @@ def _specs(H, block, nq, D, S):
     return lay, qb, full, stat, statf
 
 
-def _bs_fwd(q, k, v, layout, block, causal, sm_scale):
+def _kpm_arr(key_padding_bias, B, S):
+    """[B, S] additive bias -> ([B, S, LANES] array, spec, has_bias); a
+    1-row dummy (never read: the kernels skip the add when has_bias is
+    False) keeps the pallas signature static without streaming zeros."""
+    if key_padding_bias is None:
+        arr = jnp.zeros((1, S, LANES), jnp.float32)
+        spec = pl.BlockSpec((1, S, LANES), lambda b, i: (0, 0, 0))
+        return arr, spec, False
+    kpb = jnp.asarray(key_padding_bias, jnp.float32)
+    assert kpb.shape == (B, S), (kpb.shape, (B, S))
+    arr = jnp.broadcast_to(kpb[:, :, None], (B, S, LANES))
+    H = None  # bound below via closure in the spec builder
+    return arr, None, True
+
+
+def _bs_fwd(q, k, v, layout, key_padding_bias, block, causal, sm_scale):
     B, H, S, D = q.shape
     if block is None:
         block = S // layout.shape[-1]
@@ -192,24 +222,27 @@ def _bs_fwd(q, k, v, layout, block, causal, sm_scale):
     kf = k.reshape(B * H, S, D)
     vf = v.reshape(B * H, S, D)
     layout = jnp.asarray(layout, jnp.int32)
+    kpm, kpm_spec, has_bias = _kpm_arr(key_padding_bias, B, S)
+    if kpm_spec is None:   # per-batch bias shared across heads
+        kpm_spec = pl.BlockSpec((1, S, LANES), lambda b, i: (b // H, 0, 0))
 
-    lay, qb, full, stat, _ = _specs(H, block, nq, D, S)
+    lay, qb, full, stat, statf = _specs(H, block, nq, D, S)
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
-                          block=block, seq=S),
+                          block=block, seq=S, has_bias=has_bias),
         grid=(B * H, nq),
-        in_specs=[lay, qb, full, full],
+        in_specs=[lay, kpm_spec, qb, full, full],
         out_specs=[qb, stat],
         out_shape=[jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
                    jax.ShapeDtypeStruct((B * H, S, LANES), jnp.float32)],
         interpret=_interpret(),
-    )(layout, qf, kf, vf)
-    return o.reshape(B, H, S, D), (q, k, v, layout, o.reshape(B, H, S, D),
-                                   lse)
+    )(layout, kpm, qf, kf, vf)
+    return o.reshape(B, H, S, D), (q, k, v, layout, key_padding_bias,
+                                   o.reshape(B, H, S, D), lse)
 
 
 def _bs_bwd(block, causal, sm_scale, res, g):
-    q, k, v, layout, out, lse = res
+    q, k, v, layout, key_padding_bias, out, lse = res
     B, H, S, D = q.shape
     if block is None:
         block = S // layout.shape[-1]
@@ -220,6 +253,9 @@ def _bs_bwd(block, causal, sm_scale, res, g):
     kf = k.reshape(B * H, S, D)
     vf = v.reshape(B * H, S, D)
     dof = g.reshape(B * H, S, D)
+    kpm, kpm_spec, has_bias = _kpm_arr(key_padding_bias, B, S)
+    if kpm_spec is None:
+        kpm_spec = pl.BlockSpec((1, S, LANES), lambda b, i: (b // H, 0, 0))
     delta = jnp.broadcast_to(
         jnp.sum(dof.astype(jnp.float32) *
                 out.reshape(B * H, S, D).astype(jnp.float32),
@@ -228,33 +264,33 @@ def _bs_bwd(block, causal, sm_scale, res, g):
     lay, qb, full, stat, statf = _specs(H, block, nq, D, S)
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, sm_scale=sm_scale, causal=causal,
-                          block=block, seq=S),
+                          block=block, seq=S, has_bias=has_bias),
         grid=(B * H, nq),
-        in_specs=[lay, qb, full, full, qb, stat, stat],
+        in_specs=[lay, kpm_spec, qb, full, full, qb, stat, stat],
         out_specs=qb,
         out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
         interpret=_interpret(),
-    )(layout, qf, kf, vf, dof, lse, delta)
+    )(layout, kpm, qf, kf, vf, dof, lse, delta)
 
     kb = pl.BlockSpec((1, block, D), lambda b, j: (b, j, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, sm_scale=sm_scale, causal=causal,
-                          block=block, seq=S),
+                          block=block, seq=S, has_bias=has_bias),
         grid=(B * H, nq),
-        in_specs=[lay, full, kb, kb, full, statf, statf],
+        in_specs=[lay, kpm_spec, full, kb, kb, full, statf, statf],
         out_specs=[kb, kb],
         out_shape=[jax.ShapeDtypeStruct((B * H, S, D), k.dtype),
                    jax.ShapeDtypeStruct((B * H, S, D), v.dtype)],
         interpret=_interpret(),
-    )(layout, qf, kf, vf, dof, lse, delta)
+    )(layout, kpm, qf, kf, vf, dof, lse, delta)
 
     return (dq.reshape(B, H, S, D), dk.reshape(B, H, S, D),
-            dv.reshape(B, H, S, D), None)
+            dv.reshape(B, H, S, D), None, None)
 
 
 block_sparse_attention.defvjp(
-    lambda q, k, v, layout, block, causal, sm_scale:
-    _bs_fwd(q, k, v, layout, block, causal, sm_scale),
+    lambda q, k, v, layout, kpb, block, causal, sm_scale:
+    _bs_fwd(q, k, v, layout, kpb, block, causal, sm_scale),
     _bs_bwd)
 
 

@@ -22,10 +22,16 @@ _layout_cache = {}
 def _config_key(cfg: SparsityConfig):
     """Content-based cache key: id()-keyed caching is unsafe when configs
     are constructed per call (a freed id can be reused by a DIFFERENT
-    config, serving a stale layout)."""
+    config, serving a stale layout). List-valued geometry (variable /
+    longformer block indices) participates via tuple conversion."""
+    def norm(v):
+        if isinstance(v, (list, tuple)):
+            return tuple(norm(x) for x in v)
+        if isinstance(v, (int, float, str, bool)) or v is None:
+            return v
+        return repr(v)
     return (type(cfg).__name__,
-            tuple(sorted((k, v) for k, v in vars(cfg).items()
-                         if isinstance(v, (int, float, str, bool)))))
+            tuple(sorted((k, norm(v)) for k, v in vars(cfg).items())))
 
 
 def get_layout(sparsity_config: SparsityConfig, seq_len: int):
@@ -50,20 +56,36 @@ class SparseSelfAttention(nn.Module):
     def __call__(self, query, key, value, rpe=None, key_padding_mask=None,
                  attn_mask=None):
         assert query.dtype == key.dtype == value.dtype
-        if key_padding_mask is not None or attn_mask is not None:
-            # the Pallas kernel has no mask input yet; silently attending
-            # padding would be worse than failing
+        if attn_mask is not None:
             raise NotImplementedError(
-                "SparseSelfAttention: key_padding_mask/attn_mask are not "
-                "supported by the TPU block-sparse kernel; drop padding "
-                "host-side or use dense attention for padded batches")
+                "SparseSelfAttention: full [S, S] attn_mask is not "
+                "supported by the TPU block-sparse kernel; use "
+                "key_padding_mask (per-key) or a causal sparsity config")
         S = query.shape[2]
+        kpb = None
+        if key_padding_mask is not None:
+            kpm = jnp.asarray(key_padding_mask)
+            if jnp.issubdtype(kpm.dtype, jnp.floating):
+                # reference key_padding_mask_mode: 'add' means the float
+                # mask IS the additive score bias (callers with 1.0/0.0
+                # validity masks must convert to bool first — see
+                # BertSparseSelfAttention)
+                if self.key_padding_mask_mode != "add":
+                    raise NotImplementedError(
+                        f"key_padding_mask_mode="
+                        f"{self.key_padding_mask_mode!r}; only 'add' is "
+                        "implemented for float masks")
+                kpb = kpm.astype(jnp.float32)
+            else:
+                # bool/int: True/1 = attend, False/0 = padding
+                kpb = jnp.where(kpm.astype(bool), 0.0, -1e9
+                                ).astype(jnp.float32)
         cfg = self._config()
         layout = get_layout(cfg, S)
         causal = getattr(cfg, "attention", "bidirectional") == "unidirectional"
         return block_sparse_attention(
-            query, key, value, jnp.asarray(layout), cfg.block, causal,
-            None)
+            query, key, value, jnp.asarray(layout),
+            key_padding_bias=kpb, block=cfg.block, causal=causal)
 
 
 class BertSparseSelfAttention(nn.Module):
@@ -82,6 +104,11 @@ class BertSparseSelfAttention(nn.Module):
         q = q.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
         k = k.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
         v = v.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
+        if attention_mask is not None:
+            # HF-style validity mask (possibly float 1.0/0.0): force the
+            # boolean reading so a float-typed mask can't be misread as
+            # an additive bias (the dense leg does the same .astype(bool))
+            attention_mask = jnp.asarray(attention_mask).astype(bool)
         ctx = SparseSelfAttention(
             sparsity_config=self.sparsity_config or
             FixedSparsityConfig(num_heads=nh), name="sparse_attn")(

@@ -58,8 +58,8 @@ def test_sparse_forward_matches_masked_dense(name, cfg, causal):
     # softmax is well-defined everywhere)
     for h in range(layout.shape[0]):
         np.fill_diagonal(layout[h], 1)
-    out = block_sparse_attention(q, k, v, jnp.asarray(layout), cfg.block,
-                                 causal)
+    out = block_sparse_attention(q, k, v, jnp.asarray(layout),
+                                 block=cfg.block, causal=causal)
     ref = _oracle(q, k, v, layout, cfg.block, causal)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=3e-5, rtol=3e-5)
@@ -74,8 +74,8 @@ def test_sparse_backward_matches_masked_dense():
     lay = jnp.asarray(layout)
 
     def loss_sparse(q, k, v):
-        return jnp.sum(block_sparse_attention(q, k, v, lay, cfg.block,
-                                              False) ** 2)
+        return jnp.sum(block_sparse_attention(
+            q, k, v, lay, block=cfg.block, causal=False) ** 2)
 
     def loss_ref(q, k, v):
         return jnp.sum(_oracle(q, k, v, layout, cfg.block, False) ** 2)
@@ -92,7 +92,7 @@ def test_dense_config_equals_full_attention():
     cfg = DenseSparsityConfig(num_heads=2, block=16)
     out = block_sparse_attention(q, k, v,
                                  jnp.asarray(cfg.make_layout(64)),
-                                 cfg.block, False)
+                                 block=cfg.block, causal=False)
     ref = mha_reference(q, k, v, causal=False)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-5, rtol=2e-5)
@@ -121,3 +121,69 @@ def test_sparse_self_attention_module():
     params = m.init(jax.random.PRNGKey(1), x)
     out = m.apply(params, x)
     assert out.shape == x.shape and np.isfinite(np.asarray(out)).all()
+
+
+
+def test_key_padding_mask_matches_masked_dense():
+    """[B, S] key-padding bias: parity of fwd AND grads vs a dense
+    softmax with the same additive mask (reference
+    key_padding_mask_mode='add')."""
+    q, k, v = _qkv(S=64)
+    B, H, S, D = q.shape
+    cfg = DenseSparsityConfig(num_heads=H, block=16)
+    lay = jnp.asarray(cfg.make_layout(S))
+    rng = np.random.default_rng(3)
+    valid = rng.random((B, S)) > 0.3          # ~70% keys valid
+    valid[:, 0] = True                        # every row attends something
+    kpb = jnp.where(jnp.asarray(valid), 0.0, -1e9).astype(jnp.float32)
+
+    def dense_masked(q, k, v):
+        s = jnp.einsum("bhsd,bhtd->bhst", q, k) * (D ** -0.5)
+        s = s + kpb[:, None, None, :]
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhst,bhtd->bhsd", p, v)
+
+    out = block_sparse_attention(q, k, v, lay, key_padding_bias=kpb,
+                                 block=cfg.block)
+    ref = dense_masked(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=3e-5, rtol=3e-5)
+
+    gs = jax.grad(lambda *a: jnp.sum(block_sparse_attention(
+        *a, lay, key_padding_bias=kpb, block=cfg.block) ** 2),
+        (0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda *a: jnp.sum(dense_masked(*a) ** 2),
+                  (0, 1, 2))(q, k, v)
+    for a, b, n in zip(gs, gr, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-3, rtol=1e-3, err_msg=n)
+
+
+def test_bert_sparse_attention_mask():
+    """BertSparseSelfAttention consumes the HF-style attention_mask
+    (1 = attend, 0 = pad); padded keys must not influence valid rows."""
+    import flax.linen as nn
+    from deepspeed_tpu.ops.sparse_attention.sparse_self_attention import \
+        BertSparseSelfAttention
+
+    B, S, Hd = 2, 64, 32
+    layer = BertSparseSelfAttention(
+        hidden_size=Hd, num_attention_heads=2,
+        sparsity_config=DenseSparsityConfig(num_heads=2, block=16))
+    x = jax.random.normal(jax.random.PRNGKey(0), (B, S, Hd))
+    mask = np.ones((B, S), np.int32)
+    mask[:, S // 2:] = 0                      # second half is padding
+    params = layer.init(jax.random.PRNGKey(1), x)["params"]
+    masked = layer.apply({"params": params}, x, jnp.asarray(mask))
+    # perturbing the PADDED tokens' inputs must not change valid outputs
+    x2 = x.at[:, S // 2:].set(
+        jax.random.normal(jax.random.PRNGKey(2), (B, S // 2, Hd)))
+    masked2 = layer.apply({"params": params}, x2, jnp.asarray(mask))
+    np.testing.assert_allclose(np.asarray(masked[:, :S // 2]),
+                               np.asarray(masked2[:, :S // 2]),
+                               atol=1e-5, rtol=1e-5)
+    # and WITHOUT the mask they do change
+    un = layer.apply({"params": params}, x)
+    un2 = layer.apply({"params": params}, x2)
+    assert np.abs(np.asarray(un[:, :S // 2]) -
+                  np.asarray(un2[:, :S // 2])).max() > 1e-4
