@@ -195,10 +195,12 @@ def _specs(H, block, nq, D, S):
     return lay, qb, full, stat, statf
 
 
-def _kpm_arr(key_padding_bias, B, S):
-    """[B, S] additive bias -> ([B, S, LANES] array, spec, has_bias); a
-    1-row dummy (never read: the kernels skip the add when has_bias is
-    False) keeps the pallas signature static without streaming zeros."""
+def _kpm_arr(key_padding_bias, B, H, S):
+    """[B, S] additive bias -> ([B, S, LANES] array, spec, has_bias).
+    The spec shares one bias row across all H heads of a batch (b // H);
+    without a mask, a 1-row dummy (never read: the kernels compile the
+    add out when has_bias is False) keeps the pallas signature static
+    without streaming zeros."""
     if key_padding_bias is None:
         arr = jnp.zeros((1, S, LANES), jnp.float32)
         spec = pl.BlockSpec((1, S, LANES), lambda b, i: (0, 0, 0))
@@ -206,8 +208,8 @@ def _kpm_arr(key_padding_bias, B, S):
     kpb = jnp.asarray(key_padding_bias, jnp.float32)
     assert kpb.shape == (B, S), (kpb.shape, (B, S))
     arr = jnp.broadcast_to(kpb[:, :, None], (B, S, LANES))
-    H = None  # bound below via closure in the spec builder
-    return arr, None, True
+    spec = pl.BlockSpec((1, S, LANES), lambda b, i: (b // H, 0, 0))
+    return arr, spec, True
 
 
 def _bs_fwd(q, k, v, layout, key_padding_bias, block, causal, sm_scale):
@@ -222,11 +224,9 @@ def _bs_fwd(q, k, v, layout, key_padding_bias, block, causal, sm_scale):
     kf = k.reshape(B * H, S, D)
     vf = v.reshape(B * H, S, D)
     layout = jnp.asarray(layout, jnp.int32)
-    kpm, kpm_spec, has_bias = _kpm_arr(key_padding_bias, B, S)
-    if kpm_spec is None:   # per-batch bias shared across heads
-        kpm_spec = pl.BlockSpec((1, S, LANES), lambda b, i: (b // H, 0, 0))
+    kpm, kpm_spec, has_bias = _kpm_arr(key_padding_bias, B, H, S)
 
-    lay, qb, full, stat, statf = _specs(H, block, nq, D, S)
+    lay, qb, full, stat, _ = _specs(H, block, nq, D, S)
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
                           block=block, seq=S, has_bias=has_bias),
@@ -253,9 +253,7 @@ def _bs_bwd(block, causal, sm_scale, res, g):
     kf = k.reshape(B * H, S, D)
     vf = v.reshape(B * H, S, D)
     dof = g.reshape(B * H, S, D)
-    kpm, kpm_spec, has_bias = _kpm_arr(key_padding_bias, B, S)
-    if kpm_spec is None:
-        kpm_spec = pl.BlockSpec((1, S, LANES), lambda b, i: (b // H, 0, 0))
+    kpm, kpm_spec, has_bias = _kpm_arr(key_padding_bias, B, H, S)
     delta = jnp.broadcast_to(
         jnp.sum(dof.astype(jnp.float32) *
                 out.reshape(B * H, S, D).astype(jnp.float32),
