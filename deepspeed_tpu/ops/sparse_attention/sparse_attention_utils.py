@@ -1,0 +1,115 @@
+"""SparseAttentionUtils — wire the ``"sparse_attention"`` JSON block to
+models.
+
+Rebuild of deepspeed/ops/sparse_attention/sparse_attention_utils.py:13 and
+the config extraction at deepspeed/runtime/config.py:345-529. The
+reference walks an HF module tree swapping self-attention instances; flax
+modules are config-built, so the substitution happens at MODEL-CONFIG
+level: :func:`apply_to_bert_config` maps the JSON block onto the
+BertConfig fields that select :class:`models.bert.BertSparseLayer`.
+"""
+
+import dataclasses
+
+from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
+    BigBirdSparsityConfig, BSLongformerSparsityConfig, DenseSparsityConfig,
+    FixedSparsityConfig, VariableSparsityConfig)
+
+_MODES = {
+    "dense": DenseSparsityConfig,
+    "fixed": FixedSparsityConfig,
+    "variable": VariableSparsityConfig,
+    "bigbird": BigBirdSparsityConfig,
+    "bslongformer": BSLongformerSparsityConfig,
+}
+
+
+def get_sparse_attention_config(ds_config_dict, num_heads):
+    """JSON ``sparse_attention`` block -> SparsityConfig instance
+    (reference runtime/config.py:345 ``get_sparse_attention``). Returns
+    None when the block is absent; an EMPTY block enables fixed-mode
+    defaults (reference behavior); unknown keys raise from the sparsity
+    config constructor."""
+    block_cfg = (ds_config_dict or {}).get("sparse_attention")
+    if block_cfg is None:
+        return None
+    if not isinstance(block_cfg, dict):
+        raise ValueError(
+            f"'sparse_attention' must be a dict block, got "
+            f"{block_cfg!r} (use {{}} for fixed-mode defaults)")
+    mode = block_cfg.get("mode", "fixed")
+    if mode not in _MODES:
+        raise NotImplementedError(
+            f"Given sparsity mode, {mode}, has not been implemented yet!")
+    kwargs = {k: v for k, v in block_cfg.items() if k != "mode"}
+    return _MODES[mode](num_heads=num_heads, **kwargs)
+
+
+class SparseAttentionUtils:
+    """Reference class surface (sparse_attention_utils.py:13)."""
+
+    # the JSON keys BertConfig can represent, per mode (beyond "mode")
+    _BERT_FIELDS = {
+        "fixed": {"block", "num_local_blocks", "num_global_blocks"},
+        "dense": {"block"},
+        "bigbird": {"block"},
+        "bslongformer": {"block"},
+        "variable": {"block"},
+    }
+
+    @staticmethod
+    def apply_to_bert_config(bert_config, ds_config_dict):
+        """Return a BertConfig whose layers use block-sparse attention per
+        the ds_config ``sparse_attention`` block — the flax analogue of
+        ``replace_model_self_attention_with_sparse_self_attention``.
+
+        Validates the WHOLE block through
+        :func:`get_sparse_attention_config` first (so typo'd keys raise),
+        then refuses keys BertConfig cannot carry instead of silently
+        training a different pattern than configured."""
+        sc = get_sparse_attention_config(
+            ds_config_dict, bert_config.num_attention_heads)
+        if sc is None:
+            return bert_config
+        block_cfg = ds_config_dict["sparse_attention"]
+        mode = block_cfg.get("mode", "fixed")
+        extra = (set(block_cfg) - {"mode"}
+                 - SparseAttentionUtils._BERT_FIELDS[mode])
+        if extra:
+            raise ValueError(
+                f"sparse_attention keys {sorted(extra)} are valid for "
+                f"mode {mode!r} but not representable in BertConfig; "
+                "construct BertSparseLayer with a custom SparsityConfig "
+                "instead")
+        updates = {"sparse_attention_mode": mode, "sparse_block": sc.block}
+        if mode == "fixed":
+            updates["sparse_num_local_blocks"] = sc.num_local_blocks
+            updates["sparse_num_global_blocks"] = sc.num_global_blocks
+        return dataclasses.replace(bert_config, **updates)
+
+    @staticmethod
+    def pad_to_block_size(block_size, input_ids, attention_mask=None,
+                          pad_token_id=0):
+        """Pad the sequence dim up to a multiple of the sparsity block
+        (reference :151); returns (pad_len, input_ids, attention_mask)."""
+        import jax.numpy as jnp
+        S = input_ids.shape[1]
+        pad_len = (-S) % block_size
+        if attention_mask is None:
+            # always return a mask: a data-dependent None would flip the
+            # caller's types on input length
+            attention_mask = jnp.ones(input_ids.shape, jnp.int32)
+        if pad_len == 0:
+            return 0, input_ids, attention_mask
+        ids = jnp.pad(input_ids, ((0, 0), (0, pad_len)),
+                      constant_values=pad_token_id)
+        mask = jnp.pad(attention_mask, ((0, 0), (0, pad_len)),
+                       constant_values=0)
+        return pad_len, ids, mask
+
+    @staticmethod
+    def unpad_sequence_output(pad_len, sequence_output):
+        """reference :210 — strip the block padding again."""
+        if pad_len == 0:
+            return sequence_output
+        return sequence_output[:, :-pad_len]

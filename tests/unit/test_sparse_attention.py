@@ -187,3 +187,4 @@ def test_bert_sparse_attention_mask():
     un2 = layer.apply({"params": params}, x2)
     assert np.abs(np.asarray(un[:, :S // 2]) -
                   np.asarray(un2[:, :S // 2])).max() > 1e-4
+

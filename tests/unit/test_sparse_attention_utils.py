@@ -1,0 +1,72 @@
+"""sparse_attention_utils config wiring — fast tier (no kernels).
+
+The JSON 'sparse_attention' block -> SparsityConfig / BertConfig mapping
+(reference runtime/config.py:345 get_sparse_attention +
+sparse_attention_utils.py)."""
+
+import pytest
+
+from deepspeed_tpu.ops.sparse_attention.sparsity_config import \
+    FixedSparsityConfig
+
+def test_sparse_attention_utils_config_wiring():
+    """The ds_config 'sparse_attention' JSON block reaches the model
+    (reference runtime/config.py:345 get_sparse_attention +
+    sparse_attention_utils replace_model_self_attention)."""
+    from deepspeed_tpu.models.bert import BertConfig
+    from deepspeed_tpu.ops.sparse_attention.sparse_attention_utils import (
+        SparseAttentionUtils, get_sparse_attention_config)
+
+    ds = {"sparse_attention": {"mode": "fixed", "block": 8,
+                               "num_local_blocks": 2}}
+    sc = get_sparse_attention_config(ds, num_heads=4)
+    assert isinstance(sc, FixedSparsityConfig)
+    assert sc.block == 8 and sc.num_local_blocks == 2
+
+    base = BertConfig(vocab_size=512, hidden_size=64, num_hidden_layers=2,
+                      num_attention_heads=4, intermediate_size=256)
+    cfg = SparseAttentionUtils.apply_to_bert_config(base, ds)
+    assert cfg.sparse_attention_mode == "fixed"
+    assert cfg.sparse_block == 8
+    assert cfg.sparse_num_local_blocks == 2
+    # absent block: config unchanged
+    assert SparseAttentionUtils.apply_to_bert_config(base, {}) is base
+
+    assert get_sparse_attention_config({}, 4) is None
+    # EMPTY block = fixed-mode defaults (reference behavior), not disabled
+    sc_default = get_sparse_attention_config({"sparse_attention": {}}, 4)
+    assert isinstance(sc_default, FixedSparsityConfig)
+    with pytest.raises(NotImplementedError):
+        get_sparse_attention_config(
+            {"sparse_attention": {"mode": "nope"}}, 4)
+    with pytest.raises(ValueError):
+        get_sparse_attention_config({"sparse_attention": True}, 4)
+    # keys BertConfig cannot carry fail loudly instead of being dropped
+    with pytest.raises(ValueError, match="not representable"):
+        SparseAttentionUtils.apply_to_bert_config(
+            BertConfig(vocab_size=512, hidden_size=64,
+                       num_hidden_layers=2, num_attention_heads=4,
+                       intermediate_size=256),
+            {"sparse_attention": {"mode": "fixed",
+                                  "attention": "unidirectional"}})
+
+
+def test_pad_to_block_size_roundtrip():
+    import jax.numpy as jnp
+    from deepspeed_tpu.ops.sparse_attention.sparse_attention_utils import \
+        SparseAttentionUtils
+
+    ids = jnp.ones((2, 30), jnp.int32)
+    pad, pids, pmask = SparseAttentionUtils.pad_to_block_size(16, ids)
+    assert pad == 2 and pids.shape == (2, 32) and pmask.shape == (2, 32)
+    assert int(pmask[:, -2:].sum()) == 0
+    out = jnp.zeros((2, 32, 8))
+    assert SparseAttentionUtils.unpad_sequence_output(pad, out).shape == \
+        (2, 30, 8)
+    # already aligned: no-op, and a mask is ALWAYS returned (no
+    # length-dependent None)
+    pad0, ids0, mask0 = SparseAttentionUtils.pad_to_block_size(16, pids,
+                                                               pmask)
+    assert pad0 == 0 and ids0 is pids and mask0 is pmask
+    pad1, _, mask1 = SparseAttentionUtils.pad_to_block_size(16, pids)
+    assert pad1 == 0 and mask1 is not None and mask1.shape == (2, 32)
