@@ -60,6 +60,52 @@ def _retry(fn, what, attempts=4, sleep_s=10.0):
             time.sleep(sleep_s)
 
 
+_PROBE_FN = None
+
+
+def _probe_tunnel(n=5):
+    """Round-trip a trivial compiled dispatch n times; return median ms.
+
+    Distinguishes "engine slow" from "environment slow": through the remote
+    tunnel a dispatch+device_get pair costs ~100 ms when healthy; a degraded
+    tunnel (the BENCH_r03 failure mode: identical code measured 62 then 2.2
+    TFLOPS hours apart) shows up here as a 10-100x larger round trip.
+    """
+    global _PROBE_FN
+    import jax.numpy as jnp
+    x = jnp.ones((8, 128), jnp.float32)
+    if _PROBE_FN is None:  # one jitted fn for all probes: compile ONCE
+        _PROBE_FN = jax.jit(lambda a: a * 2.0 + 1.0)
+        _retry(lambda: jax.device_get(_PROBE_FN(x)), "tunnel-probe compile")
+    samples = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        jax.device_get(_PROBE_FN(x))
+        samples.append((time.perf_counter() - t0) * 1e3)
+    return float(np.median(samples))
+
+
+def _wait_for_healthy_tunnel(threshold_ms=1000.0, attempts=6, sleep_s=30.0):
+    """Probe until the round trip is under threshold.
+
+    Returns (healthy, last_rtt_ms, history). ``healthy=False`` means every
+    probe exceeded the threshold — callers must surface that in the output
+    rather than publish a silently poisoned number.
+    """
+    history = []
+    for i in range(attempts):
+        rtt = _probe_tunnel()
+        history.append(round(rtt, 1))
+        if rtt < threshold_ms:
+            return True, rtt, history
+        print(f"# tunnel degraded: trivial round trip {rtt:.0f} ms "
+              f"(attempt {i + 1}/{attempts}); sleeping {sleep_s:.0f}s",
+              flush=True)
+        if i < attempts - 1:
+            time.sleep(sleep_s)
+    return False, history[-1], history
+
+
 def main():
     _enable_compile_cache()
 
@@ -162,11 +208,54 @@ def main():
 
     _retry(_compile_step, "first train_batch compile")
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        engine.train_batch(batch=batch)
-    _sync()
-    dt = time.perf_counter() - t0
+    # Pre-flight: the r03 driver run recorded 2.2 TFLOPS from the same code
+    # that measured 62-106 in-session — a silently degraded tunnel. Probe a
+    # trivial round trip, wait for health, and put the evidence in the JSON.
+    healthy, rtt_ms, rtt_history = _wait_for_healthy_tunnel()
+
+    def _warmup():
+        for _ in range(2):
+            engine.train_batch(batch=batch)
+        _sync()
+    _retry(_warmup, "warmup steps")
+
+    # Median-of-N rounds. Each round dispatches `steps` async steps and
+    # syncs once (per-step sync would add one tunnel RTT ~100 ms to every
+    # step). Stall filtering is against the minimum over ALL rounds seen so
+    # far — including earlier ones — so a degraded FIRST round is evicted
+    # retroactively the moment a faster round lands (guards the case where
+    # the tunnel starts poisoned and recovers mid-bench).
+    target_rounds, max_attempts = 3, 8
+    all_rounds = []
+    for attempt in range(max_attempts):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            engine.train_batch(batch=batch)
+        _sync()
+        step_ms = (time.perf_counter() - t0) / steps * 1e3
+        all_rounds.append(step_ms)
+        best = min(all_rounds)
+        accepted = [r for r in all_rounds if r <= 2.5 * best]
+        if len(accepted) >= target_rounds:
+            break
+        if step_ms > 2.5 * best:
+            print(f"# stall detected: round at {step_ms:.1f} ms/step vs "
+                  f"best {best:.1f}; re-probing tunnel", flush=True)
+            ok, re_rtt, re_hist = _wait_for_healthy_tunnel()
+            rtt_history.extend(re_hist)
+            if not ok:
+                healthy = False
+                print(f"# tunnel still degraded after re-probe "
+                      f"({re_rtt:.0f} ms); abandoning further rounds",
+                      flush=True)
+                break
+    best = min(all_rounds)
+    round_step_ms = [r for r in all_rounds if r <= 2.5 * best]
+    stalled_rounds = [round(r, 1) for r in all_rounds
+                      if r > 2.5 * best]
+
+    med_step_ms = float(np.median(round_step_ms))
+    dt = med_step_ms * steps / 1e3
 
     tokens_per_s = batch_size * seq_len * steps / dt
     flops_per_token = 6 * n_params + 12 * n_layer * width * seq_len
@@ -182,8 +271,20 @@ def main():
         "unit": "TFLOPS/chip",
         "vs_baseline": round(tflops_per_chip / REFERENCE_TFLOPS_PER_GPU, 3),
         "mfu": round(tflops_per_chip / peak_tflops, 4),
-        "step_time_ms": round(dt / steps * 1e3, 1),
+        "step_time_ms": round(med_step_ms, 1),
         "tokens_per_s": round(tokens_per_s, 1),
+        # evidence that the number is steady state, not a lucky (or poisoned)
+        # single loop: per-round per-step times, their spread, the trivial
+        # round-trip probe before the timed rounds, and any stalled rounds
+        # that were detected and excluded
+        "round_step_ms": [round(x, 1) for x in round_step_ms],
+        "step_ms_stddev": round(float(np.std(round_step_ms)), 2),
+        "tunnel_rtt_ms": round(rtt_ms, 1),
+        "tunnel_rtt_history_ms": rtt_history,
+        "stalled_rounds_ms": stalled_rounds,
+        # False = every health probe exceeded 1 s round-trip: the number
+        # above reflects a degraded environment, NOT engine speed
+        "tunnel_healthy": healthy,
     }))
 
 
