@@ -111,7 +111,12 @@ class CostModelTuner:
             return  # failed trial: visited but not a training point
         idx = getattr(self, "_pending", None)
         if idx is None or self.configs[idx] is not config:
-            idx = self.configs.index(config)
+            # dict-equality lookup would map the measurement to the FIRST
+            # equal config when the space contains duplicate dicts,
+            # training the model on the wrong feature row
+            raise ValueError(
+                "CostModelTuner.update must be called with the exact "
+                "config object returned by the preceding next()")
         self.xs.append(idx)
         self.ys.append(float(perf))
 

@@ -49,7 +49,7 @@ def _fwd_kernel(layout_ref, kpm_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
                 # key-padding bias (0 = attend, ~-1e9 = masked): the
                 # online softmax self-corrects — masked contributions get
                 # weight exp(-1e9 - m_final) == 0 once a valid key raises m
-                s = s + kpm_ref[0, pl.ds(j * block, block), 0][None, :]
+                s = s + kpm_ref[0:1, pl.ds(j * block, block)]
             if causal:
                 rows = qi * block + jax.lax.broadcasted_iota(
                     jnp.int32, (block, block), 0)
@@ -100,7 +100,7 @@ def _dq_kernel(layout_ref, kpm_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                                     preferred_element_type=jnp.float32) \
                 * sm_scale
             if has_bias:
-                s = s + kpm_ref[0, pl.ds(j * block, block), 0][None, :]
+                s = s + kpm_ref[0:1, pl.ds(j * block, block)]
             if causal:
                 rows = qi * block + jax.lax.broadcasted_iota(
                     jnp.int32, (block, block), 0)
@@ -142,7 +142,7 @@ def _dkv_kernel(layout_ref, kpm_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                                     preferred_element_type=jnp.float32) \
                 * sm_scale
             if has_bias:
-                s = s + kpm_ref[0, pl.ds(kj * block, block), 0][None, :]
+                s = s + kpm_ref[0:1, pl.ds(kj * block, block)]
             if causal:
                 rows = i * block + jax.lax.broadcasted_iota(
                     jnp.int32, (block, block), 0)
@@ -196,20 +196,21 @@ def _specs(H, block, nq, D, S):
 
 
 def _kpm_arr(key_padding_bias, B, H, S):
-    """[B, S] additive bias -> ([B, S, LANES] array, spec, has_bias).
-    The spec shares one bias row across all H heads of a batch (b // H);
-    without a mask, a 1-row dummy (never read: the kernels compile the
-    add out when has_bias is False) keeps the pallas signature static
-    without streaming zeros."""
+    """[B, S] additive bias -> ([B, S] array, spec, has_bias).
+    Kept 2D at its natural width — the (8,128) HBM tiling stores it dense,
+    and the kernels slice a (1, block) row per key block instead of
+    streaming a LANES-wide broadcast (128x the mask bytes). The spec
+    shares one bias row across all H heads of a batch (b // H); without a
+    mask, a 1-row dummy (never read: the kernels compile the add out when
+    has_bias is False) keeps the pallas signature static."""
     if key_padding_bias is None:
-        arr = jnp.zeros((1, S, LANES), jnp.float32)
-        spec = pl.BlockSpec((1, S, LANES), lambda b, i: (0, 0, 0))
+        arr = jnp.zeros((1, S), jnp.float32)
+        spec = pl.BlockSpec((1, S), lambda b, i: (0, 0))
         return arr, spec, False
     kpb = jnp.asarray(key_padding_bias, jnp.float32)
     assert kpb.shape == (B, S), (kpb.shape, (B, S))
-    arr = jnp.broadcast_to(kpb[:, :, None], (B, S, LANES))
-    spec = pl.BlockSpec((1, S, LANES), lambda b, i: (b // H, 0, 0))
-    return arr, spec, True
+    spec = pl.BlockSpec((1, S), lambda b, i: (b // H, 0))
+    return kpb, spec, True
 
 
 def _bs_fwd(q, k, v, layout, key_padding_bias, block, causal, sm_scale):

@@ -167,8 +167,12 @@ def save_moe_experts(tag_dir, params_np, mp_rank=0):
     import os
     non_moe, prefixes, experts = split_moe_state(params_np)
     if experts:
+        # scope the cleanup to THIS mp_rank's files: with mp>1 every rank
+        # saves into the same tag dir, and a rank-wide glob would delete
+        # the other ranks' freshly written experts
         for f in _glob.glob(os.path.join(
-                tag_dir, "layer_*_expert_*_model_states.pt")):
+                tag_dir,
+                f"layer_*_expert_*_mp_rank_{mp_rank:02d}_model_states.pt")):
             os.remove(f)
     counts = []
     for lid, layer in enumerate(experts):
