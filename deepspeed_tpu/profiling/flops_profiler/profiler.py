@@ -47,10 +47,17 @@ class FlopsProfiler:
         self._params = 0
         self._start_time = None
         self._duration = 0.0
+        self._scope_flops = {}
+
+    def get_scope_flops(self):
+        """{name-stack path tuple: flops} from the per-module jaxpr walk
+        (exclusive counts; see module_profile.aggregate_by_module)."""
+        return dict(self._scope_flops)
 
     def start_profile(self, ignore_list=None):
         self.started = True
         self._start_time = time.perf_counter()
+        self._scope_flops = {}
         if self.ds_engine is not None:
             import jax.numpy as jnp
             state = self.ds_engine.state
@@ -62,6 +69,12 @@ class FlopsProfiler:
                     jax.random.PRNGKey(0), jnp.float32(1.0))
                 self._flops = costs.get("flops", 0.0)
                 self._bytes = costs.get("bytes accessed", 0.0)
+                # per-module attribution from the SAME traced step
+                from deepspeed_tpu.profiling.flops_profiler.module_profile \
+                    import profile_fn_by_scope
+                self._scope_flops = profile_fn_by_scope(
+                    self.ds_engine._jit_micro, state, batch,
+                    jax.random.PRNGKey(0), jnp.float32(1.0))
 
     def stop_profile(self):
         if self._start_time is not None:
@@ -86,9 +99,20 @@ class FlopsProfiler:
 
     def print_model_profile(self, profile_step=1, module_depth=-1,
                             top_modules=1, detailed=True, output_file=None):
-        out = (f"flops: {self.get_total_flops(True)}  "
+        out = (f"flops profile at step {profile_step}\n"
+               f"flops: {self.get_total_flops(True)}  "
                f"params: {self.get_total_params(True)}  "
                f"duration: {self.get_total_duration(True)}")
+        if self._scope_flops:
+            from deepspeed_tpu.profiling.flops_profiler.module_profile \
+                import format_model_profile
+            params = (self.ds_engine.state.params
+                      if self.ds_engine is not None else None)
+            out += "\n" + format_model_profile(
+                self._scope_flops, params=params,
+                total_duration=self._duration,
+                module_depth=module_depth, top_modules=top_modules,
+                detailed=detailed)
         if output_file:
             with open(output_file, "w") as f:
                 f.write(out + "\n")

@@ -378,8 +378,11 @@ class DeepSpeedConfig:
             "enabled", False))
         self.elasticity_params = pd.get("elasticity", {}) or {}
 
-        self.dataloader_drop_last = pd.get(C.DATALOADER_DROP_LAST,
-                                           C.DATALOADER_DROP_LAST_DEFAULT)
+        # None = not configured. The engine's loader then defaults to
+        # drop_last=True (a ragged final batch is a new shape, and under
+        # jit a new shape is a recompile) — the reference's False default
+        # is an eager-mode luxury; an EXPLICIT false is still honored.
+        self.dataloader_drop_last = pd.get(C.DATALOADER_DROP_LAST, None)
         self.gradient_accumulation_dtype = pd.get(C.GRADIENT_ACCUMULATION_FORMAT, None)
 
     # -- batch triangulation (reference config.py:926-1004) -----------------
@@ -446,11 +449,51 @@ class DeepSpeedConfig:
         if self.fp16_enabled and self.bfloat16_enabled:
             raise DeepSpeedConfigError("fp16 and bf16 modes are mutually exclusive")
         if self.fp16_master_weights_and_gradients:
-            if self.zero_optimization_stage != 2 or \
-                    self.zero_config.offload_optimizer.device != "cpu":
-                raise DeepSpeedConfigError(
-                    "fp16_master_weights_and_grads requires ZeRO stage 2 with "
-                    "cpu offload (reference constraint, engine.py:922)")
+            raise DeepSpeedConfigError(
+                "fp16_master_weights_and_grads halves HOST memory for the "
+                "cpu-offload masters; the TPU offload engine keeps fp32 "
+                "masters (host RAM is not the binding constraint on TPU "
+                "hosts, and the AVX CPU-Adam operates on fp32 buffers) — "
+                "remove the key")
+        # -- no-silent-no-op rule (same as the pipeline/offload dispatch in
+        # deepspeed_tpu/__init__.py): keys whose reference mechanism has no
+        # TPU/XLA counterpart are REJECTED when set off-default, never
+        # silently accepted.
+        if self.amp_enabled:
+            raise DeepSpeedConfigError(
+                "amp.enabled: NVIDIA apex AMP has no TPU counterpart; use "
+                "the native mixed-precision blocks instead — bf16 "
+                "{enabled: true} (preferred on TPU) or fp16 {enabled: true}")
+        if self.prescale_gradients or self.gradient_predivide_factor != 1.0:
+            raise DeepSpeedConfigError(
+                "prescale_gradients/gradient_predivide_factor rescale "
+                "gradients around an explicit NCCL allreduce to dodge fp16 "
+                "overflow; under XLA the data-parallel reduction is fused "
+                "into the compiled step with fp32 accumulation, so there "
+                "is no allreduce boundary to pre-scale — remove the key "
+                "(fp16 overflow is handled by the dynamic loss scaler)")
+        if self.disable_allgather:
+            raise DeepSpeedConfigError(
+                "disable_allgather selects allreduce over allgather for "
+                "the ZeRO-1 parameter update; XLA chooses the collective "
+                "implementation from the sharding layout — remove the key")
+        if self.communication_data_type is not None:
+            raise DeepSpeedConfigError(
+                "communication_data_type casts gradients for an explicit "
+                "allreduce; XLA's fused reduction accumulates in fp32 and "
+                "there is no user-visible collective to cast — remove the "
+                "key (for bandwidth compression use the 1-bit optimizers)")
+        if self.optimizer_legacy_fusion:
+            raise DeepSpeedConfigError(
+                "optimizer.legacy_fusion toggles a CUDA kernel-fusion "
+                "fallback; TPU optimizers are XLA/Pallas-fused uncondition"
+                "ally — remove the key")
+        if self.gradient_accumulation_dtype not in (
+                None, "fp32", "bf16", "fp16"):
+            raise DeepSpeedConfigError(
+                "data_types.grad_accum_dtype must be one of "
+                "fp32|bf16|fp16, got "
+                f"{self.gradient_accumulation_dtype!r}")
 
     def print(self, name="DeepSpeedConfig"):
         logger.info(f"{name}:")

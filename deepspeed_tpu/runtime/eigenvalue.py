@@ -7,10 +7,27 @@ here the same estimate is a Hessian-vector-product power iteration using
 ``jax.jvp`` over ``jax.grad`` — functionally identical, and jit-compiled.
 """
 
+import re
 from typing import Callable
 
 import jax
 import jax.numpy as jnp
+
+
+def path_str(path):
+    """Join a jax key-path into 'a/b/0/c' (shared with the MoQ quantizer
+    so block_eigenvalue keys match its tree_map_with_path lookups)."""
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        elif hasattr(k, "name"):
+            parts.append(str(k.name))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
 
 
 class Eigenvalue:
@@ -61,3 +78,93 @@ class Eigenvalue:
                 break
             eig = new_eig
         return eig
+
+    def _block_index(self, joined_path):
+        """Block id of a param path, or None.
+
+        ``layer_name`` names the repeated-layer module ('h', 'layers',
+        'bert.encoder.layer', ...); the block id is the integer that
+        follows it in the path ('h_3/attn/...', 'layers/3/...')."""
+        if self.layer_name:
+            tail = self.layer_name.replace(".", "/").split("/")[-1]
+            pat = rf"(?:^|/){re.escape(tail)}s?[_/]?(\d+)(?:/|$)"
+        else:
+            pat = r"_(\d+)(?:/|$)"
+        m = re.search(pat, joined_path)
+        if m is None:
+            return None
+        idx = int(m.group(1))
+        if self.layer_num and idx >= self.layer_num:
+            return None
+        return idx
+
+    def compute_block_eigenvalues(self, loss_fn: Callable, params, rng=None):
+        """Per-layer-block curvature for the MoQ schedule.
+
+        Power-iterates the DIAGONAL Hessian block of each repeated layer
+        (tangent zero outside the block — the jax form of the reference's
+        per-block ``torch.autograd.grad(grads, params, grad_outputs=v)``,
+        eigenvalue.py:61-145). Returns ``{leaf_path: (ratio, layer_id)}``
+        with ratios post-processed to [0, 1] of the max block, 1.0 for
+        blocks whose estimate is 0 — exactly the reference's
+        ``post_process`` (eigenvalue.py:148-151)."""
+        flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+        paths = [path_str(p) for p, _ in flat]
+        block_of = {i: b for i, p in enumerate(paths)
+                    if (b := self._block_index(p)) is not None}
+        if not block_of:
+            return {}
+        n_blocks = max(block_of.values()) + 1
+        grad_fn = jax.grad(loss_fn)
+
+        def hvp(v_tree):
+            return jax.jvp(grad_fn, (params,), (v_tree,))[1]
+
+        hvp = jax.jit(hvp)
+        key = rng if rng is not None else jax.random.PRNGKey(17)
+        leaves = [x for _, x in flat]
+        block_evs = []
+        for b in range(n_blocks):
+            idxs = {i for i, blk in block_of.items() if blk == b}
+            if not idxs:
+                block_evs.append(0.0)
+                continue
+            key, sub = jax.random.split(key)
+            subkeys = jax.random.split(sub, len(idxs))
+            v_leaves = [jnp.zeros_like(x, jnp.float32) for x in leaves]
+            for k, i in zip(subkeys, sorted(idxs)):
+                v_leaves[i] = jax.random.normal(
+                    k, leaves[i].shape, jnp.float32)
+
+            def restrict_norm(lvs):
+                norm = jnp.sqrt(sum(
+                    jnp.vdot(lvs[i], lvs[i]).real for i in idxs))
+                norm = jnp.maximum(norm, self.stability)
+                return [lvs[i] / norm if i in idxs
+                        else jnp.zeros_like(lvs[i])
+                        for i in range(len(lvs))]
+
+            v_leaves = restrict_norm(v_leaves)
+            eig = 0.0
+            for _ in range(self.max_iter):
+                Hv = jax.tree.leaves(hvp(treedef.unflatten(v_leaves)))
+                new_eig = float(sum(jnp.vdot(v_leaves[i], Hv[i]).real
+                                    for i in idxs))
+                v_leaves = restrict_norm(
+                    [h.astype(jnp.float32) for h in Hv])
+                if abs(new_eig) < self.stability:
+                    eig = 0.0
+                    break
+                if eig != 0.0 and abs(new_eig - eig) / abs(new_eig) < self.tol:
+                    eig = new_eig
+                    break
+                eig = new_eig
+            block_evs.append(eig)
+            if self.verbose:
+                from deepspeed_tpu.utils.logging import log_dist
+                log_dist(f"block {b} eigenvalue: {eig}", ranks=[0])
+
+        max_ev = max((abs(v) for v in block_evs), default=0.0)
+        ratios = [abs(v) / max_ev if (max_ev > 0.0 and v != 0.0) else 1.0
+                  for v in block_evs]
+        return {paths[i]: (ratios[blk], blk) for i, blk in block_of.items()}

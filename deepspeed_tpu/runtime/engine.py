@@ -31,6 +31,7 @@ the zero_to_fp32 converter work unchanged.
 
 import os
 import pickle
+import time
 from typing import Any, NamedTuple, Optional
 
 import jax
@@ -52,6 +53,14 @@ from deepspeed_tpu.runtime.zero.partition import (
 from deepspeed_tpu.utils import groups
 from deepspeed_tpu.utils.logging import log_dist, logger
 from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer, ThroughputTimer
+
+# reference timer names (deepspeed/runtime/engine.py:113-123). Under XLA the
+# forward and backward are ONE fused vjp program, so the 'forward' timer
+# carries the fused fwd+bwd time and 'backward' only the host bookkeeping;
+# a one-time log line says so when wall_clock_breakdown is enabled.
+FORWARD_GLOBAL_TIMER = "forward"
+BACKWARD_GLOBAL_TIMER = "backward"
+STEP_GLOBAL_TIMER = "step"
 
 MODEL_FILE_SUFFIX = "_model_states.pt"
 OPTIM_FILE_SUFFIX = "_optim_states.pt"
@@ -89,8 +98,15 @@ def _default_sparse_ids_fn(batch):
             "sparse_gradients: could not find token ids in the batch dict "
             f"(keys {list(batch)}); pass sparse_ids_fn=... to initialize()")
     if isinstance(batch, (tuple, list)):
-        return batch[0]
-    return batch
+        ids = batch[0]
+    else:
+        ids = batch
+    if not jnp.issubdtype(jnp.asarray(ids).dtype, jnp.integer):
+        raise ValueError(
+            "sparse_gradients: the first batch element has dtype "
+            f"{jnp.asarray(ids).dtype}, not an integer token-id array; "
+            "pass sparse_ids_fn=... to initialize()")
+    return ids
 
 
 class DeepSpeedEngine:
@@ -253,6 +269,7 @@ class DeepSpeedEngine:
             self.curriculum_scheduler = CurriculumScheduler(
                 self.config.curriculum_config.params)
         self.quantizer = None
+        ev_cfg = self.config.eigenvalue_config
         if getattr(self.config, "quantize_training_enabled", False):
             from deepspeed_tpu.runtime.quantize import Quantizer
             qc = self.config.quantize_training_config
@@ -264,7 +281,33 @@ class DeepSpeedEngine:
                 q_rounding=1 if getattr(qc, "rounding", "nearest") ==
                 "stochastic" else 0,
                 q_start_bits=qc.start_bits, q_target_bits=qc.target_bits,
-                q_period=qc.quantize_period)
+                q_period=qc.quantize_period,
+                q_eigenvalue=self.config.eigenvalue_enabled,
+                layer_num=ev_cfg.layer_num if
+                self.config.eigenvalue_enabled else 0)
+        # eigenvalue-guided MoQ (reference engine.py:316 construction,
+        # :1891 per-step block_eigenvalue feed)
+        self.eigenvalue = None
+        self.block_eigenvalue = {}
+        if self.config.eigenvalue_enabled:
+            if self.quantizer is None:
+                raise ValueError(
+                    "eigenvalue.enabled=true has no consumer without "
+                    "quantize_training (MoQ): the curvature estimate only "
+                    "guides the quantization schedule — enable "
+                    "quantize_training or drop the eigenvalue block")
+            if ev_cfg.layer_num < 1:
+                raise ValueError(
+                    "eigenvalue.layer_num must be the model's repeated-"
+                    "layer count (>= 1): it sizes the per-block MoQ "
+                    "schedule and bounds the block ids parsed from param "
+                    "paths")
+            from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+            self.eigenvalue = Eigenvalue(
+                verbose=ev_cfg.verbose, max_iter=ev_cfg.max_iter,
+                tol=ev_cfg.tol, stability=ev_cfg.stability,
+                gas_boundary_resolution=ev_cfg.gas_boundary_resolution,
+                layer_name=ev_cfg.layer_name, layer_num=ev_cfg.layer_num)
 
         # ---- parameters / state init --------------------------------------
         self._init_state(model_parameters, sample_batch)
@@ -280,17 +323,32 @@ class DeepSpeedEngine:
         self.monitor = MonitorMaster(self.config.tensorboard,
                                      rank=_dist.get_rank())
 
+        # ---- flops profiler (reference engine.py:1722 step trigger) -------
+        self.flops_profiler = None
+        if self.config.flops_profiler_config.enabled:
+            from deepspeed_tpu.profiling.flops_profiler.profiler import \
+                FlopsProfiler
+            self.flops_profiler = FlopsProfiler(ds_engine=self)
+
         # ---- timers -------------------------------------------------------
         self.timers = SynchronizedWallClockTimer()
         self.tput_timer = ThroughputTimer(
             batch_size=self.train_micro_batch_size_per_gpu() * self.dp_world_size,
             steps_per_output=self.steps_per_print())
+        self._breakdown_steps = 0  # global steps since the last breakdown log
+        if self.wall_clock_breakdown():
+            log_dist(
+                "wall_clock_breakdown: XLA fuses forward+backward into one "
+                "vjp program; the 'forward' timer carries the fused fwd+bwd "
+                "time ('backward' is host bookkeeping only)", ranks=[0])
 
         log_dist(
             f"DeepSpeedEngine ready: zero_stage={self.zero_stage} "
             f"dtype={self.compute_dtype.__name__} dp={self.dp_world_size} "
             f"mp={self.mp_world_size} gas={self.gradient_accumulation_steps()}",
             ranks=[0])
+        if self.config.dump_state:  # reference engine.py:245 dump_state
+            self.config.print("DeepSpeedEngine configuration")
 
     # ------------------------------------------------------------------ config
     def train_batch_size(self):
@@ -304,6 +362,12 @@ class DeepSpeedEngine:
 
     def steps_per_print(self):
         return self.config.steps_per_print
+
+    def wall_clock_breakdown(self):
+        """Reference API (engine.py:585). When enabled the gas=1 fused
+        program is split back into micro+apply so the phases are separately
+        timeable — same trade the reference makes with its cuda syncs."""
+        return self.config.wall_clock_breakdown
 
     def zero_optimization_stage(self):
         return self.zero_stage
@@ -548,10 +612,18 @@ class DeepSpeedEngine:
         # every leaf is born sharded (no host round-trip of full params).
         dp = self.dp_world_size
 
+        # gradient_accumulation_dtype (reference "data_types" block):
+        # fp32 default; bf16/fp16 halve the accumulator's HBM footprint at
+        # the cost of accumulation precision. The 1-bit path keeps fp32 —
+        # its error-feedback residuals are precision-critical.
+        acc_dtype = {None: jnp.float32, "fp32": jnp.float32,
+                     "bf16": jnp.bfloat16, "fp16": jnp.float16}[
+                         self.config.gradient_accumulation_dtype]
+
         def make_acc(x):
             if self._onebit_dist:   # rank-local accumulation: [dp, ...]
                 return jnp.zeros((dp,) + x.shape, jnp.float32)
-            return jnp.zeros_like(x, jnp.float32)
+            return jnp.zeros_like(x, acc_dtype)
 
         def make_state(p):
             return TrainState(
@@ -582,6 +654,7 @@ class DeepSpeedEngine:
         self._build_step_fns()
         self._pending_loss = None
         self._last_grad_norm = None
+        self._last_batch = None
 
     def _build_sparse_mask(self, params):
         """Flat boolean mask over the param leaves: True = embedding table
@@ -703,7 +776,10 @@ class DeepSpeedEngine:
                 state.params, batch, rng, pld_theta,
                 state.scale.loss_scale / gas)
             grads = self._grad_constraint(grads)
-            acc = jax.tree.map(jnp.add, state.acc_grads, grads)
+            # cast INTO the accumulator dtype (gradient_accumulation_dtype);
+            # bare jnp.add would promote and silently widen the buffer
+            acc = jax.tree.map(lambda a, g: a + g.astype(a.dtype),
+                               state.acc_grads, grads)
             loss = sloss * gas / state.scale.loss_scale
             return state._replace(acc_grads=acc), loss
 
@@ -739,7 +815,8 @@ class DeepSpeedEngine:
 
         def grad_prologue(state):
             """grad_epilogue over the accumulation buffer, which it resets."""
-            acc = state.acc_grads
+            acc = jax.tree.map(lambda a: a.astype(jnp.float32),
+                               state.acc_grads)
             zeros = jax.tree.map(jnp.zeros_like, state.acc_grads)
             state, grads, grad_norm, finite = grad_epilogue(
                 state._replace(acc_grads=zeros), acc)
@@ -799,7 +876,7 @@ class DeepSpeedEngine:
         # gas=1 (the common large-model config): one fused program per
         # global step instead of micro+apply with an HBM acc round-trip
         self._jit_train = None
-        if gas == 1 and not self._offload:
+        if gas == 1 and not self._offload and not cfg.wall_clock_breakdown:
             self._jit_train = jax.jit(
                 fused_train_step, donate_argnums=0,
                 in_shardings=(sh, None, None, None),
@@ -959,10 +1036,16 @@ class DeepSpeedEngine:
         theta = jnp.float32(
             self.progressive_layer_drop.get_theta()
             if self.progressive_layer_drop is not None else 1.0)
+        breakdown = self.wall_clock_breakdown()
+        if breakdown:
+            self.timers(FORWARD_GLOBAL_TIMER).start()
         with self.mesh:
             batch = self._globalize_batch(batch)
             self.state, loss = self._jit_micro(
                 self.state, batch, self._next_rng(), theta)
+        if breakdown:
+            jax.block_until_ready(loss)
+            self.timers(FORWARD_GLOBAL_TIMER).stop()
         self._pending_loss = loss
         self._last_batch = batch
         return loss
@@ -997,12 +1080,38 @@ class DeepSpeedEngine:
     def backward(self, loss=None, allreduce_gradients=True, release_loss=False):
         """Bookkeeping half of the fused forward/backward (see ``forward``)."""
         assert self._pending_loss is not None, "backward() requires a prior forward()"
+        if self.wall_clock_breakdown():
+            self.timers(BACKWARD_GLOBAL_TIMER).start()
+            self.timers(BACKWARD_GLOBAL_TIMER).stop()
         self._pending_loss = None
         self.micro_steps += 1
         return loss
 
     def is_gradient_accumulation_boundary(self):
         return (self.micro_steps % self.gradient_accumulation_steps()) == 0
+
+    def _compute_block_eigenvalues(self):
+        """Per-block loss-Hessian eigenvalue ratios at the current params
+        over the last trained batch (reference engine.py:1891)."""
+        if self._last_batch is None:
+            return {}
+        batch = self._last_batch
+
+        def loss_fn(p):
+            return self._compute_loss(p, batch, jax.random.PRNGKey(0))
+
+        with self.mesh:
+            ev = self.eigenvalue.compute_block_eigenvalues(
+                loss_fn, self.state.params)
+        if ev:
+            blocks = sorted({lid for _, lid in ev.values()})
+            vals = {lid: r for r, lid in ev.values()}
+            if self.monitor.enabled and self.monitor.monitors:
+                # reference scalar names (engine.py:1926-1934)
+                self.monitor.write_events([
+                    (f"Train/Eigenvalues/ModelBlockParam_{i}", vals[i],
+                     self.global_samples) for i in blocks])
+        return ev
 
     def _offload_step(self):
         """Host half of the offloaded step: shard-local CPU-Adam."""
@@ -1022,10 +1131,16 @@ class DeepSpeedEngine:
         (reference engine.step, engine.py:1862)."""
         if not self.is_gradient_accumulation_boundary():
             return
+        breakdown = self.wall_clock_breakdown()
+        if breakdown:
+            self.timers(STEP_GLOBAL_TIMER).start()
         if self._offload:
             grad_norm, overflow = self._offload_step()
         else:
             self.state, grad_norm, overflow = self._jit_apply(self.state)
+        if breakdown:
+            jax.block_until_ready(self.state.step)
+            self.timers(STEP_GLOBAL_TIMER).stop()
         self._post_apply(grad_norm, overflow, lr_kwargs)
 
     def _post_apply(self, grad_norm, overflow, lr_kwargs=None):
@@ -1041,9 +1156,20 @@ class DeepSpeedEngine:
         if self.quantizer is not None:
             # MoQ: progressive fake-quantization of the trained params
             # (reference _take_model_step hook, engine.py:1816-1827 —
-            # skips on overflow so the bit schedule tracks applied steps)
-            quantized = self.quantizer.quantize(self.state.params,
-                                                overflow=overflowed)
+            # skips on overflow so the bit schedule tracks applied steps).
+            # When a precision switch is due and eigenvalue guidance is on,
+            # spend a per-block curvature estimate first (reference
+            # engine.py:1884-1904): its ratios stretch the next period of
+            # sharp (high-curvature) blocks.
+            if (self.eigenvalue is not None
+                    and self.global_steps %
+                    self.eigenvalue.gas_boundary_resolution == 0
+                    and self.quantizer.any_precision_switch()):
+                self.block_eigenvalue = self._compute_block_eigenvalues()
+            quantized = self.quantizer.quantize(
+                self.state.params, overflow=overflowed,
+                eigenvalue_enabled=self.eigenvalue is not None,
+                block_eigenvalue=self.block_eigenvalue)
             if quantized is not self.state.params:
                 self.state = self.state._replace(
                     params=jax.device_put(quantized, self.param_shardings))
@@ -1080,6 +1206,10 @@ class DeepSpeedEngine:
 
     def train_batch(self, data_iter=None, batch=None):
         """One full global step: gas micro-batches + optimizer step."""
+        fp_cfg = self.config.flops_profiler_config
+        profiling = (self.flops_profiler is not None
+                     and self.global_steps == fp_cfg.profile_step)
+        profile_t0 = time.perf_counter() if profiling else 0.0
         self.tput_timer.start()
         if self._jit_train is not None:
             mean_loss = self._fused_train_batch(data_iter, batch)
@@ -1101,6 +1231,35 @@ class DeepSpeedEngine:
         if self.global_steps % self.steps_per_print() == 0:
             log_dist(f"step={self.global_steps} loss={float(mean_loss):.6f} "
                      f"lr={self.get_lr()[0]:.3e}", ranks=[0])
+        if profiling:
+            # one-shot at profile_step (reference engine.py:1722-1952):
+            # attribute the just-traced step's flops per module and print
+            jax.block_until_ready(mean_loss)
+            self.flops_profiler.start_profile()
+            self.flops_profiler._duration = time.perf_counter() - profile_t0
+            self.flops_profiler.print_model_profile(
+                profile_step=fp_cfg.profile_step,
+                module_depth=fp_cfg.module_depth,
+                top_modules=fp_cfg.top_modules,
+                detailed=fp_cfg.detailed,
+                output_file=fp_cfg.output_file)
+            self.flops_profiler.end_profile()
+        if self.wall_clock_breakdown():
+            self._breakdown_steps += 1
+            if self.global_steps % self.steps_per_print() == 0:
+                names = [FORWARD_GLOBAL_TIMER, BACKWARD_GLOBAL_TIMER,
+                         STEP_GLOBAL_TIMER]
+                if self.monitor.enabled and self.monitor.monitors:
+                    means = self.timers.get_mean(
+                        names, normalizer=self._breakdown_steps, reset=False)
+                    # reference scalar names (engine.py:2015-2037)
+                    self.monitor.write_events([
+                        (f"Train/Samples/elapsed_time_ms_{n}", means[n],
+                         self.global_samples) for n in names if n in means])
+                self.timers.log(
+                    names, normalizer=self._breakdown_steps,
+                    memory_breakdown=self.config.memory_breakdown)
+                self._breakdown_steps = 0
         if self.monitor.enabled and self.monitor.monitors:
             # reference scalar names (engine.py:1686/:1911)
             self.monitor.write_events([
@@ -1131,7 +1290,8 @@ class DeepSpeedEngine:
             dataset,
             batch_size=batch_size or per_process,
             shuffle=data_sampler is None,
-            drop_last=True,
+            drop_last=(True if self.config.dataloader_drop_last is None
+                       else self.config.dataloader_drop_last),
             collate_fn=collate_fn or self.collate_fn,
             data_sampler=data_sampler,
             process_index=dist.get_rank(),

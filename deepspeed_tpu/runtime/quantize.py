@@ -1,16 +1,30 @@
 """Quantize-aware training (MoQ).
 
 Rebuild of deepspeed/runtime/quantize.py (``Quantizer`` :12): progressive
-bit-reduction during training, optionally guided by the eigenvalue
-estimate; engine hooks it at the gradient boundary (_take_model_step,
-engine.py:1816-1827). The quantization kernel is
-ops/quantizer/quantizer.py; this class owns the SCHEDULE (period, start
-bits, target bits, mixed fp16/quantized groups) — pure host logic."""
+bit-reduction during training, optionally guided by the per-block
+eigenvalue estimate; engine hooks it at the gradient boundary
+(_take_model_step, engine.py:1816-1827). The quantization kernel is
+ops/quantizer/quantizer.py; this class owns the SCHEDULE (per-block
+period, start bits, target bits, mixed fp16/quantized groups) — pure
+host logic.
+
+Schedule semantics follow the reference's ``compute_quantization``
+(quantize.py:129-157): a block whose step counter reaches its period
+drops one bit and DOUBLES its period (so precision falls fast early,
+slowly near the target); with eigenvalue guidance the new period is
+additionally multiplied by ``1 + floor(curvature_ratio * 4)`` — flat
+blocks (low curvature ratio) re-quantize sooner than sharp ones
+(quantize.py:75-80). ``qsteps`` counts engine steps (the reference
+counts TWO_D_PARAMS * layer_num per step; periods here are in steps).
+"""
+
+import math
 
 import jax
-import jax.numpy as jnp
 
 from deepspeed_tpu.ops.quantizer.quantizer import quantize as quantize_kernel
+from deepspeed_tpu.runtime.eigenvalue import path_str
+from deepspeed_tpu.utils.logging import log_dist
 
 
 class Quantizer:
@@ -18,6 +32,7 @@ class Quantizer:
                  q_type=0, q_rounding=0, q_verbose=False, q_eigenvalue=False,
                  use_quantizer_kernel=True, layer_num=0,
                  q_start_bits=16, q_target_bits=8, q_period=1000):
+        n = layer_num if layer_num != 0 else 1
         self.q_groups = q_groups
         self.q_mixed_fp16 = q_mixed_fp16
         self.q_change_ratio = q_change_ratio
@@ -27,37 +42,81 @@ class Quantizer:
         self.use_eigenvalue = q_eigenvalue
         self.use_quantizer_kernel = use_quantizer_kernel
         self.layer_num = layer_num
-        self.q_start_bits = q_start_bits
+        self.q_start_bits = [q_start_bits] * n
         self.q_target_bits = q_target_bits
-        self.q_period = q_period
+        self.q_period = [q_period] * n
         self.qsteps = 0
         self.quantize_real_ratio = 1.0
+        self._seen_blocks = set()   # block ids that own at least one matrix
 
     def any_precision_switch(self):
-        if self.q_start_bits == self.q_target_bits:
-            return False
-        return (self.qsteps % self.q_period) == 0
+        """True when the NEXT step will drop a bit for some block
+        (reference quantize.py:46-56) — the engine's cue to spend a
+        (costly) eigenvalue computation. Only blocks that actually own a
+        quantized matrix count once known (a layer_num larger than the
+        real layer count would otherwise keep this True forever and the
+        engine would power-iterate the Hessian every step)."""
+        ids = range(len(self.q_start_bits))
+        if self.qsteps > 0:  # after the first pass the real blocks are known
+            ids = self._seen_blocks
+        return any(
+            self.q_start_bits[i] != self.q_target_bits
+            and self.qsteps + 1 >= self.q_period[i]
+            for i in ids)
 
-    def current_bits(self):
-        """Progressive schedule: one bit per period toward the target
-        (reference runtime/quantize.py decrements q_start_bits each
-        period)."""
-        reductions = self.qsteps // self.q_period
-        return max(self.q_target_bits, self.q_start_bits - reductions)
+    def current_bits(self, index=0):
+        return self.q_start_bits[index]
 
-    def quantize(self, parameter_group, overflow=False, eigenvalue_enabled=False,
-                 block_eigenvalue=None):
+    def _maybe_switch(self, index, factor):
+        """Per-block bit drop + period doubling at the period boundary
+        (reference compute_quantization:141-155)."""
+        if (self.q_start_bits[index] != self.q_target_bits
+                and self.qsteps >= self.q_period[index]):
+            self.quantize_real_ratio = 1.0
+            if self.use_eigenvalue:
+                self.q_period[index] = (self.q_period[index] << 1) * factor
+                self.q_start_bits[index] -= 1
+            else:
+                for i in range(len(self.q_start_bits)):
+                    self.q_start_bits[i] -= 1
+                    self.q_period[i] <<= 1
+            if self.q_verbose:
+                log_dist(
+                    f"MoQ: block {index} -> {self.q_start_bits[index]} "
+                    f"bits, next period {self.q_period[index]} "
+                    f"(step {self.qsteps})", ranks=[0])
+
+    def quantize(self, parameter_group, overflow=False,
+                 eigenvalue_enabled=False, block_eigenvalue=None):
         """Fake-quantize a pytree of params in place of the reference's
-        in-place tensor mutation; returns the new pytree."""
+        in-place tensor mutation; returns the new pytree.
+
+        ``block_eigenvalue``: ``{leaf_path: (curvature_ratio, layer_id)}``
+        from ``Eigenvalue.compute_block_eigenvalues`` (paths joined by
+        ``eigenvalue.path_str``). Empty/None falls back to the uniform
+        schedule with every 2D+ param in block 0."""
         if overflow and not eigenvalue_enabled:
             return parameter_group
         self.qsteps += 1
-        bits = self.current_bits()
-        if bits >= 16:
-            return parameter_group
+        block_eigenvalue = block_eigenvalue or {}
 
-        def q(x):
-            if x.ndim < 1 or x.size % self.q_groups:
+        def q(path, x):
+            # reference quantizes only matrices (len(p.size()) > 1)
+            if x.ndim < 2 or x.size % self.q_groups:
+                return x
+            ev, layer_id = block_eigenvalue.get(
+                path_str(path), (None, 0))
+            if layer_id >= len(self.q_start_bits):
+                raise ValueError(
+                    f"MoQ: eigenvalue block id {layer_id} for param "
+                    f"'{path_str(path)}' exceeds the quantizer's "
+                    f"layer_num={self.layer_num}; set eigenvalue."
+                    "layer_num to the model's repeated-layer count")
+            self._seen_blocks.add(layer_id)
+            factor = 1 + math.floor(ev * 4) if ev is not None else 1
+            self._maybe_switch(layer_id, factor)
+            bits = self.q_start_bits[layer_id]
+            if bits >= 16:
                 return x
             ratio = self.quantize_real_ratio
             qx = quantize_kernel(
@@ -68,7 +127,8 @@ class Quantizer:
                 return ratio * x + (1.0 - ratio) * qx
             return qx
 
+        out = jax.tree_util.tree_map_with_path(q, parameter_group)
         if self.q_mixed_fp16:
             self.quantize_real_ratio = max(
                 0.0, self.quantize_real_ratio - self.q_change_ratio)
-        return jax.tree.map(q, parameter_group)
+        return out

@@ -94,6 +94,8 @@ class SynchronizedWallClockTimer:
             if name in self.timers:
                 elapsed_time = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
                 string += " | {}: {:.2f}".format(name, elapsed_time)
+        if memory_breakdown:
+            string += " | " + self.memory_usage()
         log_dist(string, ranks=ranks or [0])
 
     def get_mean(self, names, normalizer=1.0, reset=True):

@@ -114,15 +114,64 @@ def test_activation_checkpointing_matches():
 
 
 def test_moq_progressive_bits():
+    # reference compute_quantization:141-151: a bit drops when qsteps
+    # reaches the period, and the period DOUBLES — switches at steps
+    # 2, 4, 8, 16 for q_period=2
     q = Quantizer(q_groups=1, q_start_bits=16, q_target_bits=8, q_period=2)
     params = {"w": jax.random.normal(jax.random.PRNGKey(0), (4, 64))}
     out = params
     for step in range(17):
         out = q.quantize(out)
-    assert q.current_bits() <= 8
+    assert q.current_bits() == 12
+    assert q.q_period[0] == 32
     # quantized values differ from originals but stay close
     diff = np.abs(np.asarray(out["w"] - params["w"])).max()
     assert 0 < diff < 0.5
+
+
+def test_moq_eigenvalue_period_responds_to_curvature():
+    # reference quantize.py:75-80: factor = 1 + floor(ev_ratio * 4)
+    # multiplies the doubled period — SHARP blocks (ratio→1) wait 5x
+    # longer for their next bit drop than FLAT blocks (ratio→0)
+    q = Quantizer(q_groups=1, q_start_bits=16, q_target_bits=8,
+                  q_period=1, q_eigenvalue=True, layer_num=2)
+    params = {"h_0": {"w": jax.random.normal(jax.random.PRNGKey(0), (4, 8))},
+              "h_1": {"w": jax.random.normal(jax.random.PRNGKey(1), (4, 8))}}
+    block_ev = {"h_0/w": (1.0, 0),   # sharpest block
+                "h_1/w": (0.1, 1)}   # flat block
+    assert q.any_precision_switch()
+    q.quantize(params, eigenvalue_enabled=True, block_eigenvalue=block_ev)
+    assert q.q_start_bits == [15, 15]
+    # period 1 -> (1<<1)*factor: sharp factor 5, flat factor 1
+    assert q.q_period[0] == 10
+    assert q.q_period[1] == 2
+    # the flat block drops its next bit sooner
+    for _ in range(2):
+        q.quantize(params, eigenvalue_enabled=True,
+                   block_eigenvalue=block_ev)
+    assert q.q_start_bits[1] < q.q_start_bits[0]
+
+
+def test_block_eigenvalues_quadratic():
+    # loss = sum over blocks of c_b * |w_b|^2 has Hessian 2*c_b per
+    # block; ratios must order the blocks by curvature
+    from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+
+    params = {"layer_0": {"w": jnp.ones((4, 4))},
+              "layer_1": {"w": jnp.ones((4, 4))}}
+
+    def loss(p):
+        return (3.0 * jnp.sum(p["layer_0"]["w"] ** 2)
+                + 1.0 * jnp.sum(p["layer_1"]["w"] ** 2))
+
+    ev = Eigenvalue(max_iter=20, tol=1e-3, layer_name="layer", layer_num=2)
+    out = ev.compute_block_eigenvalues(loss, params)
+    assert set(out) == {"layer_0/w", "layer_1/w"}
+    r0, lid0 = out["layer_0/w"]
+    r1, lid1 = out["layer_1/w"]
+    assert (lid0, lid1) == (0, 1)
+    assert r0 == pytest.approx(1.0)          # sharpest block normalizes to 1
+    assert r1 == pytest.approx(1.0 / 3.0, rel=1e-2)   # 2*1 / 2*3
 
 
 def test_flops_profiler_counts_matmul():
@@ -245,3 +294,23 @@ def test_public_zero_and_checkpointing_surfaces():
         jnp.ones((4,)))
     assert g.shape == (4,)
     deepspeed_tpu.checkpointing.reset()
+
+
+def test_moq_eigenvalue_guard_rails():
+    # block id beyond layer_num raises a clear error instead of IndexError
+    q = Quantizer(q_groups=1, q_start_bits=12, q_target_bits=8,
+                  q_period=1, q_eigenvalue=True, layer_num=1)
+    params = {"h_0": {"w": jnp.ones((4, 8))}}
+    with pytest.raises(ValueError, match="layer_num"):
+        q.quantize(params, eigenvalue_enabled=True,
+                   block_eigenvalue={"h_0/w": (1.0, 5)})
+    # unseen blocks stop driving any_precision_switch after the 1st pass
+    q2 = Quantizer(q_groups=1, q_start_bits=9, q_target_bits=8,
+                   q_period=1, q_eigenvalue=True, layer_num=4)
+    q2.quantize(params, eigenvalue_enabled=True,
+                block_eigenvalue={"h_0/w": (1.0, 0)})
+    # block 0 reached target-adjacent state; blocks 1-3 never exist
+    q2.quantize(params, eigenvalue_enabled=True,
+                block_eigenvalue={"h_0/w": (1.0, 0)})
+    assert q2.q_start_bits[0] == 8
+    assert not q2.any_precision_switch()

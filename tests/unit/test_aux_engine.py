@@ -6,6 +6,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 import deepspeed_tpu
 
@@ -75,6 +76,61 @@ def test_curriculum_engine_truncates():
         assert np.isfinite(float(loss))
     # after total_curriculum_step the full seqlen is used
     assert engine.curriculum_scheduler.get_current_difficulty() == 16
+
+
+def test_eigenvalue_moq_engine():
+    """eigenvalue.enabled constructs the estimator, feeds per-block
+    curvature into the quantizer at precision switches, and the block
+    periods diverge by curvature (reference engine.py:316/:1891)."""
+    from deepspeed_tpu.models.simple import SimpleModel, sample_batch
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=16, nlayers=2),
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                "quantize_training": {
+                    "enabled": True,
+                    "quantize_bits": {"start_bits": 12, "target_bits": 8},
+                    "quantize_schedule": {"quantize_period": 1},
+                    "quantize_groups": 1},
+                "eigenvalue": {
+                    "enabled": True, "verbose": False, "max_iter": 10,
+                    "tol": 1e-2, "stability": 1e-6,
+                    "gas_boundary_resolution": 1,
+                    "layer_name": "Dense", "layer_num": 2}},
+        sample_batch=sample_batch(4, 16), seed=0)
+    assert engine.eigenvalue is not None
+    assert engine.quantizer.use_eigenvalue
+    assert engine.quantizer.layer_num == 2
+    rng = np.random.default_rng(0)
+
+    def batch():
+        return (rng.standard_normal((8, 16)).astype(np.float32),
+                rng.standard_normal((8, 16)).astype(np.float32))
+
+    for _ in range(3):
+        loss = engine.train_batch(batch=batch())
+        assert np.isfinite(float(loss))
+    # a precision switch happened, so curvature was computed per block...
+    assert set(engine.block_eigenvalue) == {
+        "Dense_0/bias", "Dense_0/kernel",
+        "Dense_1/bias", "Dense_1/kernel"}
+    ratios = {lid: r for r, lid in engine.block_eigenvalue.values()}
+    assert max(ratios.values()) == pytest.approx(1.0)
+    # ...and the per-block schedule consumed it: periods grew from the
+    # initial 1 by the eigenvalue factor (1 + floor(ratio*4))
+    assert all(p >= 2 for p in engine.quantizer.q_period)
+    assert engine.quantizer.q_start_bits[0] < 12
+
+
+def test_eigenvalue_without_moq_rejected():
+    from deepspeed_tpu.models.simple import SimpleModel, sample_batch
+    with pytest.raises(ValueError, match="eigenvalue"):
+        deepspeed_tpu.initialize(
+            model=SimpleModel(hidden_dim=16, nlayers=2),
+            config={"train_batch_size": 8,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                    "eigenvalue": {"enabled": True}},
+            sample_batch=sample_batch(4, 16), seed=0)
 
 
 def test_moq_engine():

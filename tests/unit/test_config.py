@@ -184,3 +184,34 @@ class TestSubConfigs:
             "schedule_config": {"total_curriculum_step": 40000, "difficulty_step": 8}}))
         assert cfg.curriculum_enabled
         assert cfg.curriculum_config.params["curriculum_type"] == "seqlen"
+
+
+class TestNoSilentNoOp:
+    """Keys whose reference mechanism has no XLA counterpart must be
+    rejected off-default, never silently parsed (build rule, also applied
+    at deepspeed_tpu/__init__.py pipeline/offload dispatch)."""
+
+    @pytest.mark.parametrize("over", [
+        {"amp": {"enabled": True}},
+        {"prescale_gradients": True},
+        {"gradient_predivide_factor": 2.0},
+        {"disable_allgather": True},
+        {"communication_data_type": "fp16"},
+        {"optimizer": {"type": "Adam", "legacy_fusion": True,
+                       "params": {"lr": 1e-3}}},
+        {"fp16": {"enabled": True,
+                  "fp16_master_weights_and_grads": True}},
+        {"gradient_accumulation_dtype": "fp8"},
+    ])
+    def test_rejected(self, over):
+        with pytest.raises(DeepSpeedConfigError):
+            DeepSpeedConfig(basic(**over))
+
+    def test_defaults_still_parse(self):
+        cfg = DeepSpeedConfig(basic())
+        assert cfg.gradient_predivide_factor == 1.0
+        assert cfg.gradient_accumulation_dtype is None
+
+    def test_grad_accum_dtype_accepted(self):
+        cfg = DeepSpeedConfig(basic(gradient_accumulation_dtype="bf16"))
+        assert cfg.gradient_accumulation_dtype == "bf16"
