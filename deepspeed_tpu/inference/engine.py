@@ -35,7 +35,19 @@ class InferenceEngine:
         self.module = model
         self.mp_world_size = mp_size
         self.checkpoint = checkpoint
+        # dtype=int8 (reference init_inference(dtype=torch.int8)) selects
+        # TRUE int8 weight storage: transformer kernels live in HBM as
+        # int8 + per-column scales and dequantize inside the matmul
+        # (module_inject/module_quantize.py); compute stays bf16
+        try:
+            self._int8_weights = (dtype is not None
+                                  and np.dtype(dtype) == np.int8)
+        except TypeError:
+            self._int8_weights = False
+        if self._int8_weights:
+            dtype = jnp.bfloat16
         self.dtype = dtype or jnp.bfloat16
+        self.quant_scales = None
         self.injection_dict = injection_dict
         self.quantization_setting = quantization_setting
         # MoE inference (reference inference/engine.py:146
@@ -72,16 +84,28 @@ class InferenceEngine:
             lambda x: x.astype(self.dtype)
             if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
             else x, params)
+        if self._int8_weights:
+            if apply_fn is not None:
+                raise ValueError(
+                    "dtype=int8 quantizes kernels and threads a "
+                    "'quant_scales' collection through module.apply; a "
+                    "custom apply_fn would bypass it and QuantDense would "
+                    "fail — drop apply_fn or quantize explicitly via "
+                    "module_inject.quantize_transformer_layer")
+            from deepspeed_tpu.module_inject.module_quantize import \
+                quantize_transformer_layer
+            params, self.quant_scales = quantize_transformer_layer(params)
         shardings = build_param_shardings(params, self.mesh, stage=0,
                                           mp_rules=self.mp_rules)
         with self.mesh:
             self.params = jax.device_put(params, shardings)
+            if self.quant_scales is not None:
+                # per-output-column fp32 vectors: tiny; replicated
+                self.quant_scales = jax.device_put(self.quant_scales)
 
         self._user_apply = apply_fn
         self._apply = apply_fn or (
-            lambda p, batch: self.module.apply(
-                p if isinstance(p, dict) and "params" in p else {"params": p},
-                batch))
+            lambda p, batch: self.module.apply(self._wrap(p), batch))
         self._jit_forward = jax.jit(self._apply)
         self._gen_cache = {}  # (temperature, eos) -> compiled decode loop
         log_dist(f"InferenceEngine ready: mp={mp_size} "
@@ -214,7 +238,10 @@ class InferenceEngine:
                                         eos_token_id)
 
     def _wrap(self, p):
-        return p if isinstance(p, dict) and "params" in p else {"params": p}
+        out = p if isinstance(p, dict) and "params" in p else {"params": p}
+        if self.quant_scales is not None and "quant_scales" not in out:
+            out = {**out, "quant_scales": self.quant_scales}
+        return out
 
     def _sample(self, last, rng, temperature):
         # Megatron-style padded vocab: rows >= vocab_size exist only for

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from deepspeed_tpu.ops.quantizer.int8_linear import QuantDense
 from deepspeed_tpu.ops.transformer.attention import attention
 
 
@@ -106,8 +107,8 @@ class CausalSelfAttention(nn.Module):
         cfg = self.config
         B, S, E = x.shape
         H, D = cfg.n_head, E // cfg.n_head
-        qkv = nn.Dense(3 * E, name="qkv",
-                       kernel_init=nn.initializers.normal(0.02))(x)
+        qkv = QuantDense(3 * E, name="qkv",
+                         kernel_init=nn.initializers.normal(0.02))(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q = q.reshape(B, S, H, D).transpose(0, 2, 1, 3)
         k = k.reshape(B, S, H, D).transpose(0, 2, 1, 3)
@@ -198,9 +199,9 @@ class CausalSelfAttention(nn.Module):
         else:
             out = attention(q, k, v, causal=True, use_flash=cfg.use_flash)
         out = out.transpose(0, 2, 1, 3).reshape(B, S, E)
-        out = nn.Dense(E, name="proj",
-                       kernel_init=nn.initializers.normal(
-                           0.02 / np.sqrt(2 * cfg.n_layer)))(out)
+        out = QuantDense(E, name="proj",
+                         kernel_init=nn.initializers.normal(
+                             0.02 / np.sqrt(2 * cfg.n_layer)))(out)
         if cfg.dropout > 0:
             out = nn.Dropout(cfg.dropout)(out, deterministic=deterministic)
         return out
@@ -213,12 +214,12 @@ class MLP(nn.Module):
     def __call__(self, x, deterministic=True):
         cfg = self.config
         E = x.shape[-1]
-        h = nn.Dense(4 * E, name="fc",
-                     kernel_init=nn.initializers.normal(0.02))(x)
+        h = QuantDense(4 * E, name="fc",
+                       kernel_init=nn.initializers.normal(0.02))(x)
         h = nn.gelu(h, approximate=True)
-        h = nn.Dense(E, name="proj",
-                     kernel_init=nn.initializers.normal(
-                         0.02 / np.sqrt(2 * cfg.n_layer)))(h)
+        h = QuantDense(E, name="proj",
+                       kernel_init=nn.initializers.normal(
+                           0.02 / np.sqrt(2 * cfg.n_layer)))(h)
         if cfg.dropout > 0:
             h = nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
         return h

@@ -14,3 +14,7 @@ from deepspeed_tpu.module_inject.replace_module import (  # noqa: F401
     revert_transformer_layer,
     tensor_slicing_rules,
 )
+from deepspeed_tpu.module_inject.module_quantize import (  # noqa: F401
+    dequantize_transformer_layer,
+    quantize_transformer_layer,
+)

@@ -1,0 +1,103 @@
+"""Module-level weight quantization for inference (MoQ int8).
+
+Rebuild of deepspeed/module_inject/module_quantize.py:6
+(``quantize_transformer_layer``), which walks a model and casts each
+transformer layer's four matmul weights (qkv, attn-out, mlp-in, mlp-out)
+to int8 in place. Flax separates params from modules, so the TPU form
+walks the PARAMS pytree: matched kernels are replaced by true int8
+arrays (4x smaller in HBM than fp32) plus a parallel ``quant_scales``
+collection holding one fp32 scale per output column. The model consumes
+them through ``QuantDense`` (ops/quantizer/int8_linear.py), which folds
+the dequant into the matmul — the analogue of the reference's
+dequantize-inside-GEMM inference kernels
+(csrc/transformer/inference/csrc/dequantize.cu).
+"""
+
+import re
+
+import jax
+
+from deepspeed_tpu.ops.quantizer.int8_linear import (
+    dequantize_weight_int8, quantize_weight_int8)
+from deepspeed_tpu.runtime.eigenvalue import path_str
+
+# the four transformer matmuls, GPT-2 naming + DeepSpeedTransformerLayer
+# naming (reference megatron_layer_quantize / bert_layer_quantize)
+DEFAULT_PATTERNS = (
+    r"(^|/)h_\d+/(attn/(qkv|proj)|mlp/(fc|proj))/kernel$",
+    r"(^|/)(attn_qkv|attn_out)/kernel$",
+    r"(^|/)(inter_w|output_w/kernel)$",
+)
+
+
+def _set_by_path(tree, segs, leaf):
+    node = tree
+    for s in segs[:-1]:
+        node = node.setdefault(s, {})
+    node[segs[-1]] = leaf
+
+
+def quantize_transformer_layer(params, patterns=DEFAULT_PATTERNS, bits=8):
+    """Quantize matched transformer weights to TRUE int8 storage.
+
+    Returns ``(new_params, quant_scales)``: ``new_params`` is ``params``
+    with matched 2D kernels replaced by int8 arrays; ``quant_scales``
+    mirrors the module hierarchy with a ``kernel_scale`` leaf per
+    quantized kernel — pass it as the ``quant_scales`` collection to
+    ``module.apply`` (the InferenceEngine does this automatically).
+    """
+    if bits != 8:
+        raise ValueError(
+            f"module-level weight quantization stores int8 (got bits="
+            f"{bits}); sub-8-bit TRAINING schedules are runtime/quantize.py")
+    regexes = [re.compile(p) for p in patterns]
+    scales = {}
+    n = 0
+
+    def q(path, x):
+        nonlocal n
+        joined = path_str(path)
+        if (getattr(x, "ndim", 0) == 2
+                and any(r.search(joined) for r in regexes)):
+            wq, scale = quantize_weight_int8(x)
+            segs = joined.split("/")
+            _set_by_path(scales, segs[:-1] + ["kernel_scale"], scale)
+            n += 1
+            return wq
+        return x
+
+    new_params = jax.tree_util.tree_map_with_path(q, params)
+    if n == 0:
+        raise ValueError(
+            "quantize_transformer_layer matched no kernels; pass patterns "
+            "for this model's layer naming (default matches GPT-2 blocks "
+            "and DeepSpeedTransformerLayer)")
+    return new_params, scales
+
+
+def dequantize_transformer_layer(params, quant_scales, dtype=None):
+    """Revert: int8 kernels back to float using the stored scales
+    (reference revert path; exact inverse of the stored representation)."""
+    import jax.numpy as jnp
+    dtype = dtype or jnp.float32
+
+    def flatten(tree, prefix=()):
+        out = {}
+        for k, v in tree.items():
+            if isinstance(v, dict):
+                out.update(flatten(v, prefix + (k,)))
+            else:
+                out[prefix + (k,)] = v
+        return out
+
+    scale_by_dir = {segs[:-1]: s for segs, s in flatten(quant_scales).items()}
+
+    def dq(path, x):
+        if getattr(x, "dtype", None) == jnp.int8:
+            segs = tuple(path_str(path).split("/"))
+            scale = scale_by_dir.get(segs[:-1])
+            if scale is not None:
+                return dequantize_weight_int8(x, scale, dtype)
+        return x
+
+    return jax.tree_util.tree_map_with_path(dq, params)

@@ -34,6 +34,15 @@ class AsyncIOHandle:
     def get_thread_count(self):
         return self._thread_count
 
+    def kernel_aio_available(self, probe_dir=None):
+        """True when transfers (for files under ``probe_dir``) run through
+        the kernel io_submit engine (csrc/aio.cpp kernel_aio_rw); False =
+        thread-pool pread/pwrite fallback. Probes BOTH io_setup and an
+        O_DIRECT open in probe_dir (tmpfs/overlayfs reject O_DIRECT even
+        where io_setup works); probe_dir=None checks io_setup only."""
+        d = probe_dir.encode() if probe_dir is not None else None
+        return bool(self.lib.aio_kernel_available(d))
+
     def sync_pread(self, buffer: np.ndarray, path: str, offset=0):
         n = self.lib.aio_sync_pread(self.handle, _buf(buffer),
                                     path.encode(), buffer.nbytes, offset)

@@ -138,6 +138,8 @@ class AsyncIOBuilder(CPUOpBuilder):
         lib.aio_wait.restype = i64
         lib.aio_pending.argtypes = [i64]
         lib.aio_pending.restype = i64
+        lib.aio_kernel_available.argtypes = [ctypes.c_char_p]
+        lib.aio_kernel_available.restype = ctypes.c_int
 
 
 ALL_OPS = {

@@ -1,0 +1,82 @@
+"""True int8 weight storage with dequant-in-matmul.
+
+The inference half of MoQ (reference module_inject/module_quantize.py:6
+casts transformer layer weights to int8 in place;
+csrc/transformer/inference/csrc/dequantize.cu dequantizes inside the
+GEMM). TPU form: weights live in HBM as int8 (4x smaller than fp32) with
+one fp32 scale per OUTPUT column; the matmul upcasts the int8 block to
+the activation dtype on the fly — int8 magnitudes (<=127) are exact in
+bfloat16, so ``(x @ w_int8) * scale`` loses nothing over dequantizing
+first, and the MXU sees its native bf16 operands. The per-column scale
+folds into the matmul epilogue (one multiply per output element, fused
+by XLA).
+"""
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def quantize_weight_int8(w):
+    """[in, out] float weight -> (int8 weight, fp32 [out] scales).
+
+    Per-output-column absmax: column j is stored as
+    round(w[:, j] / scale_j) with scale_j = absmax_j / 127. Column-wise
+    (not row/group-wise) so the scale applies AFTER the contraction."""
+    absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=0)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    wq = jnp.clip(jnp.round(w.astype(jnp.float32) / scale),
+                  -127, 127).astype(jnp.int8)
+    return wq, scale
+
+
+def dequantize_weight_int8(wq, scale, dtype=jnp.float32):
+    return (wq.astype(jnp.float32) * scale).astype(dtype)
+
+
+def int8_matmul(x, w_int8, scale, bias=None):
+    """x @ dequant(w_int8) with the dequant folded into the matmul."""
+    y = x @ w_int8.astype(x.dtype)
+    y = y * scale.astype(y.dtype)
+    if bias is not None:
+        y = y + bias.astype(y.dtype)
+    return y
+
+
+class QuantDense(nn.Module):
+    """Drop-in ``nn.Dense`` that transparently consumes int8 kernels.
+
+    Param tree is identical to nn.Dense (kernel/bias under the module
+    name), so swapping the class changes no checkpoints. When the kernel
+    leaf has been replaced post-load by ``module_quantize`` (dtype int8)
+    the per-column scale is read from the sibling ``quant_scales``
+    collection and the forward runs dequant-in-matmul; float kernels take
+    the ordinary path."""
+    features: int
+    use_bias: bool = True
+    dtype: Optional[Any] = None
+    kernel_init: Callable = nn.initializers.lecun_normal()
+    bias_init: Callable = nn.initializers.zeros
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param("kernel", self.kernel_init,
+                            (x.shape[-1], self.features))
+        bias = (self.param("bias", self.bias_init, (self.features,))
+                if self.use_bias else None)
+        if kernel.dtype == jnp.int8:
+            if not self.has_variable("quant_scales", "kernel_scale"):
+                raise ValueError(
+                    f"QuantDense {self.name}: int8 kernel but no "
+                    "'quant_scales'/'kernel_scale' variable — pass the "
+                    "scales tree from module_quantize alongside params")
+            scale = self.get_variable("quant_scales", "kernel_scale")
+            return int8_matmul(x, kernel, scale, bias)
+        if self.dtype is not None:
+            x = x.astype(self.dtype)
+            kernel = kernel.astype(self.dtype)
+        y = x @ kernel
+        if bias is not None:
+            y = y + bias.astype(y.dtype)
+        return y

@@ -25,7 +25,7 @@ from typing import Optional
 
 import jax
 
-_CONFIG = {
+_DEFAULTS = {
     "partition_activations": False,
     "contiguous_memory_optimization": False,
     "cpu_checkpointing": False,
@@ -34,16 +34,19 @@ _CONFIG = {
     "profile": False,
     "num_checkpoints": None,
 }
+_CONFIG = dict(_DEFAULTS)
 
 _mpu = None
+_configured = False
 
 
 def configure(mpu_=None, deepspeed_config=None, partition_activations=None,
               contiguous_checkpointing=None, num_checkpoints=None,
               checkpoint_in_cpu=None, synchronize=None, profile=None):
     """Reference checkpointing.py:906 — store the knobs."""
-    global _mpu
+    global _mpu, _configured
     _mpu = mpu_
+    _configured = True
     if deepspeed_config is not None:
         acfg = getattr(deepspeed_config, "activation_checkpointing_config",
                        None)
@@ -70,29 +73,64 @@ def configure(mpu_=None, deepspeed_config=None, partition_activations=None,
 
 
 def is_configured():
-    return True
+    """True once ``configure`` has run (reference checkpointing.py:928)."""
+    return _configured
 
 
 def _policy():
-    """Map the configured knobs to a jax.checkpoint policy."""
+    """Map the configured knobs to a jax.checkpoint policy.
+
+    ``jax.checkpoint`` with no policy already recomputes every
+    intermediate (only the segment INPUTS are kept alive for the
+    backward) — the reference's base checkpointing semantics."""
     cp = jax.checkpoint_policies
     if _CONFIG["cpu_checkpointing"] or _CONFIG["checkpoint_in_cpu"]:
         # save matmul outputs but keep them in host memory
         if hasattr(cp, "offload_dot_with_no_batch_dims"):
             return cp.offload_dot_with_no_batch_dims("device", "pinned_host")
         return cp.nothing_saveable
-    if _CONFIG["partition_activations"]:
-        # save only what is cheap per-shard; everything else recomputes —
-        # the spiritual analogue of slicing saved activations across MP
-        # ranks (reference :367): memory per device scales down with MP
-        return cp.nothing_saveable
-    return None  # default: save everything jax deems profitable
+    return None
+
+
+def _partition_args(args):
+    """partition_activations (reference :367): each MP rank stores only
+    its 1/mp slice of the saved segment inputs, allgathered on backward.
+
+    The XLA form: constrain every tensor input of the checkpointed
+    segment to be sharded over the 'model' mesh axis (last dim). The
+    saved residual then lives sharded — per-device activation memory
+    scales down with mp — and XLA inserts the all-gather where the
+    recompute consumes it, exactly the reference's gather-on-backward."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    try:
+        from deepspeed_tpu.utils import groups
+        mesh = groups.get_mesh()   # raises when groups not initialized
+    except Exception:
+        return args
+    if mesh is None or "model" not in mesh.axis_names:
+        return args
+    mp = mesh.shape["model"]
+    if mp == 1:
+        return args
+
+    def constrain(x):
+        if (hasattr(x, "ndim") and x.ndim >= 1
+                and x.shape[-1] % mp == 0):
+            spec = P(*([None] * (x.ndim - 1)), "model")
+            return jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, spec))
+        return x
+
+    return jax.tree.map(constrain, args)
 
 
 def checkpoint(function, *args):
     """Checkpoint a forward function (reference :748): returns
     function(*args) with recompute-in-backward semantics."""
     policy = _policy()
+    if _CONFIG["partition_activations"]:
+        args = _partition_args(args)
     if policy is None:
         fn = jax.checkpoint(function)
     else:
@@ -102,10 +140,12 @@ def checkpoint(function, *args):
 
 def checkpoint_wrapper(function):
     """Decorator form used by model code."""
-    policy = _policy()
-    if policy is None:
-        return jax.checkpoint(function)
-    return jax.checkpoint(function, policy=policy)
+    import functools
+
+    @functools.wraps(function)
+    def wrapped(*args):
+        return checkpoint(function, *args)
+    return wrapped
 
 
 # ---- reference API stubs that are no-ops under jax's functional PRNG ----
@@ -120,4 +160,9 @@ def model_parallel_cuda_manual_seed(seed):  # pragma: no cover
 
 
 def reset():
-    return None
+    """Restore the unconfigured default state (reference :941)."""
+    global _mpu, _configured
+    _CONFIG.clear()
+    _CONFIG.update(_DEFAULTS)
+    _mpu = None
+    _configured = False

@@ -1,0 +1,120 @@
+"""Module-level int8 weight quantization + dequant-in-matmul.
+
+Reference: deepspeed/module_inject/module_quantize.py:6 (in-place int8
+cast of transformer layer weights) and the inference dequantize-in-GEMM
+kernels (csrc/transformer/inference/csrc/dequantize.cu).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel, synthetic_batch
+from deepspeed_tpu.module_inject import (dequantize_transformer_layer,
+                                         quantize_transformer_layer)
+from deepspeed_tpu.ops.quantizer.int8_linear import (int8_matmul,
+                                                     quantize_weight_int8)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = GPT2Config(vocab_size=128, n_positions=64, n_embd=32,
+                     n_layer=2, n_head=2)
+    model = GPT2LMHeadModel(cfg)
+    batch = synthetic_batch(2, 16, cfg.vocab_size)
+    variables = model.init(jax.random.PRNGKey(0), batch)
+    return cfg, model, variables["params"], batch
+
+
+class TestInt8Op:
+    def test_matmul_parity(self):
+        w = jax.random.normal(jax.random.PRNGKey(0), (64, 32)) * 0.1
+        x = jax.random.normal(jax.random.PRNGKey(1), (8, 64))
+        wq, s = quantize_weight_int8(w)
+        assert wq.dtype == jnp.int8
+        y = int8_matmul(x, wq, s)
+        ref = x @ w
+        # int8 per-column: ~0.4% worst-case weight error
+        err = np.abs(np.asarray(y - ref)).max()
+        assert err < 0.02 * np.abs(np.asarray(ref)).max() + 1e-3
+
+    def test_column_scales_exact_at_extremes(self):
+        w = jnp.array([[127.0, -1.0], [-127.0, 0.0]])
+        wq, s = quantize_weight_int8(w)
+        back = wq.astype(jnp.float32) * s
+        np.testing.assert_allclose(np.asarray(back), np.asarray(w),
+                                   rtol=1e-6)
+
+
+class TestQuantizeTransformerLayer:
+    def test_kernels_become_int8_and_memory_shrinks(self, tiny):
+        _, _, params, _ = tiny
+        qp, scales = quantize_transformer_layer(params)
+        int8_leaves = [x for x in jax.tree.leaves(qp)
+                       if x.dtype == jnp.int8]
+        # 2 layers x (qkv, attn proj, fc, mlp proj)
+        assert len(int8_leaves) == 8
+        before = sum(x.nbytes for x in jax.tree.leaves(params))
+        after = sum(x.nbytes for x in jax.tree.leaves(qp)) + \
+            sum(x.nbytes for x in jax.tree.leaves(scales))
+        assert after < 0.7 * before
+        # scales mirror the module hierarchy
+        assert "kernel_scale" in scales["h_0"]["attn"]["qkv"]
+
+    def test_dequantize_roundtrip(self, tiny):
+        _, _, params, _ = tiny
+        qp, scales = quantize_transformer_layer(params)
+        back = dequantize_transformer_layer(qp, scales)
+        w = params["h_0"]["mlp"]["fc"]["kernel"]
+        wb = back["h_0"]["mlp"]["fc"]["kernel"]
+        assert wb.dtype == jnp.float32
+        err = np.abs(np.asarray(w - wb)).max()
+        assert err <= np.abs(np.asarray(w)).max() / 127 + 1e-7
+
+    def test_no_match_raises(self):
+        with pytest.raises(ValueError, match="matched no kernels"):
+            quantize_transformer_layer({"dense": {"kernel": jnp.ones((4, 4))}})
+
+    def test_logits_parity_8bit_vs_fp32(self, tiny):
+        cfg, model, params, batch = tiny
+        ref = model.apply({"params": params}, batch, return_logits=True)
+        qp, scales = quantize_transformer_layer(params)
+        q = model.apply({"params": qp, "quant_scales": scales}, batch,
+                        return_logits=True)
+        ref_n = np.asarray(ref, np.float32)
+        q_n = np.asarray(q, np.float32)
+        # 8-bit weights: logits track fp32 closely (reference MoQ claim:
+        # accuracy-neutral int8 inference)
+        cos = np.sum(ref_n * q_n) / (np.linalg.norm(ref_n)
+                                     * np.linalg.norm(q_n))
+        assert cos > 0.999, cos
+        assert np.abs(q_n - ref_n).max() < 0.05 * np.abs(ref_n).max() + 0.05
+
+    def test_int8_kernel_without_scales_raises(self, tiny):
+        _, model, params, batch = tiny
+        qp, _ = quantize_transformer_layer(params)
+        with pytest.raises(ValueError, match="quant_scales"):
+            model.apply({"params": qp}, batch, return_logits=True)
+
+
+class TestInferenceEngineInt8:
+    def test_generate_matches_fp32_greedy(self, tiny):
+        import deepspeed_tpu
+        cfg, model, params, _ = tiny
+        prompt = np.array([[5, 7, 11, 13]], np.int32)
+        outs = {}
+        for name, dtype in [("fp32", jnp.float32), ("int8", jnp.int8)]:
+            eng = deepspeed_tpu.init_inference(
+                model, mp_size=1, dtype=dtype, params=params)
+            if name == "int8":
+                assert eng.quant_scales is not None
+                n_int8 = sum(x.dtype == jnp.int8
+                             for x in jax.tree.leaves(eng.params))
+                assert n_int8 == 8
+            outs[name] = np.asarray(eng.generate(
+                prompt, max_new_tokens=8, temperature=0.0))
+            from deepspeed_tpu.utils import groups
+            groups.destroy()
+        # greedy decode is robust to 8-bit weight error on a tiny model
+        assert (outs["fp32"] == outs["int8"]).mean() > 0.7

@@ -105,3 +105,94 @@ def test_tensor_swapper_tree_roundtrip(tmp_path):
     back = sw.swap_in_tree(tree)
     np.testing.assert_array_equal(back["mu"]["w"], tree["mu"]["w"])
     np.testing.assert_array_equal(back["nu"]["w"], tree["nu"]["w"])
+
+
+@pytest.mark.parametrize("single_submit,overlap_events",
+                         [(False, True), (True, True),
+                          (False, False), (True, False)])
+def test_aio_kernel_strategies_roundtrip(tmp_path, single_submit,
+                                         overlap_events):
+    """All four submit/reap strategies of the kernel io_submit engine
+    (reference deepspeed_aio_common.cpp:69 sequential / :121 overlap,
+    single vs batched io_submit) move the same bytes — including an
+    unaligned tail that takes the buffered path."""
+    from deepspeed_tpu.ops.aio.aio_handle import AsyncIOHandle
+    h = AsyncIOHandle(block_size=1 << 16, queue_depth=4,
+                      single_submit=single_submit,
+                      overlap_events=overlap_events)
+    rng = np.random.default_rng(0)
+    arr = rng.integers(0, 256, size=(1 << 20) + 777, dtype=np.uint8)
+    path = str(tmp_path / "strat.bin")
+    assert h.sync_pwrite(arr, path) == arr.nbytes
+    out = np.zeros_like(arr)
+    assert h.sync_pread(out, path) == arr.nbytes
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_aio_forced_fallback_matches(tmp_path, monkeypatch):
+    from deepspeed_tpu.ops.aio.aio_handle import AsyncIOHandle
+    monkeypatch.setenv("DS_AIO_DISABLE_KERNEL", "1")
+    h = AsyncIOHandle()
+    assert not h.kernel_aio_available()
+    arr = np.arange(123457, dtype=np.uint8) % 251
+    path = str(tmp_path / "fb.bin")
+    h.sync_pwrite(arr, path)
+    out = np.zeros_like(arr)
+    h.sync_pread(out, path)
+    np.testing.assert_array_equal(out, arr)
+
+
+@pytest.mark.slow
+def test_aio_kernel_beats_threadpool(tmp_path, monkeypatch):
+    """The reason kernel AIO exists (reference csrc/aio/common/
+    deepspeed_aio_common.cpp:69-216): queue_depth in-flight O_DIRECT
+    blocks beat threaded pread. Skipped where io_setup is unavailable.
+
+    NOTE on the assertion bound: on this VM the hypervisor caches virtio
+    reads, so a buffered pread after drop_caches can still be served from
+    HOST RAM at ~2.5 GB/s while O_DIRECT honestly hits the device — an
+    A/B here measures the hypervisor, not the engine. Under a cold host
+    cache the measured ratio was 5.8x write / 9.9x read (PERF.md, aio
+    row); this test only guards against the kernel engine being BROKEN
+    (an order of magnitude slower than the fallback)."""
+    import time
+    from deepspeed_tpu.ops.aio.aio_handle import AsyncIOHandle
+    probe = AsyncIOHandle()
+    if not probe.kernel_aio_available(str(tmp_path)):
+        pytest.skip("kernel AIO unavailable here (io_setup or O_DIRECT)")
+
+    def drop_caches():
+        # a buffered pread of a cached file measures RAM, not the device;
+        # posix_fadvise(DONTNEED) proved unreliable here, so use the real
+        # thing and skip where we can't
+        try:
+            os.system("sync")
+            with open("/proc/sys/vm/drop_caches", "w") as f:
+                f.write("3")
+        except OSError:
+            pytest.skip("cannot drop page cache (not root)")
+    n = 64 * (1 << 20)
+    arr = np.frombuffer(np.random.bytes(n), np.uint8).copy()
+    out = np.zeros_like(arr)
+
+    def read_bw(env):
+        if env:
+            monkeypatch.setenv("DS_AIO_DISABLE_KERNEL", "1")
+        else:
+            monkeypatch.delenv("DS_AIO_DISABLE_KERNEL", raising=False)
+        h = AsyncIOHandle(block_size=1 << 20, queue_depth=32)
+        path = str(tmp_path / f"bw{env}.bin")
+        h.sync_pwrite(arr, path)
+        best = 0.0
+        for _ in range(3):  # best-of-3: the shared 1-core host is noisy
+            drop_caches()
+            t0 = time.perf_counter()
+            h.sync_pread(out, path)
+            best = max(best, n / (time.perf_counter() - t0))
+        return best
+
+    kernel = read_bw(False)
+    pool = read_bw(True)
+    print(f"\naio read bandwidth: kernel {kernel / 1e6:.0f} MB/s, "
+          f"threadpool {pool / 1e6:.0f} MB/s")
+    assert kernel > 0.3 * pool, (kernel / 1e6, pool / 1e6)
