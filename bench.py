@@ -148,10 +148,15 @@ def main():
         cfg = BERT_PRESETS["bert-large"]
         model = BertForPreTraining(cfg)
         optimizer = {"type": "Lamb", "params": {"lr": 1e-4, "fused": True}}
+        # BENCH_MLM=masked: the reference pretraining data format
+        # (max_predictions_per_seq gathered positions) — the MLM head runs
+        # on P<<S positions instead of the full sequence
+        masked_fmt = os.environ.get("BENCH_MLM", "").lower() == "masked"
 
         def make_batch(seed):
             return synthetic_mlm_batch(batch_size, seq_len, cfg.vocab_size,
-                                       seed=seed)
+                                       seed=seed,
+                                       masked_positions_format=masked_fmt)
     else:
         cfg = (PRESETS[name] if name in PRESETS else
                GPT2Config(vocab_size=2048, n_positions=256, n_embd=128,
@@ -174,6 +179,8 @@ def main():
 
     groups.destroy()
     groups.initialize()
+    offload_mode = os.environ.get("BENCH_OFFLOAD", "").lower()
+    layered = offload_mode == "layered"
     ds_config = {
         "train_batch_size": batch_size,
         "train_micro_batch_size_per_gpu": batch_size // max(
@@ -183,27 +190,49 @@ def main():
         "zero_optimization": {"stage": zero_stage},
         "bf16": {"enabled": True},
     }
-    if os.environ.get("BENCH_OFFLOAD", "").lower() in ("1", "true", "yes"):
+    if layered:
+        # beyond-HBM training: params streamed from host RAM layer by
+        # layer (Zero3OffloadEngine) — the only way 1.5B+ params train on
+        # this one chip (PERF.md: monolithic gpt2-xl hard-OOMs at 22.8 GB)
+        assert name.startswith("gpt2"), "layered offload bench is GPT-2"
+        ds_config["zero_optimization"] = {
+            "stage": 3, "offload_param": {"device": "cpu"}}
+        from deepspeed_tpu.models.gpt2 import gpt2_offload_layers
+        model = gpt2_offload_layers(cfg)
+    elif offload_mode in ("1", "true", "yes"):
         ds_config["zero_optimization"]["offload_optimizer"] = {"device": "cpu"}
 
+    init_kw = dict(model=model, config=ds_config, sample_batch=make_batch(0))
+    if layered:
+        init_kw["input_fn"] = lambda b: b["input_ids"]
     engine, _, _, _ = _retry(
-        lambda: deepspeed_tpu.initialize(
-            model=model, config=ds_config,
-            sample_batch=make_batch(0)),
-        "engine init")
-    n_params = sum(x.size for x in jax.tree.leaves(engine.state.params))
+        lambda: deepspeed_tpu.initialize(**init_kw), "engine init")
+    if layered:
+        st = engine.store
+        n_params = sum(h.size for i in range(len(engine.layers))
+                       for h in st.host_leaves(i))
+    else:
+        n_params = sum(x.size for x in jax.tree.leaves(engine.state.params))
 
     batch = make_batch(1)
 
     # jax.block_until_ready is NOT a reliable barrier through the axon
     # tunnel (it returned immediately in round 3, inflating TFLOPS 5x);
     # transferring a scalar out of the final state forces completion of
-    # the whole dispatched chain.
+    # the whole dispatched chain. The layered engine is a host loop whose
+    # train_batch is itself synchronous per layer; its loss transfer is
+    # the barrier.
+    _last_loss = [None]
+
     def _sync():
-        jax.device_get(engine.state.step)
+        if layered:
+            if _last_loss[0] is not None:
+                jax.device_get(_last_loss[0])
+        else:
+            jax.device_get(engine.state.step)
 
     def _compile_step():
-        engine.train_batch(batch=batch)
+        _last_loss[0] = engine.train_batch(batch=batch)
         _sync()
 
     _retry(_compile_step, "first train_batch compile")
@@ -215,7 +244,7 @@ def main():
 
     def _warmup():
         for _ in range(2):
-            engine.train_batch(batch=batch)
+            _last_loss[0] = engine.train_batch(batch=batch)
         _sync()
     _retry(_warmup, "warmup steps")
 
@@ -230,7 +259,7 @@ def main():
     for attempt in range(max_attempts):
         t0 = time.perf_counter()
         for _ in range(steps):
-            engine.train_batch(batch=batch)
+            _last_loss[0] = engine.train_batch(batch=batch)
         _sync()
         step_ms = (time.perf_counter() - t0) / steps * 1e3
         all_rounds.append(step_ms)
@@ -259,14 +288,28 @@ def main():
 
     tokens_per_s = batch_size * seq_len * steps / dt
     flops_per_token = 6 * n_params + 12 * n_layer * width * seq_len
+    if name == "bert-large" and masked_fmt:
+        # honest accounting for the gathered-positions MLM head: the tied
+        # decoder (V*H) + mlm transform (H*H) only run on P of S tokens,
+        # so the 6N-per-token approximation must shed the skipped share
+        P = max(1, int(round(seq_len * 0.15)))
+        head_params = cfg.padded_vocab * width + width * width
+        flops_per_token -= 6 * head_params * (1 - P / seq_len)
+    if layered:
+        # the layered decomposition UNTIES the LM head from wte, so
+        # n_params holds BOTH [V,H] tables — but the wte forward is a
+        # gather (~0 flops), not a matmul; shed its 6N share
+        flops_per_token -= 6 * cfg.padded_vocab * width
     tflops = tokens_per_s * flops_per_token / 1e12
     n_chips = jax.device_count()
     tflops_per_chip = tflops / n_chips
 
     print(json.dumps({
         "metric": f"{name} train TFLOPS/chip "
-                  f"(bs={batch_size} seq={seq_len} bf16 zero={zero_stage}, "
-                  f"full engine)",
+                  f"(bs={batch_size} seq={seq_len} bf16 "
+                  + ("zero=3+layered-offload (beyond-HBM)"
+                     if layered else f"zero={zero_stage}")
+                  + ", full engine)",
         "value": round(tflops_per_chip, 2),
         "unit": "TFLOPS/chip",
         "vs_baseline": round(tflops_per_chip / REFERENCE_TFLOPS_PER_GPU, 3),

@@ -76,11 +76,13 @@ class CostModelTuner:
 
     def __init__(self, configs: List[Dict], seed: int = 0,
                  explore_ratio: float = 0.2):
-        from deepspeed_tpu.autotuning.cost_model import (RidgeCostModel,
-                                                         featurize)
+        from deepspeed_tpu.autotuning.cost_model import (
+            GradientBoostingCostModel, featurize)
         self.configs = list(configs)
         self.X, self.keys = featurize(self.configs)
-        self.model = RidgeCostModel()
+        # boosted trees once enough samples accrue (the reference's
+        # XGBoost family), quadratic ridge before that
+        self.model = GradientBoostingCostModel(seed=seed)
         self.rng = _random.Random(seed)
         self.explore_ratio = explore_ratio
         self.visited: set = set()

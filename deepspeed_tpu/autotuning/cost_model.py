@@ -1,10 +1,13 @@
 """Cost model for the model-based tuner.
 
 Rebuild of deepspeed/autotuning/tuner/cost_model.py:11
-(``XGBoostCostModel``). XGBoost is not in this image, so the model is a
-closed-form ridge regression on degree-2 polynomial features — plenty for
-the handful of numeric config dims the tuner ranks (the reference also
-only RANKS configs; absolute accuracy is irrelevant)."""
+(``XGBoostCostModel``). XGBoost itself is not in this image;
+``GradientBoostingCostModel`` (sklearn) is the same model family —
+boosted regression trees — and is the default when enough samples exist.
+``RidgeCostModel`` (closed-form degree-2 ridge) is the small-sample /
+no-sklearn fallback: with the reference's INIT_NUM≈8 warm-up points,
+trees overfit where the quadratic prior still ranks sanely — and the
+tuner only needs RANKING, not absolute accuracy."""
 
 from typing import Dict, List
 
@@ -90,3 +93,40 @@ class RidgeCostModel:
         X = np.asarray(X, np.float64)
         P = self._expand((X - self._mu) / self._sigma)
         return P @ self.w
+
+
+class GradientBoostingCostModel:
+    """Boosted regression trees — the reference's XGBoostCostModel family
+    (cost_model.py:11), via sklearn. Falls back to ridge below
+    ``min_samples`` (trees need data to split) or without sklearn."""
+
+    def __init__(self, n_estimators: int = 200, min_samples: int = 12,
+                 seed: int = 0):
+        self.min_samples = min_samples
+        self._ridge = RidgeCostModel()
+        self._gb = None
+        self._use_gb = False
+        try:
+            from sklearn.ensemble import GradientBoostingRegressor
+            # random_state pins the subsample draws: the tuner's `seed`
+            # promises reproducible rankings
+            self._gb = GradientBoostingRegressor(
+                n_estimators=n_estimators, max_depth=3,
+                learning_rate=0.05, subsample=0.9, random_state=seed)
+        except ImportError:  # pragma: no cover — sklearn is baked in
+            self._gb = None
+
+    def fit(self, X, y):
+        X = np.asarray(X, np.float64)
+        y = np.asarray(y, np.float64)
+        y = y / max(float(np.max(np.abs(y))), 1e-9)
+        self._use_gb = self._gb is not None and len(y) >= self.min_samples
+        if self._use_gb:
+            self._gb.fit(X, y)
+        else:
+            self._ridge.fit(X, y)
+
+    def predict(self, X):
+        if self._use_gb:
+            return self._gb.predict(np.asarray(X, np.float64))
+        return self._ridge.predict(X)

@@ -69,6 +69,28 @@ def init_distributed(dist_backend="xla",
         env("DS_NUM_PROCESSES", "JAX_PROCESS_COUNT")
     pid = process_id if process_id is not None else \
         env("DS_PROCESS_ID", "JAX_PROCESS_ID")
+    if pid is None and auto_mpi_discovery:
+        # MPI transport (reference OpenMPIRunner/MVAPICHRunner,
+        # launcher/multinode_runner.py:100/:155): mpirun exports the rank
+        pid = env("OMPI_COMM_WORLD_RANK", "MV2_COMM_WORLD_RANK",
+                  "PMI_RANK")
+        if nprocs is None:
+            nprocs = env("OMPI_COMM_WORLD_SIZE", "MV2_COMM_WORLD_SIZE",
+                         "PMI_SIZE")
+        if (pid is not None and coordinator is None
+                and nprocs is not None and int(nprocs) > 1):
+            # without a rendezvous address every mpirun rank would
+            # silently train an independent single-process copy
+            raise RuntimeError(
+                f"MPI world of {nprocs} discovered (rank {pid}) but no "
+                "coordinator address is set — export "
+                "JAX_COORDINATOR_ADDRESS=host:port (the deepspeed "
+                "--launcher openmpi transport does this), or ranks would "
+                "each train an independent copy of the job")
+    if pid is None and os.environ.get("DS_WORLD_INFO"):
+        pid, n = rank_from_world_info(os.environ["DS_WORLD_INFO"])
+        if nprocs is None:
+            nprocs = n
 
     if coordinator is not None and nprocs is not None and pid is not None:
         if verbose:
@@ -82,6 +104,38 @@ def init_distributed(dist_backend="xla",
         logger.info("Single-controller JAX: no multi-host rendezvous needed "
                     f"({len(jax.devices())} local device(s))")
     _INITIALIZED = True
+
+
+def rank_from_world_info(world_info: str):
+    """Derive (process_id, num_processes) for the pdsh transport
+    (reference PDSHRunner, multinode_runner.py:45): one identical command
+    fans out to every host; the rank is this host's position in the
+    hostfile encoded in DS_WORLD_INFO.
+
+    Raises loudly when this host's name matches no hostfile entry — a
+    silent fall-through would leave every pdsh-launched host training an
+    independent single-process copy of the job. Hostnames are matched
+    exactly, then by short-name (FQDN vs hostfile short names either way
+    round)."""
+    import base64 as _b64
+    import json as _json
+    import socket as _socket
+    world = _json.loads(_b64.urlsafe_b64decode(world_info).decode())
+    hosts = list(world)
+    me = _socket.gethostname()
+    if me not in hosts:
+        short = {h.split(".")[0]: h for h in hosts}
+        if me.split(".")[0] in short:
+            me = short[me.split(".")[0]]
+        else:
+            raise RuntimeError(
+                f"DS_WORLD_INFO is set but this host "
+                f"({_socket.gethostname()!r}) matches none of its entries "
+                f"{hosts} — rank cannot be derived. The pdsh transport "
+                f"needs hostfile names that resolve to worker hostnames "
+                f"(IP-based hostfiles need --launcher ssh, which assigns "
+                f"ranks driver-side)")
+    return str(hosts.index(me)), str(len(hosts))
 
 
 def is_initialized():

@@ -146,6 +146,34 @@ def encode_world_info(resource_pool):
         json.dumps(world_info).encode()).decode()
 
 
+def build_pdsh_cmd(hosts, env_base, user_script, user_args):
+    """One pdsh fan-out command (reference PDSHRunner,
+    launcher/multinode_runner.py:45): identical per host — each worker
+    derives its rank from its hostname's position in DS_WORLD_INFO
+    (comm.init_distributed)."""
+    exports = " ".join(f"{k}={v}" for k, v in env_base.items())
+    remote = (f"cd {os.getcwd()}; {exports} {sys.executable} "
+              f"{user_script} {' '.join(user_args)}")
+    return ["pdsh", "-S", "-f", str(len(hosts)), "-w",
+            ",".join(hosts), remote]
+
+
+def build_openmpi_cmd(hosts, env_base, user_script, user_args):
+    """mpirun transport (reference OpenMPIRunner,
+    launcher/multinode_runner.py:100): ranks come from
+    OMPI_COMM_WORLD_RANK (comm.init_distributed MPI discovery).
+
+    ONE rank per host, like every multi-node transport here: on a TPU pod
+    a single process drives all the host's local chips (hostfile slots =
+    chips, not extra ranks)."""
+    cmd = ["mpirun", "-n", str(len(hosts)),
+           "--host", ",".join(f"{h}:1" for h in hosts),
+           "--allow-run-as-root"]
+    for k, v in env_base.items():
+        cmd += ["-x", f"{k}={v}"]
+    return cmd + [sys.executable, user_script] + list(user_args)
+
+
 def main(args=None):
     args = parse_args(args)
     resource_pool = fetch_hostfile(args.hostfile)
@@ -174,11 +202,40 @@ def main(args=None):
         # driver by default would overload it and hang the rendezvous
         raise ValueError(
             "multi-node run needs an explicit --launcher: 'ssh' (remote "
-            "fan-out), 'print' (emit per-host commands), or 'local' "
-            "(spawn every slot on THIS machine — testing/multi-process "
-            "single host; pass --master_addr 127.0.0.1)")
+            "fan-out), 'pdsh' (parallel-ssh fan-out), 'openmpi' (mpirun), "
+            "'print' (emit per-host commands), or 'local' (spawn every "
+            "slot on THIS machine — testing/multi-process single host; "
+            "pass --master_addr 127.0.0.1)")
 
     hosts = list(resource_pool.keys())
+    if args.launcher in ("pdsh", "openmpi"):
+        # single-command transports: rank assignment happens worker-side
+        # (hostname lookup in DS_WORLD_INFO for pdsh; OMPI_COMM_WORLD_RANK
+        # for mpirun) — see comm.init_distributed
+        # slot values are ints from the hostfile but lists after an
+        # --include slot filter (parse_resource_filter)
+        if any((len(s) if isinstance(s, (list, tuple)) else s) > 1
+               for s in resource_pool.values()):
+            logger.info(
+                "hostfile slots>1: each host still gets ONE process that "
+                "drives all its local chips (TPU-pod topology; same as "
+                "--launcher ssh)")
+        master = args.master_addr or hosts[0]
+        env_base = {
+            "JAX_COORDINATOR_ADDRESS": f"{master}:{args.master_port}",
+            "JAX_PROCESS_COUNT": str(len(hosts)),
+            "DS_WORLD_INFO": encode_world_info(resource_pool),
+        }
+        if args.launcher == "pdsh":
+            cmd = build_pdsh_cmd(hosts, env_base, args.user_script,
+                                 args.user_args)
+        else:
+            cmd = build_openmpi_cmd(hosts, env_base, args.user_script,
+                                    args.user_args)
+        logger.info(f"cmd = {' '.join(cmd)}")
+        result = subprocess.Popen(cmd, env=os.environ.copy())
+        result.wait()
+        sys.exit(result.returncode)
     if args.launcher == "local":
         # one jax process per SLOT, all on this machine
         workers = [(host, slot) for host, slots in resource_pool.items()

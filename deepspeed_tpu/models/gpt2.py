@@ -273,6 +273,63 @@ class _PipeBlock(nn.Module):
         return Block(self.config, name="block")(x, self.deterministic)
 
 
+class _OffloadEmbed(nn.Module):
+    """First layer of the beyond-HBM decomposition: ids -> hidden."""
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, input_ids):
+        cfg = self.config
+        S = input_ids.shape[1]
+        wte = self.param("wte", nn.initializers.normal(0.02),
+                         (cfg.padded_vocab, cfg.n_embd))
+        x = wte[input_ids]
+        if cfg.position_embedding != "rope":
+            wpe = self.param("wpe", nn.initializers.normal(0.01),
+                             (cfg.n_positions, cfg.n_embd))
+            x = x + wpe[None, :S].astype(wte.dtype)
+        return x
+
+
+class _OffloadHead(nn.Module):
+    """Loss head of the beyond-HBM decomposition: (hidden, batch) -> CE.
+
+    The LM head is UNTIED from the input embedding — the layer-streamed
+    engine requires disjoint per-layer param sets (the reference's
+    zero.Init partitions tied weights once but gathers them twice; here
+    untying keeps each layer's working set independently streamable)."""
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, batch):
+        cfg = self.config
+        if isinstance(batch, (tuple, list)):
+            ids, labels = batch[0], (batch[1] if len(batch) > 1 else None)
+        else:
+            ids, labels = batch["input_ids"], batch.get("labels")
+        shift_labels = (ids if labels is None else labels)[:, 1:]
+        x = nn.LayerNorm(epsilon=1e-5, name="ln_f")(x)
+        head = self.param("lm_head", nn.initializers.normal(0.02),
+                          (cfg.padded_vocab, cfg.n_embd))
+        shift_logits = jnp.einsum("bse,ve->bsv", x[:, :-1], head,
+                                  preferred_element_type=jnp.float32)
+        from deepspeed_tpu.models.common import masked_next_token_ce
+        return masked_next_token_ce(shift_logits, shift_labels)
+
+
+def gpt2_offload_layers(cfg: GPT2Config, deterministic: bool = True):
+    """LayerSpec decomposition for the ``Zero3OffloadEngine`` (params
+    beyond one chip's HBM, streamed from host/NVMe): body layers map
+    ``x -> x``; the last maps ``(x, batch) -> loss``. Drive via
+    ``deepspeed_tpu.initialize(model=gpt2_offload_layers(cfg), config=
+    {"zero_optimization": {"stage": 3, "offload_param": {"device":
+    "cpu"}}, ...}, sample_batch=..., input_fn=lambda b: b["input_ids"])``.
+    """
+    return ([_OffloadEmbed(cfg)] +
+            [_PipeBlock(cfg, deterministic) for _ in range(cfg.n_layer)] +
+            [_OffloadHead(cfg)])
+
+
 class GPT2LMHeadModel(nn.Module):
     """GPT-2 causal LM; returns mean next-token cross-entropy."""
     config: GPT2Config

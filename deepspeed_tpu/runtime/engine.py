@@ -1072,6 +1072,23 @@ class DeepSpeedEngine:
                     "data-parallel mesh axis or load the full batch per "
                     "process via model_parameters/batch_spec")
         import numpy as _np
+        # loud rejection of uneven per-host slices: every process must
+        # hold exactly global_rows/process_count rows, or the assembled
+        # global array would be silently misaligned (rank-dependent rows
+        # duplicated/dropped)
+        n_proc = jax.process_count()
+        global_rows = (self.train_micro_batch_size_per_gpu()
+                       * self.dp_world_size)
+        expect = global_rows // n_proc
+        for leaf in jax.tree.leaves(batch):
+            rows = _np.shape(leaf)[0] if _np.ndim(leaf) else None
+            if rows is not None and rows != expect:
+                raise ValueError(
+                    f"uneven per-process batch slice: this process holds "
+                    f"{rows} rows but the global micro-batch "
+                    f"({global_rows}) over {n_proc} processes requires "
+                    f"exactly {expect} per process (deepspeed_io slices "
+                    f"evenly; feed each rank its own equal slice)")
         return jax.tree.map(
             lambda x, sh: jax.make_array_from_process_local_data(
                 sh, _np.asarray(x)),
@@ -1429,6 +1446,20 @@ class DeepSpeedEngine:
         zero_paths = sorted(_glob.glob(os.path.join(
             load_dir, str(tag), "zero_pp_rank_*" + OPTIM_FILE_SUFFIX)))
         zero_payloads = [pickle.load(open(p, "rb")) for p in zero_paths]
+        saved_dp = (zero_payloads[0].get("partition_count")
+                    if zero_payloads else None)
+        if saved_dp is not None and saved_dp != self.dp_world_size:
+            # elastic resize (reference stage_1_and_2.py:2023
+            # _restore_from_elastic_fp32_weights / the 'universal
+            # checkpoint' load path): shards carry their GLOBAL indices,
+            # so restore_tree reassembles the full tree from the saved
+            # world size and re-slices it onto the current one — every
+            # checkpoint here is 'universal'; load_universal_checkpoint
+            # is honored by construction.
+            log_dist(
+                f"elastic checkpoint load: saved at dp={saved_dp}, "
+                f"resuming at dp={self.dp_world_size} (shard reassembly)",
+                ranks=[0])
 
         if sd.get("module") is not None:
             module_np = sd["module"]

@@ -201,16 +201,26 @@ class Zero3OffloadEngine:
         self._v = [[np.zeros_like(h) for h in self.store.host_leaves(i)]
                    for i in range(len(self.layers))]
 
-        # per-layer compiled fns: fwd, vjp-recompute, loss head grad
+        # per-layer compiled fns: fwd, vjp-recompute, loss head grad.
+        # Deduped by module equality: a 48-block GPT stack compiles ONE
+        # fwd + ONE bwd program shared by every identical block instead
+        # of 96 (flax modules are value-hashable dataclasses).
+        fwd_cache, bwd_cache = {}, {}
+
         def fwd(mod):
-            return jax.jit(lambda p, x: mod.apply({"params": p}, x))
+            if mod not in fwd_cache:
+                fwd_cache[mod] = jax.jit(
+                    lambda p, x: mod.apply({"params": p}, x))
+            return fwd_cache[mod]
 
         def bwd(mod):
-            def f(p, x, ct):
-                _, vjp = jax.vjp(
-                    lambda p, x: mod.apply({"params": p}, x), p, x)
-                return vjp(ct)
-            return jax.jit(f)
+            if mod not in bwd_cache:
+                def f(p, x, ct):
+                    _, vjp = jax.vjp(
+                        lambda p, x: mod.apply({"params": p}, x), p, x)
+                    return vjp(ct)
+                bwd_cache[mod] = jax.jit(f)
+            return bwd_cache[mod]
 
         self._fwd = [fwd(m) for m in self.layers[:-1]]
         self._bwd = [bwd(m) for m in self.layers[:-1]]
@@ -226,7 +236,9 @@ class Zero3OffloadEngine:
     def train_batch(self, batch=None):
         L = len(self.layers)
         dt = self.compute_dtype
-        x = jnp.asarray(self.input_fn(batch), dt)
+        x = jnp.asarray(self.input_fn(batch))
+        if jnp.issubdtype(x.dtype, jnp.floating):  # token ids stay integer
+            x = x.astype(dt)
 
         # forward sweep: fetch i, prefetch i+1, compute, release
         acts = [x]

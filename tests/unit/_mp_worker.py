@@ -1,12 +1,19 @@
-"""Worker process for the multi-process distributed test.
+"""Worker process for the multi-process distributed tests.
 
 Launched by test_multiprocess.py with DS_COORDINATOR_ADDRESS /
 DS_NUM_PROCESSES / DS_PROCESS_ID set — the analogue of one rank spawned by
 the reference's @distributed_test fixture (tests/unit/common.py:57). Each
 process owns 2 virtual CPU devices; jax.distributed glues them into one
-4-device mesh, exercising the REAL multi-process branches:
+2*N-device mesh, exercising the REAL multi-process branches:
 _globalize_batch (make_array_from_process_local_data), the multihost
 barrier, and multi-process checkpoint save/load.
+
+Modes via DS_MP_MODE:
+  train_save (default) — train, checkpoint, reload, train once more
+  resume    — load the checkpoint written by a train_save run at a
+              DIFFERENT world size (elastic dp resize) and keep training
+  uneven    — feed a wrong-sized per-process slice; expect the loud
+              rejection from engine._globalize_batch
 """
 
 import json
@@ -29,49 +36,93 @@ import deepspeed_tpu  # noqa: E402
 import deepspeed_tpu.comm as dist  # noqa: E402
 from deepspeed_tpu.models.simple import SimpleModel, sample_batch  # noqa: E402
 
+GLOBAL_BATCH = 8
+HIDDEN = 16
 
-def main():
-    out_dir = sys.argv[1]
-    dist.init_distributed()          # env-driven jax.distributed rendezvous
-    rank = dist.get_rank()
-    assert dist.get_process_count() == 2, dist.get_process_count()
-    assert jax.device_count() == 4, jax.device_count()
 
-    hidden = 16
+def make_engine():
     engine, _, _, _ = deepspeed_tpu.initialize(
-        model=SimpleModel(hidden_dim=hidden, nlayers=2),
+        model=SimpleModel(hidden_dim=HIDDEN, nlayers=2),
         config={
-            "train_batch_size": 8,
-            "train_micro_batch_size_per_gpu": 2,
+            "train_batch_size": GLOBAL_BATCH,
+            "train_micro_batch_size_per_gpu":
+                GLOBAL_BATCH // jax.device_count(),
             "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
             "zero_optimization": {"stage": 1},
         },
-        sample_batch=sample_batch(2, hidden))
-    assert engine.dp_world_size == 4
+        sample_batch=sample_batch(2, HIDDEN))
+    assert engine.dp_world_size == jax.device_count()
+    return engine
 
-    # Each process feeds only ITS slice of the global batch — the
-    # deepspeed_io per-process slicing contract; _globalize_batch must
-    # assemble the global jax.Array from the process-local shards.
+
+def my_slice(rank, nproc, gx, gy):
+    per = GLOBAL_BATCH // nproc
+    lo = rank * per
+    return gx[lo:lo + per], gy[lo:lo + per]
+
+
+def main():
+    out_dir = sys.argv[1]
+    mode = os.environ.get("DS_MP_MODE", "train_save")
+    dist.init_distributed()          # env-driven jax.distributed rendezvous
+    rank = dist.get_rank()
+    nproc = dist.get_process_count()
+    want = os.environ.get("DS_NUM_PROCESSES")  # launcher path sets JAX_*
+    if want is not None:
+        assert nproc == int(want), nproc
+    assert jax.device_count() == 2 * nproc, jax.device_count()
+
+    engine = make_engine()
     rng = np.random.default_rng(7)
+    ck = os.path.join(out_dir, "ck")
+
+    if mode == "uneven":
+        gx = rng.standard_normal((GLOBAL_BATCH, HIDDEN)).astype(np.float32)
+        gy = rng.standard_normal((GLOBAL_BATCH, HIDDEN)).astype(np.float32)
+        # one row short on every rank: must be rejected loudly
+        try:
+            engine.train_batch(batch=(gx[:GLOBAL_BATCH // nproc - 1],
+                                      gy[:GLOBAL_BATCH // nproc - 1]))
+        except ValueError as e:
+            assert "uneven per-process batch slice" in str(e), e
+            print(f"worker {rank} UNEVEN-REJECTED OK", flush=True)
+            return
+        raise SystemExit("uneven slice was NOT rejected")
+
+    if mode == "resume":
+        # elastic dp resize: the checkpoint was saved by a run with a
+        # different world size; shard reassembly must restore it here
+        engine.load_checkpoint(ck, tag="mp")
+        losses = []
+        for _ in range(2):
+            gx = rng.standard_normal((GLOBAL_BATCH, HIDDEN)).astype(np.float32)
+            gy = rng.standard_normal((GLOBAL_BATCH, HIDDEN)).astype(np.float32)
+            losses.append(float(engine.train_batch(
+                batch=my_slice(rank, nproc, gx, gy))))
+        dist.barrier()
+        with open(os.path.join(out_dir, f"resumed_losses_{rank}.json"),
+                  "w") as f:
+            json.dump(losses, f)
+        print(f"worker {rank} RESUME OK", flush=True)
+        return
+
+    # default: train, checkpoint, reload, continue
     losses = []
     for _ in range(3):
-        gx = rng.standard_normal((8, hidden)).astype(np.float32)
-        gy = rng.standard_normal((8, hidden)).astype(np.float32)
-        lo, hi = rank * 4, rank * 4 + 4
-        loss = engine.train_batch(batch=(gx[lo:hi], gy[lo:hi]))
-        losses.append(float(loss))
+        gx = rng.standard_normal((GLOBAL_BATCH, HIDDEN)).astype(np.float32)
+        gy = rng.standard_normal((GLOBAL_BATCH, HIDDEN)).astype(np.float32)
+        losses.append(float(engine.train_batch(
+            batch=my_slice(rank, nproc, gx, gy))))
 
     dist.barrier()
-    ck = os.path.join(out_dir, "ck")
     engine.save_checkpoint(ck, tag="mp")
     dist.barrier()
     engine.load_checkpoint(ck, tag="mp")
 
-    # one more step after resume
-    gx = rng.standard_normal((8, hidden)).astype(np.float32)
-    gy = rng.standard_normal((8, hidden)).astype(np.float32)
-    lo, hi = rank * 4, rank * 4 + 4
-    losses.append(float(engine.train_batch(batch=(gx[lo:hi], gy[lo:hi]))))
+    gx = rng.standard_normal((GLOBAL_BATCH, HIDDEN)).astype(np.float32)
+    gy = rng.standard_normal((GLOBAL_BATCH, HIDDEN)).astype(np.float32)
+    losses.append(float(engine.train_batch(
+        batch=my_slice(rank, nproc, gx, gy))))
     dist.barrier()
 
     with open(os.path.join(out_dir, f"losses_{rank}.json"), "w") as f:

@@ -259,3 +259,62 @@ def test_gridsearch_visits_all_stages(tmp_path):
     assert (1, 2) in calls, calls
     assert best["zero_optimization"]["stage"] == 1
     assert best["train_micro_batch_size_per_gpu"] == 2
+
+
+from deepspeed_tpu.autotuning.scheduler import ResourceManager  # noqa: E402
+
+
+class TestCrossHostScheduling:
+    def test_localhost_pool_runs_parallel_slots(self, tmp_path):
+        """A 2-'host' localhost pool x 1 slot runs experiments through the
+        per-host worker pool (reference ResourceManager node allocation)
+        without needing sshd."""
+        script = tmp_path / "exp.py"
+        script.write_text(
+            "import json, os\n"
+            "d = os.environ['DS_AUTOTUNING_EXP_DIR']\n"
+            "cfg = json.load(open(os.path.join(d, 'ds_config.json')))\n"
+            "json.dump({'throughput': cfg['x'] * 2.0},\n"
+            "          open(os.path.join(d, 'metric.json'), 'w'))\n")
+        import sys
+        rm = ResourceManager(
+            cmd_template=[sys.executable, str(script)],
+            exps_dir=str(tmp_path / "exps"), num_slots=1,
+            hosts=["localhost", "127.0.0.1"])
+        rm.schedule_experiments([{"x": 1}, {"x": 2}, {"x": 3}, {"x": 4}])
+        exps = rm.run()
+        assert [e.metric for e in exps] == [2.0, 4.0, 6.0, 8.0]
+        assert all(e.host in ("localhost", "127.0.0.1") for e in exps)
+        assert rm.best().metric == 8.0
+
+    def test_remote_cmd_construction(self, tmp_path):
+        rm = ResourceManager(cmd_template=["python", "train.py"],
+                             exps_dir=str(tmp_path), hosts=["worker-7"],
+                             ssh_cmd=["ssh", "-p", "2222"])
+        cmd = rm._build_remote_cmd("worker-7", "/shared/exp_0")
+        assert cmd[:4] == ["ssh", "-p", "2222", "worker-7"]
+        assert "DS_AUTOTUNING_EXP_DIR=/shared/exp_0" in cmd[4]
+        assert "python train.py" in cmd[4]
+
+    def test_hosts_require_cmd_template(self):
+        with pytest.raises(AssertionError, match="cross-host"):
+            ResourceManager(run_fn=lambda c: 1.0, hosts=["a"])
+
+
+class TestGradientBoostingCostModel:
+    def test_ranks_like_truth_and_switches_family(self):
+        from deepspeed_tpu.autotuning.cost_model import (
+            GradientBoostingCostModel, featurize)
+        rng = np.random.default_rng(0)
+        configs = [{"micro": int(m), "zero": int(z)}
+                   for m in (1, 2, 4, 8, 16) for z in (0, 1, 2, 3)]
+        X, _ = featurize(configs)
+        truth = X[:, 0] * 3.0 - (X[:, 1] - 4) ** 2
+        m = GradientBoostingCostModel(min_samples=12)
+        m.fit(X[:8], truth[:8])
+        assert not m._use_gb            # small sample -> ridge
+        m.fit(X, truth + rng.normal(0, 0.1, len(truth)))
+        assert m._use_gb                # enough data -> boosted trees
+        pred = m.predict(X)
+        # ranking quality: the true best config is in the predicted top-3
+        assert int(np.argmax(truth)) in np.argsort(pred)[-3:]

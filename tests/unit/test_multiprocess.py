@@ -29,19 +29,20 @@ def _free_port():
         return s.getsockname()[1]
 
 
-def test_two_process_train_checkpoint(tmp_path):
+def _run_workers(tmp_path, nproc, mode="train_save", timeout=480):
     port = _free_port()
     procs = []
-    for rank in range(2):
+    for rank in range(nproc):
         env = dict(os.environ)
         env.pop("PYTEST_CURRENT_TEST", None)
         # a clean env: the workers must NOT inherit this pytest process's
         # jax platform state beyond what the worker sets itself
         env.update({
             "DS_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
-            "DS_NUM_PROCESSES": "2",
+            "DS_NUM_PROCESSES": str(nproc),
             "DS_PROCESS_ID": str(rank),
             "DS_REPO": REPO,
+            "DS_MP_MODE": mode,
         })
         procs.append(subprocess.Popen(
             [sys.executable, WORKER, str(tmp_path)],
@@ -50,7 +51,7 @@ def test_two_process_train_checkpoint(tmp_path):
     outs = []
     for p in procs:
         try:
-            out, _ = p.communicate(timeout=480)
+            out, _ = p.communicate(timeout=timeout)
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
@@ -58,6 +59,12 @@ def test_two_process_train_checkpoint(tmp_path):
         outs.append(out)
     for rank, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {rank} failed:\n{out[-4000:]}"
+    return outs
+
+
+def test_two_process_train_checkpoint(tmp_path):
+    outs = _run_workers(tmp_path, 2)
+    for rank, out in enumerate(outs):
         assert f"worker {rank} OK" in out
 
     # identical global loss stream on both ranks: the globalized batch and
@@ -69,6 +76,39 @@ def test_two_process_train_checkpoint(tmp_path):
     # training made progress and survived the checkpoint roundtrip
     assert l0[-1] < l0[0]
     assert (tmp_path / "ck" / "mp").exists()
+
+
+def test_four_process_train_and_elastic_resize(tmp_path):
+    """4 processes x 2 devices (dp=8) train and checkpoint; then 2
+    processes x 2 devices (dp=4) load the SAME checkpoint and continue —
+    the elastic resize restore (reference stage_1_and_2.py:2023
+    _restore_from_elastic_fp32_weights): shards carry global indices, so
+    reassembly is world-size independent."""
+    outs = _run_workers(tmp_path, 4)
+    for rank, out in enumerate(outs):
+        assert f"worker {rank} OK" in out
+    losses = [json.load(open(tmp_path / f"losses_{r}.json"))
+              for r in range(4)]
+    assert all(l == losses[0] for l in losses[1:])
+    assert losses[0][-1] < losses[0][0]
+
+    outs = _run_workers(tmp_path, 2, mode="resume")
+    for rank, out in enumerate(outs):
+        assert f"worker {rank} RESUME OK" in out
+    # log_dist ranks=[0]: the elastic-load line appears on rank 0 only
+    assert "elastic checkpoint load: saved at dp=8" in outs[0]
+    r0 = json.load(open(tmp_path / "resumed_losses_0.json"))
+    r1 = json.load(open(tmp_path / "resumed_losses_1.json"))
+    assert r0 == r1 and len(r0) == 2
+    # resumed training continues to improve on the checkpointed loss
+    final_before = losses[0][-1]
+    assert r0[-1] < final_before * 1.5  # sane continuation, not a reset
+
+
+def test_uneven_slice_rejected(tmp_path):
+    outs = _run_workers(tmp_path, 2, mode="uneven")
+    for rank, out in enumerate(outs):
+        assert f"worker {rank} UNEVEN-REJECTED OK" in out
 
 
 def test_launcher_driven_two_process(tmp_path):
