@@ -52,12 +52,15 @@ def parse_args(args=None):
     parser.add_argument("--master_port", type=int, default=29500)
     parser.add_argument("--master_addr", type=str, default="")
     parser.add_argument("--launcher", type=str, default=None,
-                        choices=["local", "ssh", "print"],
+                        choices=["local", "ssh", "print", "pdsh",
+                                 "openmpi", "mvapich"],
                         help="local: run here (multi-node hostfiles spawn "
                              "every slot on THIS machine — explicit opt-in "
-                             "only); ssh: pdsh-style remote launch; print: "
-                             "emit the per-host commands. Default: local "
-                             "for single-node, error for multi-node.")
+                             "only); ssh: per-host remote launch; pdsh: one "
+                             "parallel-ssh fan-out command; openmpi/"
+                             "mvapich: mpirun/mpirun_rsh; print: emit the "
+                             "per-host commands. Default: local for "
+                             "single-node, error for multi-node.")
     parser.add_argument("--force_multi", action="store_true")
     parser.add_argument("user_script", type=str)
     parser.add_argument("user_args", nargs=argparse.REMAINDER)
@@ -174,6 +177,22 @@ def build_openmpi_cmd(hosts, env_base, user_script, user_args):
     return cmd + [sys.executable, user_script] + list(user_args)
 
 
+def build_mvapich_cmd(hosts, env_base, user_script, user_args,
+                      hostfile_path="/tmp/ds_mvapich_hostfile"):
+    """MVAPICH transport (reference MVAPICHRunner,
+    launcher/multinode_runner.py:155): mpirun_rsh with a generated
+    hostfile; ranks come from MV2_COMM_WORLD_RANK (comm.init_distributed
+    MPI discovery). The reference's CUDA-centric MV2_* exports have no
+    TPU meaning and are not set."""
+    with open(hostfile_path, "w") as f:
+        f.write("\n".join(hosts) + "\n")
+    cmd = ["mpirun_rsh", "-np", str(len(hosts)),
+           "-hostfile", hostfile_path]
+    # mpirun_rsh takes env as trailing KEY=VALUE args before the command
+    cmd += [f"{k}={v}" for k, v in env_base.items()]
+    return cmd + [sys.executable, user_script] + list(user_args)
+
+
 def main(args=None):
     args = parse_args(args)
     resource_pool = fetch_hostfile(args.hostfile)
@@ -203,15 +222,15 @@ def main(args=None):
         raise ValueError(
             "multi-node run needs an explicit --launcher: 'ssh' (remote "
             "fan-out), 'pdsh' (parallel-ssh fan-out), 'openmpi' (mpirun), "
-            "'print' (emit per-host commands), or 'local' (spawn every "
-            "slot on THIS machine — testing/multi-process single host; "
-            "pass --master_addr 127.0.0.1)")
+            "'mvapich' (mpirun_rsh), 'print' (emit per-host commands), or "
+            "'local' (spawn every slot on THIS machine — testing/"
+            "multi-process single host; pass --master_addr 127.0.0.1)")
 
     hosts = list(resource_pool.keys())
-    if args.launcher in ("pdsh", "openmpi"):
+    if args.launcher in ("pdsh", "openmpi", "mvapich"):
         # single-command transports: rank assignment happens worker-side
-        # (hostname lookup in DS_WORLD_INFO for pdsh; OMPI_COMM_WORLD_RANK
-        # for mpirun) — see comm.init_distributed
+        # (hostname lookup in DS_WORLD_INFO for pdsh; OMPI/MV2_
+        # COMM_WORLD_RANK for mpirun/mpirun_rsh) — see comm.init_distributed
         # slot values are ints from the hostfile but lists after an
         # --include slot filter (parse_resource_filter)
         if any((len(s) if isinstance(s, (list, tuple)) else s) > 1
@@ -229,6 +248,9 @@ def main(args=None):
         if args.launcher == "pdsh":
             cmd = build_pdsh_cmd(hosts, env_base, args.user_script,
                                  args.user_args)
+        elif args.launcher == "mvapich":
+            cmd = build_mvapich_cmd(hosts, env_base, args.user_script,
+                                    args.user_args)
         else:
             cmd = build_openmpi_cmd(hosts, env_base, args.user_script,
                                     args.user_args)

@@ -71,7 +71,12 @@ class OffloadedOptimizer:
             p_full = np.asarray(jax.device_get(p_leaf), np.float32)
             shards = []
             for idx, _ in _local_slices(g_leaf):
-                shards.append((idx, np.ascontiguousarray(p_full[idx])))
+                # np.array order="C", not ascontiguousarray: the masters
+                # are updated in place, and ascontiguousarray of an
+                # already-contiguous read-only device_get view would hand
+                # back that read-only view uncopied
+                shards.append((idx, np.array(p_full[idx], np.float32,
+                                             order="C")))
             self.masters.append(shards)
             flat_buffers.extend(buf for _, buf in shards)
         self.opt = DeepSpeedCPUAdam(flat_buffers, **self._opt_kwargs)

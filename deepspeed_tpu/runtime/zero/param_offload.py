@@ -84,8 +84,13 @@ class HostParamStore:
         """Take ownership of one layer's params as host fp32 leaves."""
         leaves, treedef = jax.tree.flatten(params)
         # np.array (not asarray): device_get returns read-only views, and
-        # these buffers are the in-place-updated fp32 masters
-        host = [np.array(jax.device_get(l), np.float32) for l in leaves]
+        # these buffers are the in-place-updated fp32 masters. order="C"
+        # is load-bearing: some backends (axon) hand back F-ordered
+        # arrays, and the default order="K" would preserve that — masters
+        # and their zeros_like moments must honor the CPU-Adam kernel's
+        # C-contiguity contract
+        host = [np.array(jax.device_get(l), np.float32, order="C")
+                for l in leaves]
         self.total_param_bytes += sum(h.nbytes for h in host)
         i = len(self.treedefs)
         self.treedefs.append(treedef)

@@ -152,6 +152,21 @@ class TestMultinodeTransports:
         assert "a:1,b:1,c:1" in cmd
         assert "-x" in cmd and "DS_WORLD_INFO=abc" in cmd
 
+    def test_mvapich_cmd_construction(self, tmp_path):
+        from deepspeed_tpu.launcher.runner import build_mvapich_cmd
+        hf = str(tmp_path / "mv_hosts")
+        cmd = build_mvapich_cmd(["a", "b"], {"DS_WORLD_INFO": "abc"},
+                                "t.py", ["--x"], hostfile_path=hf)
+        assert cmd[:3] == ["mpirun_rsh", "-np", "2"]
+        assert open(hf).read() == "a\nb\n"
+        assert "DS_WORLD_INFO=abc" in cmd       # env as KEY=VALUE args
+        assert cmd[-2:] == ["t.py", "--x"]
+
+    def test_launcher_cli_accepts_all_transports(self):
+        from deepspeed_tpu.launcher.runner import parse_args
+        for l in ("local", "ssh", "print", "pdsh", "openmpi", "mvapich"):
+            assert parse_args(["--launcher", l, "t.py"]).launcher == l
+
     def test_pdsh_rank_from_world_info(self):
         """comm.rank_from_world_info (the init_distributed pdsh path)
         derives this worker's rank from its hostname position in

@@ -48,6 +48,42 @@ def _batch(seed=0, bs=16):
             rng.standard_normal((bs, HID)).astype(np.float32))
 
 
+def test_masters_are_c_contiguous_writable():
+    """HostParamStore masters must be C-contiguous writable fp32 even
+    when the backend hands back F-ordered or read-only arrays — the axon
+    TPU platform does, and np.array's default order='K' preserved the F
+    layout, tripping the CPU-Adam kernel's _ptr contract (and zeros_like
+    moments inherit the order). Regression for the gpt2-xl layered bench
+    crash."""
+    from deepspeed_tpu.runtime.zero.param_offload import HostParamStore
+    st = HostParamStore()
+    f_ordered = np.asfortranarray(
+        np.arange(12, dtype=np.float32).reshape(3, 4))
+    read_only = np.arange(4, dtype=np.float32)
+    read_only.setflags(write=False)
+    st.add_layer({"w": f_ordered, "b": read_only})
+    for h in st.host_leaves(0):
+        assert h.dtype == np.float32
+        assert h.flags["C_CONTIGUOUS"], h.shape
+        assert h.flags["WRITEABLE"]
+        assert np.zeros_like(h).flags["C_CONTIGUOUS"]
+
+
+def test_optimizer_offload_masters_writable():
+    """Same contract for the optimizer-offload masters (zero/offload.py):
+    an already-contiguous read-only full-slice leaf must still be copied
+    into a writable master."""
+    from deepspeed_tpu.runtime.zero.offload import OffloadedOptimizer
+    ro = np.ones((4, 4), np.float32)
+    ro.setflags(write=False)
+    grads = {"w": np.ones((4, 4), np.float32)}
+    off = OffloadedOptimizer(grads, lr=1e-3)
+    off._init_masters(grads, {"w": ro})
+    for shards in off.masters:
+        for _, master in shards:
+            assert master.flags["C_CONTIGUOUS"] and master.flags["WRITEABLE"]
+
+
 def test_device_budget_and_training(tmp_path):
     eng = Zero3OffloadEngine(_layers(), _batch(), lr=1e-2, seed=0)
     losses = [float(eng.train_batch(_batch(s))) for s in range(8)]
