@@ -59,11 +59,21 @@ def attention(q, k, v, *, causal=True, sm_scale=None, bias=None, mask=None,
               use_flash: Optional[bool] = None):
     """Dispatch: Pallas flash kernel on TPU, jnp reference elsewhere.
 
-    ``use_flash`` forces one path (tests use False for the oracle)."""
+    ``use_flash`` forces one path (tests use False for the oracle); env
+    ``DS_ATTN_IMPL=flash|xla`` overrides the default for A/B benching
+    (at short seq the O(S^2) logits fit HBM comfortably and XLA's fused
+    softmax can beat the block loop — measure, don't guess)."""
+    import os
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
     if use_flash is None:
-        use_flash = _flash_available() and bias is None and mask is None
+        impl = os.environ.get("DS_ATTN_IMPL", "").lower()
+        if impl == "xla":
+            use_flash = False
+        elif impl == "flash":
+            use_flash = _flash_available()
+        else:
+            use_flash = _flash_available() and bias is None and mask is None
     if use_flash:
         from deepspeed_tpu.ops.transformer import flash
         return flash.flash_attention(q, k, v, causal=causal, sm_scale=sm_scale)

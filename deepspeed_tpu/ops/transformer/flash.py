@@ -175,7 +175,12 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 # ------------------------------------------------------------------ dispatch
-def _pick_block(seq, target=512):
+def _pick_block(seq, target=None):
+    if target is None:
+        import os
+        # swept in round 2 (512 best at seq>=1024); DS_FLASH_BLOCK
+        # overrides for per-config tuning at short seq
+        target = int(os.environ.get("DS_FLASH_BLOCK", "512"))
     b = min(seq, target)
     while seq % b:
         b //= 2

@@ -187,30 +187,20 @@ class Zero3OffloadEngine:
         self._adam = _HostAdam(betas, eps, weight_decay, adamw_mode)
         self.global_steps = 0
 
-        # zero.Init: one layer at a time on device, masters straight to host
-        rng = jax.random.PRNGKey(seed)
-        x = self.input_fn(sample_batch)
-        for i, m in enumerate(self.layers):
-            lrng = jax.random.fold_in(rng, i)
-            if i < len(self.layers) - 1:
-                variables = m.init(lrng, x)
-                x = m.apply(variables, x)
-            else:
-                variables = m.init(lrng, x, sample_batch)
-            self.store.add_layer(variables["params"])
-            del variables  # device copy freed; host master is authoritative
-        # moments live with the masters (RAM; the optimizer-state NVMe
-        # swapper in zero/offload.py covers disk-resident moments)
-        self._m = [[np.zeros_like(h) for h in self.store.host_leaves(i)]
-                   for i in range(len(self.layers))]
-        self._v = [[np.zeros_like(h) for h in self.store.host_leaves(i)]
-                   for i in range(len(self.layers))]
+        # per-layer compiled fns: init, fwd, vjp-recompute, loss head
+        # grad. Deduped by module equality: a 48-block GPT stack compiles
+        # ONE init + ONE fwd + ONE bwd program shared by every identical
+        # block instead of 144 (flax modules are value-hashable
+        # dataclasses). Jitting init/apply is load-bearing for remote
+        # backends: eager tracing dispatches every primitive as its own
+        # ~100 ms tunnel round trip, turning a 1.5B-param zero_init into
+        # hours.
+        init_cache, fwd_cache, bwd_cache = {}, {}, {}
 
-        # per-layer compiled fns: fwd, vjp-recompute, loss head grad.
-        # Deduped by module equality: a 48-block GPT stack compiles ONE
-        # fwd + ONE bwd program shared by every identical block instead
-        # of 96 (flax modules are value-hashable dataclasses).
-        fwd_cache, bwd_cache = {}, {}
+        def jinit(mod):
+            if mod not in init_cache:
+                init_cache[mod] = jax.jit(mod.init)
+            return init_cache[mod]
 
         def fwd(mod):
             if mod not in fwd_cache:
@@ -226,6 +216,25 @@ class Zero3OffloadEngine:
                     return vjp(ct)
                 bwd_cache[mod] = jax.jit(f)
             return bwd_cache[mod]
+
+        # zero.Init: one layer at a time on device, masters straight to host
+        rng = jax.random.PRNGKey(seed)
+        x = self.input_fn(sample_batch)
+        for i, m in enumerate(self.layers):
+            lrng = jax.random.fold_in(rng, i)
+            if i < len(self.layers) - 1:
+                variables = jinit(m)(lrng, x)
+                x = fwd(m)(variables["params"], x)
+            else:
+                variables = jinit(m)(lrng, x, sample_batch)
+            self.store.add_layer(variables["params"])
+            del variables  # device copy freed; host master is authoritative
+        # moments live with the masters (RAM; the optimizer-state NVMe
+        # swapper in zero/offload.py covers disk-resident moments)
+        self._m = [[np.zeros_like(h) for h in self.store.host_leaves(i)]
+                   for i in range(len(self.layers))]
+        self._v = [[np.zeros_like(h) for h in self.store.host_leaves(i)]
+                   for i in range(len(self.layers))]
 
         self._fwd = [fwd(m) for m in self.layers[:-1]]
         self._bwd = [bwd(m) for m in self.layers[:-1]]
