@@ -1,0 +1,18 @@
+"""Effective-platform query for kernel dispatch.
+
+``jax.default_backend()`` reports the process-global backend and ignores
+an active ``jax.default_device(...)`` context — so on a TPU host, code
+hosted onto the CPU device (e.g. the layered-offload engine's zero_init)
+would still pick TPU Pallas lowering and crash with "Only interpret mode
+is supported on CPU backend". Every ``interpret=`` / flash-availability
+decision routes through here instead.
+"""
+
+import jax
+
+
+def effective_platform() -> str:
+    dd = jax.config.jax_default_device
+    if dd is not None:
+        return dd.platform
+    return jax.default_backend()

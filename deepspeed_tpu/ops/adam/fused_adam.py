@@ -27,7 +27,8 @@ _LANES = 128
 
 
 def _interpret():
-    return jax.default_backend() != "tpu"
+    from deepspeed_tpu.ops._platform import effective_platform
+    return effective_platform() != "tpu"
 
 
 def _adam_kernel(s_ref, p_ref, g_ref, m_ref, v_ref,

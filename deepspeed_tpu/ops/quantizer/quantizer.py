@@ -27,7 +27,8 @@ except Exception:  # pragma: no cover
 
 
 def _on_tpu():
-    return jax.default_backend() == "tpu"
+    from deepspeed_tpu.ops._platform import effective_platform
+    return effective_platform() == "tpu"
 
 
 def _qrange(num_bits, symmetric):

@@ -26,7 +26,8 @@ LANES = 8
 
 
 def _interpret():
-    return jax.default_backend() != "tpu"
+    from deepspeed_tpu.ops._platform import effective_platform
+    return effective_platform() != "tpu"
 
 
 def _fwd_kernel(layout_ref, kpm_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,

@@ -45,36 +45,56 @@ def mha_reference(q, k, v, *, causal=True, sm_scale=None, bias=None,
 
 
 @functools.lru_cache(maxsize=None)
-def _flash_available():
+def _flash_importable():
     try:
-        if jax.default_backend() != "tpu":
-            return False
         from deepspeed_tpu.ops.transformer import flash  # noqa: F401
         return True
     except Exception:
         return False
 
 
+def _flash_available():
+    # effective_platform (not default_backend): code hosted onto the CPU
+    # device of a TPU process — e.g. the layered-offload zero_init — must
+    # not pick TPU Pallas lowering
+    from deepspeed_tpu.ops._platform import effective_platform
+    return effective_platform() == "tpu" and _flash_importable()
+
+
+def _want_flash(seq_k: int, has_bias: bool, has_mask: bool) -> bool:
+    """Default impl choice, measured on one v5e-class chip (PERF.md):
+    at seq 128 the flash grid degenerates to one tiny block per (b, h)
+    program and XLA's fused O(S^2) attention is 1.35x faster end-to-end
+    (BERT-large 211 -> 156 ms/step); at seq 1024 flash wins (GPT-2
+    headline). Crossover set at 512 where the fp32 logits buffer also
+    starts to matter. ``DS_ATTN_IMPL=flash|xla`` overrides."""
+    import os
+    impl = os.environ.get("DS_ATTN_IMPL", "").lower()
+    if impl == "xla":
+        return False
+    if impl == "flash":
+        return True
+    return seq_k >= 512 and not has_bias and not has_mask
+
+
 def attention(q, k, v, *, causal=True, sm_scale=None, bias=None, mask=None,
               use_flash: Optional[bool] = None):
-    """Dispatch: Pallas flash kernel on TPU, jnp reference elsewhere.
+    """Dispatch: Pallas flash kernel on TPU (long seq), jnp/XLA reference
+    otherwise.
 
     ``use_flash`` forces one path (tests use False for the oracle); env
-    ``DS_ATTN_IMPL=flash|xla`` overrides the default for A/B benching
-    (at short seq the O(S^2) logits fit HBM comfortably and XLA's fused
-    softmax can beat the block loop — measure, don't guess)."""
-    import os
+    ``DS_ATTN_IMPL=flash|xla`` overrides the measured default in
+    :func:`_want_flash`."""
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
     if use_flash is None:
-        impl = os.environ.get("DS_ATTN_IMPL", "").lower()
-        if impl == "xla":
-            use_flash = False
-        elif impl == "flash":
-            use_flash = _flash_available()
-        else:
-            use_flash = _flash_available() and bias is None and mask is None
+        use_flash = _flash_available() and _want_flash(
+            k.shape[2], bias is not None, mask is not None)
     if use_flash:
+        if bias is not None or mask is not None:
+            raise ValueError(
+                "the flash kernel has no bias/mask input; drop "
+                "DS_ATTN_IMPL=flash / use_flash=True for masked attention")
         from deepspeed_tpu.ops.transformer import flash
         return flash.flash_attention(q, k, v, causal=causal, sm_scale=sm_scale)
     return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale,

@@ -29,6 +29,11 @@ from jax.experimental import pallas as pl
 
 from deepspeed_tpu.ops.transformer.attention import mha_reference
 
+
+def _interpret():
+    from deepspeed_tpu.ops._platform import effective_platform
+    return effective_platform() != "tpu"
+
 try:  # pltpu imports on TPU-enabled jaxlibs; interpret mode needs no TPU
     from jax.experimental.pallas import tpu as pltpu
     _SMEM = pltpu.SMEM
@@ -175,7 +180,7 @@ def decode_attention(q, k_cache, v_cache, cache_len, *, k_scale=None,
         in_specs=in_specs,
         out_specs=pl.BlockSpec((1, QROWS, D), lambda b: (b, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, QROWS, D), q.dtype),
-        interpret=jax.default_backend() != "tpu",
+        interpret=_interpret(),
     )(*operands)
     return out[:, :1, :].reshape(B, H, 1, D)
 

@@ -32,7 +32,8 @@ except Exception:  # pragma: no cover
 
 
 def _interpret():
-    return jax.default_backend() != "tpu"
+    from deepspeed_tpu.ops._platform import effective_platform
+    return effective_platform() != "tpu"
 
 
 NEG_INF = -1e30

@@ -22,7 +22,8 @@ from jax.experimental import pallas as pl
 
 
 def _interpret():
-    return jax.default_backend() != "tpu"
+    from deepspeed_tpu.ops._platform import effective_platform
+    return effective_platform() != "tpu"
 
 
 def _row_block(n_rows, hidden, budget_bytes=2 << 20):

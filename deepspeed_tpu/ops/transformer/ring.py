@@ -110,7 +110,8 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name: str, causal=True,
     the seq dim over the axis). Returns the global [B, H, S, D] output
     with the same sharding."""
     if use_flash is None:
-        use_flash = jax.default_backend() == "tpu"
+        from deepspeed_tpu.ops._platform import effective_platform
+        use_flash = effective_platform() == "tpu"
     spec = P(None, None, axis_name, None)
     fn = functools.partial(_ring_attention_local, axis_name=axis_name,
                            causal=causal, sm_scale=sm_scale,
@@ -145,7 +146,8 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis_name: str, causal=True,
                       sm_scale=None, use_flash=None):
     """DeepSpeed-Ulysses sequence parallelism: all-to-all seq↔heads."""
     if use_flash is None:
-        use_flash = jax.default_backend() == "tpu"
+        from deepspeed_tpu.ops._platform import effective_platform
+        use_flash = effective_platform() == "tpu"
     H = q.shape[1]
     axis_size = mesh.shape[axis_name]
     assert H % axis_size == 0, (

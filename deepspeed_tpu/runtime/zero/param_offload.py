@@ -217,18 +217,37 @@ class Zero3OffloadEngine:
                 bwd_cache[mod] = jax.jit(f)
             return bwd_cache[mod]
 
-        # zero.Init: one layer at a time on device, masters straight to host
+        # zero.Init: masters are born ON THE HOST — each layer's init runs
+        # on the CPU backend (JAX RNG is bit-deterministic across
+        # backends) and inter-layer shapes propagate via eval_shape, so
+        # NO parameter bytes ever cross the accelerator link at init.
+        # This matters doubly on asymmetric links: the axon tunnel moves
+        # H2D at ~830 MB/s but D2H at ~4 MB/s, which priced a 6 GB
+        # init-time device_get at ~25 minutes. Init inputs are zeros
+        # (param shapes here don't depend on input values).
+        try:
+            cpu_dev = jax.local_devices(backend="cpu")[0]
+        except RuntimeError:  # pragma: no cover — cpu backend always exists
+            cpu_dev = None
         rng = jax.random.PRNGKey(seed)
-        x = self.input_fn(sample_batch)
-        for i, m in enumerate(self.layers):
-            lrng = jax.random.fold_in(rng, i)
-            if i < len(self.layers) - 1:
-                variables = jinit(m)(lrng, x)
-                x = fwd(m)(variables["params"], x)
-            else:
-                variables = jinit(m)(lrng, x, sample_batch)
-            self.store.add_layer(variables["params"])
-            del variables  # device copy freed; host master is authoritative
+        x_aval = jax.eval_shape(lambda b: jnp.asarray(self.input_fn(b)),
+                                sample_batch)
+        batch_zeros = jax.tree.map(
+            lambda l: np.zeros(np.shape(l), np.asarray(l).dtype),
+            sample_batch)
+        with jax.default_device(cpu_dev):
+            for i, m in enumerate(self.layers):
+                lrng = jax.random.fold_in(rng, i)
+                x_zero = jnp.zeros(x_aval.shape, x_aval.dtype)
+                if i < len(self.layers) - 1:
+                    variables = jinit(m)(lrng, x_zero)
+                    x_aval = jax.eval_shape(
+                        lambda p, xx, mod=m: mod.apply({"params": p}, xx),
+                        variables["params"], x_aval)
+                else:
+                    variables = jinit(m)(lrng, x_zero, batch_zeros)
+                self.store.add_layer(variables["params"])
+                del variables  # host master is authoritative
         # moments live with the masters (RAM; the optimizer-state NVMe
         # swapper in zero/offload.py covers disk-resident moments)
         self._m = [[np.zeros_like(h) for h in self.store.host_leaves(i)]

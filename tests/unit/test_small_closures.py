@@ -146,3 +146,29 @@ def test_gpt2_moe_trains_and_uses_experts():
     batch = synthetic_batch(8, 32, cfg.vocab_size, seed=0)
     losses = [float(engine.train_batch(batch=batch)) for _ in range(6)]
     assert losses[-1] < losses[0]
+
+
+class TestAttentionDispatch:
+    """Measured dispatch default (PERF.md): XLA attention below seq 512,
+    flash above; DS_ATTN_IMPL forces; forced flash with a mask raises."""
+
+    def test_want_flash_thresholds(self, monkeypatch):
+        from deepspeed_tpu.ops.transformer.attention import _want_flash
+        monkeypatch.delenv("DS_ATTN_IMPL", raising=False)
+        assert not _want_flash(128, False, False)
+        assert _want_flash(512, False, False)
+        assert _want_flash(1024, False, False)
+        assert not _want_flash(1024, False, True)   # mask -> reference
+        monkeypatch.setenv("DS_ATTN_IMPL", "xla")
+        assert not _want_flash(2048, False, False)
+        monkeypatch.setenv("DS_ATTN_IMPL", "flash")
+        assert _want_flash(128, False, False)
+
+    def test_forced_flash_with_mask_raises(self):
+        import jax.numpy as jnp
+        import pytest
+        from deepspeed_tpu.ops.transformer.attention import attention
+        q = jnp.ones((1, 1, 8, 4))
+        with pytest.raises(ValueError, match="bias/mask"):
+            attention(q, q, q, mask=jnp.ones((1, 1, 8, 8), bool),
+                      use_flash=True)
