@@ -36,7 +36,11 @@ def main():
     import jax.numpy as jnp
     ids = jnp.zeros((bs, prompt_len), jnp.int32)
     params = model.init(jax.random.PRNGKey(0), {"input_ids": ids})["params"]
-    eng = deepspeed_tpu.init_inference(model, params=params)
+    # DECODE_DTYPE=int8: module_quantize path (int8 weight storage,
+    # dequant folded into the matmuls)
+    dt_name = os.environ.get("DECODE_DTYPE", "bf16")
+    dtype = {"bf16": None, "int8": jnp.int8}[dt_name]
+    eng = deepspeed_tpu.init_inference(model, params=params, dtype=dtype)
 
     prompt = jnp.asarray(np.random.default_rng(0).integers(
         0, cfg.vocab_size, (bs, prompt_len)), jnp.int32)
@@ -65,7 +69,7 @@ def main():
     total_new = bs * new_tokens
     print(json.dumps({
         "metric": f"{name} cached decode (bs={bs} prompt={prompt_len} "
-                  f"new={new_tokens}, bf16)",
+                  f"new={new_tokens}, {dt_name})",
         "tokens_per_s": round(total_new / dt, 1),
         "ms_per_token_step": round(per_step_ms, 3),
         "batch_latency_s": round(dt, 3),
