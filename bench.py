@@ -152,6 +152,9 @@ def main():
         batch_size, seq_len, steps, default_zero = bench_shapes[name]
         zero_stage = int(os.environ.get("BENCH_ZERO", str(default_zero)))
         batch_size = int(os.environ.get("BENCH_BS", str(batch_size)))
+        # BENCH_SEQ: long-context rows (flash keeps memory O(seq), so a
+        # single chip trains seq >> the preset's 1024)
+        seq_len = int(os.environ.get("BENCH_SEQ", str(seq_len)))
     else:  # CPU smoke fallback so the bench always emits a line
         name = "gpt2-toy"
         batch_size, seq_len, steps = 2, 128, 3
@@ -177,6 +180,9 @@ def main():
         cfg = (PRESETS[name] if name in PRESETS else
                GPT2Config(vocab_size=2048, n_positions=256, n_embd=128,
                           n_layer=2, n_head=4))
+        if seq_len > cfg.n_positions:
+            import dataclasses as _dc
+            cfg = _dc.replace(cfg, n_positions=seq_len)
         model = GPT2LMHeadModel(cfg)
         optimizer = {"type": "Adam", "params": {"lr": 1e-4}}
 

@@ -9,9 +9,12 @@ memory O(seq) instead of O(seq^2) — the kernel never materialises the
 [S, S] score matrix, which is what lets the TPU build run the long-context
 configs (SURVEY.md §5.7) densely where the reference needed block-sparsity.
 
-Layout: [batch, heads, seq, head_dim]; grid over (batch*heads, blocks);
-fp32 accumulators in VMEM; causal blocks above the diagonal are skipped via
-the loop bound (not masked), so causal attention does ~half the FLOPs.
+Layout: [batch, heads, seq, head_dim]; fp32 accumulators in VMEM. TWO
+kernel forms per pass, dispatched on sequence length (_use_streaming):
+resident (≤ 4096: full K/V staged per program, causal skip via the loop
+bound — ~11% faster at 1024) and streaming (beyond: K/V blocks stream
+through the innermost grid axis with scratch accumulators — O(block)
+VMEM, unbounded seq; the resident form VMEM-OOMs at 8192).
 
 All kernels run in interpret mode off-TPU so CPU tests exercise the same
 code path bit-for-bit (tests/unit/test_flash.py).
@@ -41,7 +44,170 @@ LANES = 8  # replication width for per-row stats (lse/delta) — see _fwd_kernel
 
 
 # --------------------------------------------------------------------- forward
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
+#
+# All three kernels STREAM their long axis through the grid (kv blocks
+# for fwd/dq, q blocks for dkv) with fp32 VMEM scratch accumulators that
+# persist across the innermost grid axis — so per-program VMEM is
+# O(block), independent of sequence length. The previous design staged
+# the full K/V (resp. Q) per program, which VMEM-OOMed at seq 8192.
+# Causal blocks entirely above the diagonal skip their compute via
+# pl.when (the block fetch still pipelines — bandwidth, not FLOPs).
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                *, sm_scale, causal, block_q, block_k, num_kv, offset):
+    qi = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # causal: kv block j intersects rows [qi*BQ, (qi+1)*BQ) only if its
+    # first key column is <= the block's last row + offset
+    live = (j * block_k <= (qi + 1) * block_q - 1 + offset) \
+        if causal else True
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0]  # [BQ, D] native dtype — bf16 operands keep the MXU
+        # at full rate; accumulation is f32 via preferred_element_type
+        k = k_ref[0]
+        v = v_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(cols <= rows + offset, s, NEG_INF)
+
+        m = m_ref[:, 0]
+        l = l_ref[:, 0]
+        m_new = jnp.maximum(m, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=1)
+        acc_ref[...] = acc_ref[...] * alpha[:, None] + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
+
+    @pl.when(j == num_kv - 1)
+    def _finalize():
+        l = l_ref[:, 0]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[...] / l_safe[:, None]).astype(o_ref.dtype)
+        # lse is replicated over LANES trailing lanes so the 2D-per-row
+        # value satisfies the TPU (8, 128)-tile constraint (same trick as
+        # jax's own flash kernel, which pads to 128; 8 keeps it small)
+        lse_ref[0] = jnp.broadcast_to(
+            (m_ref[:, 0] + jnp.log(l_safe))[:, None], (block_q, LANES))
+
+
+# -------------------------------------------------------------------- backward
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_acc_ref, *, sm_scale, causal, block_q, block_k, num_kv,
+               offset):
+    qi = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc_ref[...] = jnp.zeros_like(dq_acc_ref)
+
+    live = (j * block_k <= (qi + 1) * block_q - 1 + offset) \
+        if causal else True
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0, :, 0:1]      # [BQ, 1] (lane-replicated stats)
+        delta = delta_ref[0, :, 0:1]
+        k = k_ref[0]
+        v = v_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(cols <= rows + offset, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        dq_acc_ref[...] = dq_acc_ref[...] + jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == num_kv - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc_ref[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_acc_ref, dv_acc_ref, *, sm_scale, causal,
+                block_q, block_k, num_q, offset):
+    kj = pl.program_id(1)
+    i = pl.program_id(2)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
+
+    # causal: q block i reaches kv block kj only if its last row + offset
+    # is >= the kv block's first key column
+    live = ((i + 1) * block_q - 1 + offset >= kj * block_k) \
+        if causal else True
+
+    @pl.when(live)
+    def _compute():
+        k = k_ref[0]  # [BK, D]
+        v = v_ref[0]
+        q = q_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0, :, 0:1]      # [BQ, 1]
+        delta = delta_ref[0, :, 0:1]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            rows = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = kj * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(cols <= rows + offset, s, NEG_INF)
+        p = jnp.exp(s - lse)                                # [BQ, BK]
+        dv_acc_ref[...] = dv_acc_ref[...] + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        dk_acc_ref[...] = dk_acc_ref[...] + jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(i == num_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc_ref[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc_ref[...].astype(dv_ref.dtype)
+
+
+
+
+# ---------------- resident variants (seq <= _RESIDENT_MAX_SEQ) -----------
+# The full K/V (resp. Q) is staged in VMEM per program and the kv loop
+# runs inside the kernel with the causal loop-bound skip. ~11% faster
+# than the streaming form at seq 1024 (no revisit bubbles, true FLOP
+# skip), but VMEM is O(seq) so it caps out; measured good through 4096.
+
+def _fwd_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
                 block_q, block_k, seq_k, offset):
     qi = pl.program_id(1)
     q = q_ref[0]  # [BQ, D] native dtype — bf16 operands keep the MXU at
@@ -91,8 +257,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
                                   (block_q, LANES))
 
 
-# -------------------------------------------------------------------- backward
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+def _dq_kernel_resident(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
                sm_scale, causal, block_q, block_k, seq_k, offset):
     qi = pl.program_id(1)
     q = q_ref[0]
@@ -129,7 +294,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _dkv_kernel_resident(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, *, sm_scale, causal, block_q, block_k, seq_q,
                 offset):
     kj = pl.program_id(1)
@@ -188,6 +353,20 @@ def _pick_block(seq, target=None):
     return max(b, 1)
 
 
+# Above this many keys/queries the resident kernels' O(seq) VMEM staging
+# no longer fits (measured: 4096 good, 8192 OOMs the 16 MB VMEM) and the
+# O(block)-VMEM streaming kernels take over (~11% slower at 1024, but
+# unbounded in seq). DS_FLASH_STREAM=1 forces streaming everywhere.
+_RESIDENT_MAX_SEQ = 4096
+
+
+def _use_streaming(Sq, Sk):
+    import os
+    if os.environ.get("DS_FLASH_STREAM", "") == "1":
+        return True
+    return max(Sq, Sk) > _RESIDENT_MAX_SEQ
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def flash_attention(q, k, v, causal=True, sm_scale=None):
     out, _ = _flash_fwd(q, k, v, causal, sm_scale)
@@ -195,6 +374,9 @@ def flash_attention(q, k, v, causal=True, sm_scale=None):
 
 
 def _flash_fwd(q, k, v, causal, sm_scale):
+    if pltpu is None:  # pragma: no cover — guarded import at module top
+        raise RuntimeError("flash attention needs jax.experimental.pallas"
+                           ".tpu (VMEM scratch accumulators)")
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
     B, H, Sq, D = q.shape
@@ -204,25 +386,61 @@ def _flash_fwd(q, k, v, causal, sm_scale):
     kf = k.reshape(B * H, Sk, D)
     vf = v.reshape(B * H, Sk, D)
 
+    if not _use_streaming(Sq, Sk):
+        kernel = functools.partial(
+            _fwd_kernel_resident, sm_scale=sm_scale, causal=causal,
+            block_q=bq, block_k=bk, seq_k=Sk, offset=Sk - Sq)
+        o, lse = pl.pallas_call(
+            kernel,
+            grid=(B * H, Sq // bq),
+            in_specs=[
+                pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+                pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
+                pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+                pl.BlockSpec((1, bq, LANES), lambda b, i: (b, i, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
+                jax.ShapeDtypeStruct((B * H, Sq, LANES), jnp.float32),
+            ],
+            interpret=_interpret(),
+        )(qf, kf, vf)
+        out = o.reshape(B, H, Sq, D)
+        return out, (q, k, v, out, lse)
+
+    num_kv = Sk // bk
     kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
-                               block_q=bq, block_k=bk, seq_k=Sk,
+                               block_q=bq, block_k=bk, num_kv=num_kv,
                                offset=Sk - Sq)
     o, lse = pl.pallas_call(
         kernel,
-        grid=(B * H, Sq // bq),
+        # kv blocks stream through the innermost grid axis; the scratch
+        # accumulators carry across it and the output block (same (b, i)
+        # for every j) is written on the last visit
+        grid=(B * H, Sq // bq, num_kv),
         in_specs=[
-            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, bq, LANES), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, LANES), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
             jax.ShapeDtypeStruct((B * H, Sq, LANES), jnp.float32),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, D), jnp.float32),
+            pltpu.VMEM((bq, LANES), jnp.float32),
+            pltpu.VMEM((bq, LANES), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(qf, kf, vf)
     out = o.reshape(B, H, Sq, D)
@@ -253,43 +471,101 @@ def _flash_bwd(causal, sm_scale, res, g, g_lse=None):
         delta_rows = delta_rows - g_lse.reshape(B * H, Sq, 1)
     delta = jnp.broadcast_to(delta_rows, (B * H, Sq, LANES))
 
+    if not _use_streaming(Sq, Sk):
+        dq = pl.pallas_call(
+            functools.partial(
+                _dq_kernel_resident, sm_scale=sm_scale, causal=causal,
+                block_q=bq, block_k=bk, seq_k=Sk, offset=Sk - Sq),
+            grid=(B * H, Sq // bq),
+            in_specs=[
+                pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+                pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
+                pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
+                pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+                pl.BlockSpec((1, bq, LANES), lambda b, i: (b, i, 0)),
+                pl.BlockSpec((1, bq, LANES), lambda b, i: (b, i, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+            out_shape=jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
+            interpret=_interpret(),
+        )(qf, kf, vf, dof, lse, delta)
+        dk, dv = pl.pallas_call(
+            functools.partial(
+                _dkv_kernel_resident, sm_scale=sm_scale, causal=causal,
+                block_q=bq, block_k=bk, seq_q=Sq, offset=Sk - Sq),
+            grid=(B * H, Sk // bk),
+            in_specs=[
+                pl.BlockSpec((1, Sq, D), lambda b, j: (b, 0, 0)),
+                pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0)),
+                pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0)),
+                pl.BlockSpec((1, Sq, D), lambda b, j: (b, 0, 0)),
+                pl.BlockSpec((1, Sq, LANES), lambda b, j: (b, 0, 0)),
+                pl.BlockSpec((1, Sq, LANES), lambda b, j: (b, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0)),
+                pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((B * H, Sk, D), k.dtype),
+                jax.ShapeDtypeStruct((B * H, Sk, D), v.dtype),
+            ],
+            interpret=_interpret(),
+        )(qf, kf, vf, dof, lse, delta)
+        return (dq.reshape(B, H, Sq, D), dk.reshape(B, H, Sk, D),
+                dv.reshape(B, H, Sk, D))
+
+    num_kv = Sk // bk
+    num_q = Sq // bq
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, sm_scale=sm_scale, causal=causal,
-                          block_q=bq, block_k=bk, seq_k=Sk, offset=Sk - Sq),
-        grid=(B * H, Sq // bq),
+                          block_q=bq, block_k=bk, num_kv=num_kv,
+                          offset=Sk - Sq),
+        grid=(B * H, num_q, num_kv),
         in_specs=[
-            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, bq, LANES), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, bq, LANES), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, LANES), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, LANES), lambda b, i, j: (b, i, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+        out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(qf, kf, vf, dof, lse, delta)
 
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, sm_scale=sm_scale, causal=causal,
-                          block_q=bq, block_k=bk, seq_q=Sq, offset=Sk - Sq),
-        grid=(B * H, Sk // bk),
+                          block_q=bq, block_k=bk, num_q=num_q,
+                          offset=Sk - Sq),
+        # q blocks stream through the innermost axis per kv block
+        grid=(B * H, num_kv, num_q),
         in_specs=[
-            pl.BlockSpec((1, Sq, D), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, Sq, D), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, Sq, LANES), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, Sq, LANES), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, LANES), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, LANES), lambda b, j, i: (b, i, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B * H, Sk, D), k.dtype),
             jax.ShapeDtypeStruct((B * H, Sk, D), v.dtype),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, D), jnp.float32),
+            pltpu.VMEM((bk, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(qf, kf, vf, dof, lse, delta)
 

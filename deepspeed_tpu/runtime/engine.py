@@ -545,7 +545,11 @@ class DeepSpeedEngine:
             assert sample_batch is not None, (
                 "need model_parameters or sample_batch to initialise the model")
             rng = jax.random.PRNGKey(self._seed)
-            params = self.module.init(rng, sample_batch)
+            # jit, not eager: only the param outputs are live, so jaxpr
+            # DCE drops the whole traced forward — init neither executes
+            # the model nor lowers its kernels (an eager fp32 init
+            # forward VMEM-OOMed the flash kernel at seq 8192)
+            params = jax.jit(self.module.init)(rng, sample_batch)
             if isinstance(params, dict) and set(params.keys()) == {"params"}:
                 params = params["params"]
         # fp32 master copy (reference FP16_Optimizer master weights)

@@ -125,3 +125,65 @@ def test_flash_with_lse_grads_match_reference():
     for gf, gr in zip(g_flash, g_ref):
         np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
                                    rtol=2e-4, atol=2e-4)
+
+
+class TestStreamingKernels:
+    """The O(block)-VMEM streaming form (seq > _RESIDENT_MAX_SEQ, or
+    DS_FLASH_STREAM=1) must match the resident form and the reference —
+    fwd, bwd, causal, decode offset, and the with_lse form."""
+
+    @pytest.fixture(autouse=True)
+    def _force_stream(self, monkeypatch):
+        monkeypatch.setenv("DS_FLASH_STREAM", "1")
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_stream_fwd_bwd_parity(self, causal):
+        q, k, v = [jnp.asarray(np.random.default_rng(i).standard_normal(
+            (1, 2, 128, 64)), jnp.float32) for i in range(3)]
+
+        def loss_f(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=causal) ** 2)
+
+        def loss_r(q, k, v):
+            return jnp.sum(mha_reference(q, k, v, causal=causal) ** 2)
+
+        np.testing.assert_allclose(
+            flash_attention(q, k, v, causal=causal),
+            mha_reference(q, k, v, causal=causal), atol=2e-3, rtol=2e-3)
+        gf = jax.grad(loss_f, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(a, b, atol=5e-3, rtol=5e-3)
+
+    def test_stream_decode_offset(self):
+        # q is a 64-row suffix of a 128-key sequence (decode offset)
+        rng = np.random.default_rng(0)
+        k = jnp.asarray(rng.standard_normal((1, 2, 128, 64)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((1, 2, 128, 64)), jnp.float32)
+        q = jnp.asarray(rng.standard_normal((1, 2, 64, 64)), jnp.float32)
+        np.testing.assert_allclose(
+            flash_attention(q, k, v, causal=True),
+            mha_reference(q, k, v, causal=True), atol=2e-3, rtol=2e-3)
+
+    def test_stream_with_lse_matches(self):
+        from deepspeed_tpu.ops.transformer.flash import \
+            flash_attention_with_lse
+        rng = np.random.default_rng(1)
+        q, k, v = [jnp.asarray(rng.standard_normal((1, 1, 128, 64)),
+                               jnp.float32) for _ in range(3)]
+        o, lse = flash_attention_with_lse(q, k, v, causal=True)
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (64 ** -0.5)
+        mask = jnp.tril(jnp.ones((128, 128), bool))
+        logits = jnp.where(mask[None, None], logits, -1e30)
+        np.testing.assert_allclose(
+            lse, jax.scipy.special.logsumexp(logits, axis=-1),
+            atol=2e-3, rtol=2e-3)
+
+    def test_selector(self, monkeypatch):
+        from deepspeed_tpu.ops.transformer.flash import _use_streaming
+        monkeypatch.delenv("DS_FLASH_STREAM", raising=False)
+        assert not _use_streaming(1024, 1024)
+        assert not _use_streaming(4096, 4096)
+        assert _use_streaming(8192, 8192)
+        monkeypatch.setenv("DS_FLASH_STREAM", "1")
+        assert _use_streaming(128, 128)
