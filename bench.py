@@ -165,6 +165,11 @@ def main():
                                                BertForPreTraining,
                                                synthetic_mlm_batch)
         cfg = BERT_PRESETS["bert-large"]
+        if seq_len > cfg.max_position_embeddings:
+            # widen the position table — otherwise XLA silently clamps
+            # out-of-range position gathers and benches a degenerate model
+            import dataclasses as _dc
+            cfg = _dc.replace(cfg, max_position_embeddings=seq_len)
         model = BertForPreTraining(cfg)
         optimizer = {"type": "Lamb", "params": {"lr": 1e-4, "fused": True}}
         # BENCH_MLM=masked: the reference pretraining data format

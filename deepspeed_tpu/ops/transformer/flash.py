@@ -26,12 +26,19 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-try:  # pltpu only imports on TPU-enabled jaxlibs; interpret mode needs no TPU
+try:  # pltpu only imports on TPU-enabled jaxlibs; interpret mode needs no
+    # TPU — only the STREAMING kernels (VMEM scratch) require it
     from jax.experimental.pallas import tpu as pltpu
-    _VMEM = pltpu.VMEM
 except Exception:  # pragma: no cover
     pltpu = None
-    _VMEM = None
+
+
+def _require_pltpu():
+    if pltpu is None:  # pragma: no cover — guarded import above
+        raise RuntimeError(
+            "the streaming flash kernels (seq > 4096) need "
+            "jax.experimental.pallas.tpu for VMEM scratch accumulators; "
+            "this jaxlib cannot import it")
 
 
 def _interpret():
@@ -41,6 +48,15 @@ def _interpret():
 
 NEG_INF = -1e30
 LANES = 8  # replication width for per-row stats (lse/delta) — see _fwd_kernel
+
+
+def _apply_causal_mask(s, row0, col0, block_q, block_k, offset):
+    """Mask score block s ([BQ, BK] at rows row0.., cols col0..) so row r
+    only attends keys <= r + offset (offset = Sk - Sq, decode suffix)."""
+    rows = row0 + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    cols = col0 + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    return jnp.where(cols <= rows + offset, s, NEG_INF)
+
 
 
 # --------------------------------------------------------------------- forward
@@ -77,11 +93,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
         if causal:
-            rows = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            cols = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(cols <= rows + offset, s, NEG_INF)
+            s = _apply_causal_mask(s, qi * block_q, j * block_k, block_q, block_k, offset)
 
         m = m_ref[:, 0]
         l = l_ref[:, 0]
@@ -132,11 +144,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
         if causal:
-            rows = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            cols = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(cols <= rows + offset, s, NEG_INF)
+            s = _apply_causal_mask(s, qi * block_q, j * block_k, block_q, block_k, offset)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -177,11 +185,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
         if causal:
-            rows = i * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            cols = kj * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(cols <= rows + offset, s, NEG_INF)
+            s = _apply_causal_mask(s, i * block_q, kj * block_k, block_q, block_k, offset)
         p = jnp.exp(s - lse)                                # [BQ, BK]
         dv_acc_ref[...] = dv_acc_ref[...] + jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -227,11 +231,7 @@ def _fwd_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causa
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
         if causal:
-            rows = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            cols = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(cols <= rows + offset, s, NEG_INF)
+            s = _apply_causal_mask(s, qi * block_q, j * block_k, block_q, block_k, offset)
 
         m_new = jnp.maximum(m, jnp.max(s, axis=1))
         p = jnp.exp(s - m_new[:, None])
@@ -276,11 +276,7 @@ def _dq_kernel_resident(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
         if causal:
-            rows = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            cols = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(cols <= rows + offset, s, NEG_INF)
+            s = _apply_causal_mask(s, qi * block_q, j * block_k, block_q, block_k, offset)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -316,11 +312,7 @@ def _dkv_kernel_resident(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
         if causal:
-            rows = i * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            cols = kj * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(cols <= rows + offset, s, NEG_INF)
+            s = _apply_causal_mask(s, i * block_q, kj * block_k, block_q, block_k, offset)
         p = jnp.exp(s - lse)                                # [BQ, BK]
         dv = dv + jax.lax.dot_general(p.astype(do.dtype), do,
                                       (((0,), (0,)), ((), ())),
@@ -374,9 +366,6 @@ def flash_attention(q, k, v, causal=True, sm_scale=None):
 
 
 def _flash_fwd(q, k, v, causal, sm_scale):
-    if pltpu is None:  # pragma: no cover — guarded import at module top
-        raise RuntimeError("flash attention needs jax.experimental.pallas"
-                           ".tpu (VMEM scratch accumulators)")
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
     B, H, Sq, D = q.shape
@@ -411,6 +400,7 @@ def _flash_fwd(q, k, v, causal, sm_scale):
         out = o.reshape(B, H, Sq, D)
         return out, (q, k, v, out, lse)
 
+    _require_pltpu()
     num_kv = Sk // bk
     kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
                                block_q=bq, block_k=bk, num_kv=num_kv,
@@ -515,6 +505,7 @@ def _flash_bwd(causal, sm_scale, res, g, g_lse=None):
         return (dq.reshape(B, H, Sq, D), dk.reshape(B, H, Sk, D),
                 dv.reshape(B, H, Sk, D))
 
+    _require_pltpu()
     num_kv = Sk // bk
     num_q = Sq // bq
     dq = pl.pallas_call(
