@@ -282,6 +282,13 @@ def main():
         n_params = sum(x.size for x in jax.tree.leaves(engine.state.params))
 
     batch = make_batch(1)
+    if not layered:
+        # stage the batch on device once: a real training loop's loader
+        # prefetches, so the timed path should not pay the host->device
+        # transfer latency per step (through the remote tunnel that is
+        # 1-2 x ~100 ms RTT per step — it dominated the step time)
+        batch = jax.tree.map(jax.device_put, batch)
+        jax.block_until_ready(batch)
 
     # jax.block_until_ready is NOT a reliable barrier through the axon
     # tunnel (it returned immediately in round 3, inflating TFLOPS 5x);
