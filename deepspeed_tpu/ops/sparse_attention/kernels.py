@@ -21,6 +21,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
+try:  # needed for SMEM layout residency on TPU; interpret mode works without
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pltpu = None
+
 NEG_INF = -1e30
 LANES = 8
 
@@ -188,7 +193,12 @@ def block_sparse_attention(q, k, v, layout, key_padding_bias=None,
 
 
 def _specs(H, block, nq, D, S):
-    lay = pl.BlockSpec((1, nq, nq), lambda b, i: (b % H, 0, 0))
+    # the layout LUT lives in SMEM: the kernels read layout[0, qi, j] at a
+    # DYNAMIC j, and Mosaic only allows unaligned dynamic scalar loads
+    # from scalar memory (a VMEM i32 load must be 128-lane aligned —
+    # failed to compile at seq 512). nq^2 i32 is a few KB.
+    lay = pl.BlockSpec((1, nq, nq), lambda b, i: (b % H, 0, 0),
+                       memory_space=(pltpu.SMEM if pltpu else None))
     qb = pl.BlockSpec((1, block, D), lambda b, i: (b, i, 0))
     full = pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0))
     stat = pl.BlockSpec((1, block, LANES), lambda b, i: (b, i, 0))
