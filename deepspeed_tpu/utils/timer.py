@@ -1,9 +1,10 @@
 """Wall-clock and throughput timers.
 
 Parity with the reference ``deepspeed/utils/timer.py``
-(``SynchronizedWallClockTimer`` timer.py:23, ``ThroughputTimer`` :122) with
-the CUDA synchronisation replaced by blocking on JAX async dispatch
-(``jax.block_until_ready`` / ``jax.effects_barrier``).
+(``SynchronizedWallClockTimer`` timer.py:23, ``ThroughputTimer`` :122).
+The CUDA synchronisation maps to a dispatch-ordered trivial program +
+device_get (see ``_device_synchronize``) for the breakdown timers, and a
+cheap effects barrier for the per-step throughput timer.
 """
 
 import time
@@ -17,8 +18,36 @@ except ImportError:
     PSUTIL_AVAILABLE = False
 
 
+_SYNC_FN = None
+
+
 def _device_synchronize():
-    """Drain the async dispatch queue so host timestamps bound device work."""
+    """TRUE device barrier: programs execute in dispatch order, so fetching
+    the result of a freshly dispatched trivial program proves everything
+    dispatched before it has finished. ``jax.effects_barrier`` /
+    ``block_until_ready`` are NOT sufficient — they don't drain pure
+    computations (through the remote tunnel they return immediately, and
+    the round-3 wall-clock numbers measured dispatch, not device time).
+    Costs one host<->device round trip — which is why only the
+    wall_clock_breakdown timers use it, per phase boundary, and only when
+    the flag is on (the reference's timers pay cuda.synchronize the same
+    way)."""
+    global _SYNC_FN
+    try:
+        import jax
+        import jax.numpy as jnp
+        if _SYNC_FN is None:
+            _SYNC_FN = jax.jit(lambda: jnp.zeros(()))
+        jax.device_get(_SYNC_FN())
+    except Exception:
+        pass
+
+
+def _dispatch_barrier():
+    """Cheap ordering barrier for the throughput timer: waits only for
+    effectful ops. Per-step true syncs would add a tunnel round trip to
+    EVERY step; across the tput timer's 50-step windows the bounded
+    dispatch queue makes host-side timestamps asymptotically correct."""
     try:
         import jax
         jax.effects_barrier()
@@ -141,7 +170,7 @@ class ThroughputTimer:
         self._init_timer()
         self.started = True
         if self.global_step_count >= self.start_step:
-            _device_synchronize()
+            _dispatch_barrier()
             self.start_time = time.time()
 
     def stop(self, global_step=False, report_speed=True):
@@ -152,7 +181,7 @@ class ThroughputTimer:
         if global_step:
             self.global_step_count += 1
         if self.start_time > 0:
-            _device_synchronize()
+            _dispatch_barrier()
             self.end_time = time.time()
             duration = self.end_time - self.start_time
             self.total_elapsed_time += duration
