@@ -202,9 +202,13 @@ def main():
             cfg = (PRESETS[name] if name in PRESETS else
                    GPT2Config(vocab_size=2048, n_positions=256, n_embd=128,
                               n_layer=2, n_head=4))
+        import dataclasses as _dc
         if seq_len > cfg.n_positions:
-            import dataclasses as _dc
             cfg = _dc.replace(cfg, n_positions=seq_len)
+        if os.environ.get("BENCH_REMAT", "") == "1":
+            # activation rematerialisation: longest contexts trade ~30%
+            # recompute flops for O(layers) less activation HBM
+            cfg = _dc.replace(cfg, remat=True)
         model = GPT2LMHeadModel(cfg)
         optimizer = {"type": "Adam", "params": {"lr": 1e-4}}
 
