@@ -16,3 +16,8 @@ def effective_platform() -> str:
     if dd is not None:
         return dd.platform
     return jax.default_backend()
+
+
+def interpret() -> bool:
+    """True when Pallas kernels must run in interpret mode (not on TPU)."""
+    return effective_platform() != "tpu"

@@ -21,8 +21,8 @@ _LANES = 128
 
 
 def _interpret():
-    from deepspeed_tpu.ops._platform import effective_platform
-    return effective_platform() != "tpu"
+    from deepspeed_tpu.ops._platform import interpret
+    return interpret()
 
 
 def _lamb_pass1_kernel(s_ref, p_ref, g_ref, m_ref, v_ref,

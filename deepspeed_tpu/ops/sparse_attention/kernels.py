@@ -26,13 +26,10 @@ try:  # needed for SMEM layout residency on TPU; interpret mode works without
 except Exception:  # pragma: no cover
     pltpu = None
 
+from deepspeed_tpu.ops._platform import interpret as _interpret
+
 NEG_INF = -1e30
 LANES = 8
-
-
-def _interpret():
-    from deepspeed_tpu.ops._platform import effective_platform
-    return effective_platform() != "tpu"
 
 
 def _fwd_kernel(layout_ref, kpm_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,

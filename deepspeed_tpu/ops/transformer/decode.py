@@ -27,12 +27,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from deepspeed_tpu.ops._platform import interpret as _interpret
 from deepspeed_tpu.ops.transformer.attention import mha_reference
-
-
-def _interpret():
-    from deepspeed_tpu.ops._platform import effective_platform
-    return effective_platform() != "tpu"
 
 try:  # pltpu imports on TPU-enabled jaxlibs; interpret mode needs no TPU
     from jax.experimental.pallas import tpu as pltpu

@@ -41,10 +41,7 @@ def _require_pltpu():
             "this jaxlib cannot import it")
 
 
-def _interpret():
-    from deepspeed_tpu.ops._platform import effective_platform
-    return effective_platform() != "tpu"
-
+from deepspeed_tpu.ops._platform import interpret as _interpret
 
 NEG_INF = -1e30
 LANES = 8  # replication width for per-row stats (lse/delta) — see _fwd_kernel
@@ -56,7 +53,6 @@ def _apply_causal_mask(s, row0, col0, block_q, block_k, offset):
     rows = row0 + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
     cols = col0 + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
     return jnp.where(cols <= rows + offset, s, NEG_INF)
-
 
 
 # --------------------------------------------------------------------- forward
@@ -93,7 +89,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
         if causal:
-            s = _apply_causal_mask(s, qi * block_q, j * block_k, block_q, block_k, offset)
+            s = _apply_causal_mask(s, qi * block_q, j * block_k,
+                                   block_q, block_k, offset)
 
         m = m_ref[:, 0]
         l = l_ref[:, 0]
@@ -144,7 +141,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
         if causal:
-            s = _apply_causal_mask(s, qi * block_q, j * block_k, block_q, block_k, offset)
+            s = _apply_causal_mask(s, qi * block_q, j * block_k,
+                                   block_q, block_k, offset)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -185,7 +183,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
         if causal:
-            s = _apply_causal_mask(s, i * block_q, kj * block_k, block_q, block_k, offset)
+            s = _apply_causal_mask(s, i * block_q, kj * block_k,
+                                   block_q, block_k, offset)
         p = jnp.exp(s - lse)                                # [BQ, BK]
         dv_acc_ref[...] = dv_acc_ref[...] + jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -201,8 +200,6 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _finalize():
         dk_ref[0] = dk_acc_ref[...].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc_ref[...].astype(dv_ref.dtype)
-
-
 
 
 # ---------------- resident variants (seq <= _RESIDENT_MAX_SEQ) -----------
@@ -231,7 +228,8 @@ def _fwd_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causa
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
         if causal:
-            s = _apply_causal_mask(s, qi * block_q, j * block_k, block_q, block_k, offset)
+            s = _apply_causal_mask(s, qi * block_q, j * block_k,
+                                   block_q, block_k, offset)
 
         m_new = jnp.maximum(m, jnp.max(s, axis=1))
         p = jnp.exp(s - m_new[:, None])
@@ -276,7 +274,8 @@ def _dq_kernel_resident(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
         if causal:
-            s = _apply_causal_mask(s, qi * block_q, j * block_k, block_q, block_k, offset)
+            s = _apply_causal_mask(s, qi * block_q, j * block_k,
+                                   block_q, block_k, offset)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -312,7 +311,8 @@ def _dkv_kernel_resident(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
         if causal:
-            s = _apply_causal_mask(s, i * block_q, kj * block_k, block_q, block_k, offset)
+            s = _apply_causal_mask(s, i * block_q, kj * block_k,
+                                   block_q, block_k, offset)
         p = jnp.exp(s - lse)                                # [BQ, BK]
         dv = dv + jax.lax.dot_general(p.astype(do.dtype), do,
                                       (((0,), (0,)), ((), ())),

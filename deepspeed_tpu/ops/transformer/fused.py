@@ -21,9 +21,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 
-def _interpret():
-    from deepspeed_tpu.ops._platform import effective_platform
-    return effective_platform() != "tpu"
+from deepspeed_tpu.ops._platform import interpret as _interpret
 
 
 def _row_block(n_rows, hidden, budget_bytes=2 << 20):
