@@ -55,6 +55,7 @@ class MoE(nn.Module):
     noisy_gate_policy: Optional[str] = None
     drop_tokens: bool = True
     use_rts: bool = True
+    dispatch_impl: str = "scatter"      # see MOELayer.dispatch_impl
 
     @nn.compact
     def __call__(self, hidden_states, used_token=None, train=True):
@@ -74,6 +75,7 @@ class MoE(nn.Module):
             noisy_gate_policy=self.noisy_gate_policy,
             drop_tokens=self.drop_tokens,
             use_rts=self.use_rts,
+            dispatch_impl=self.dispatch_impl,
             name="deepspeed_moe")(hidden_states, train,
                                   used_token=used_token)
 

@@ -50,11 +50,15 @@ def _expert_constraint(x):
 
 def top1gating(logits, capacity_factor=1.0, min_capacity=4,
                noisy_gate_policy: Optional[str] = None, noise_rng=None,
-               drop_tokens=True, use_rts=True, used_token=None):
+               drop_tokens=True, use_rts=True, used_token=None,
+               sparse=False):
     """Top-1 gating (reference sharded_moe.py:170).
 
     logits: [S, E]. Returns (l_aux, combine_weights [S,E,C],
-    dispatch_mask [S,E,C] bool, exp_counts [E])."""
+    dispatch_mask [S,E,C] bool, exp_counts [E]); with ``sparse=True``
+    the dense [S,E,C] tensors are never built and the routing comes back
+    factored as (l_aux, [(expert_s, slot_s, gate_s, valid_s)], C,
+    exp_counts) — same math, O(S) memory instead of O(S*E*C)."""
     S, E = logits.shape
     # drop_tokens=False must never drop: the reference grows capacity to
     # max(exp_counts) at runtime (sharded_moe.py:207); under jit capacity
@@ -101,6 +105,13 @@ def top1gating(logits, capacity_factor=1.0, min_capacity=4,
         mask1 = mask1 * keep[:, None]
 
     gates1_s = jnp.sum(gates * mask1, axis=1)              # [S]
+    if sparse:
+        # factored routing: each token's (expert, slot, gate, alive) —
+        # the [S,E,C] tensors below are rank-1 products of exactly these
+        valid = jnp.sum(mask1, axis=1) > 0
+        routing = [(indices1_s.astype(jnp.int32),
+                    locations1.astype(jnp.int32), gates1_s, valid)]
+        return l_aux, routing, C, exp_counts
     locations1_sc = _one_hot(locations1.astype(jnp.int32), C)  # [S, C]
     combine = gates1_s[:, None, None] * mask1[:, :, None] * \
         locations1_sc[:, None, :]                          # [S, E, C]
@@ -108,9 +119,11 @@ def top1gating(logits, capacity_factor=1.0, min_capacity=4,
     return l_aux, combine, dispatch, exp_counts
 
 
-def top2gating(logits, capacity_factor=1.0, min_capacity=4, noise_rng=None):
+def top2gating(logits, capacity_factor=1.0, min_capacity=4, noise_rng=None,
+               sparse=False):
     """Top-2 gating (reference sharded_moe.py:271): second expert chosen
-    after masking the first; gate pair renormalised."""
+    after masking the first; gate pair renormalised. ``sparse=True`` as
+    in :func:`top1gating`, with two routing entries (one per choice)."""
     S, E = logits.shape
     C = _capacity(S, E, capacity_factor * 2, min_capacity)
 
@@ -154,6 +167,12 @@ def top2gating(logits, capacity_factor=1.0, min_capacity=4, noise_rng=None):
     gates1_s /= denom
     gates2_s /= denom
 
+    if sparse:
+        routing = [(indices1_s.astype(jnp.int32), loc1_s.astype(jnp.int32),
+                    gates1_s, jnp.sum(mask1, axis=1) > 0),
+                   (indices2_s.astype(jnp.int32), loc2_s.astype(jnp.int32),
+                    gates2_s, jnp.sum(mask2, axis=1) > 0)]
+        return l_aux, routing, C, exp_counts
     combine = (gates1_s[:, None, None] * mask1[:, :, None] *
                _one_hot(loc1_s.astype(jnp.int32), C)[:, None, :] +
                gates2_s[:, None, None] * mask2[:, :, None] *
@@ -174,7 +193,7 @@ class TopKGate(nn.Module):
     use_rts: bool = True
 
     @nn.compact
-    def __call__(self, x, train=True, used_token=None):
+    def __call__(self, x, train=True, used_token=None, sparse=False):
         # gate runs in fp32 always (reference :368 autocast exemption)
         wg = self.param("wg", nn.initializers.lecun_normal(),
                         (x.shape[-1], self.num_experts))
@@ -188,8 +207,8 @@ class TopKGate(nn.Module):
             return top1gating(logits, cf, self.min_capacity,
                               self.noisy_gate_policy if train else None,
                               rng, self.drop_tokens, self.use_rts,
-                              used_token=used_token)
-        return top2gating(logits, cf, self.min_capacity, rng)
+                              used_token=used_token, sparse=sparse)
+        return top2gating(logits, cf, self.min_capacity, rng, sparse=sparse)
 
 
 class MOELayer(nn.Module):
@@ -208,6 +227,15 @@ class MOELayer(nn.Module):
     noisy_gate_policy: Optional[str] = None
     drop_tokens: bool = True
     use_rts: bool = True
+    # "scatter" (default): route tokens by index — each token owns a
+    # unique (expert, slot) pair, so a scatter-add builds [E,C,M] and a
+    # gather reads it back, moving O(S*M) bytes. "einsum": the reference
+    # GShard formulation through dense [S,E,C] masks — O(S*E*C) memory
+    # traffic (335 MB fp32 per combine at the bench shape), kept for
+    # cross-checking. Bit-identical results (slots are unique, adding
+    # zeros is exact): tests/unit/test_moe.py locks parity and the golden
+    # loss curves pass under both.
+    dispatch_impl: str = "scatter"
 
     @nn.compact
     def __call__(self, x, train=True, used_token=None):
@@ -217,19 +245,37 @@ class MOELayer(nn.Module):
         if used_token is not None:
             used_token = used_token.reshape(-1)
 
-        l_aux, combine, dispatch, exp_counts = TopKGate(
+        gate = TopKGate(
             num_experts=self.num_experts, k=self.k,
             capacity_factor=self.capacity_factor,
             eval_capacity_factor=self.eval_capacity_factor,
             min_capacity=self.min_capacity,
             noisy_gate_policy=self.noisy_gate_policy,
             drop_tokens=self.drop_tokens, use_rts=self.use_rts,
-            name="gate")(xf, train, used_token=used_token)
+            name="gate")
+        E = self.num_experts
+        if self.dispatch_impl not in ("scatter", "einsum"):
+            raise ValueError(
+                f"dispatch_impl must be 'scatter' or 'einsum', got "
+                f"{self.dispatch_impl!r}")
 
-        # dispatch: [S,E,C] × [S,M] → [E,C,M]; the expert-axis constraint
-        # makes XLA insert the all-to-all (reference _AllToAll :84/:507)
-        dispatched = jnp.einsum("sec,sm->ecm",
-                                dispatch.astype(xf.dtype), xf)
+        if self.dispatch_impl == "scatter":
+            l_aux, routing, C, exp_counts = gate(
+                xf, train, used_token=used_token, sparse=True)
+            # one extra trash row swallows dropped tokens
+            buf = jnp.zeros((E * C + 1, M), xf.dtype)
+            for e_s, loc_s, _, valid in routing:
+                slot = jnp.where(valid, e_s * C + loc_s, E * C)
+                buf = buf.at[slot].add(xf)
+            dispatched = buf[:E * C].reshape(E, C, M)
+        else:
+            l_aux, combine, dispatch, exp_counts = gate(
+                xf, train, used_token=used_token)
+            # dispatch: [S,E,C] × [S,M] → [E,C,M]
+            dispatched = jnp.einsum("sec,sm->ecm",
+                                    dispatch.astype(xf.dtype), xf)
+        # the expert-axis constraint makes XLA insert the all-to-all
+        # (reference _AllToAll :84/:507)
         dispatched = _expert_constraint(dispatched)
 
         experts = nn.vmap(
@@ -242,6 +288,16 @@ class MOELayer(nn.Module):
         expert_out = experts(dispatched)                     # [E, C, M]
         expert_out = _expert_constraint(expert_out)
 
-        combined = jnp.einsum("sec,ecm->sm",
-                              combine.astype(expert_out.dtype), expert_out)
+        if self.dispatch_impl == "scatter":
+            flat = expert_out.reshape(E * C, M)
+            combined = jnp.zeros((xf.shape[0], M), expert_out.dtype)
+            for e_s, loc_s, gate_s, valid in routing:
+                slot = jnp.where(valid, e_s * C + loc_s, 0)
+                combined = combined + (
+                    gate_s * valid)[:, None].astype(expert_out.dtype) \
+                    * flat[slot]
+        else:
+            combined = jnp.einsum("sec,ecm->sm",
+                                  combine.astype(expert_out.dtype),
+                                  expert_out)
         return combined.reshape(orig_shape), l_aux, exp_counts

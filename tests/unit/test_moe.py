@@ -137,3 +137,27 @@ def test_expert_params_sharded_over_expert_axis():
     for path, leaf in expert_leaves:
         spec = leaf.sharding.spec
         assert spec and spec[0] == "expert", (path, spec)
+
+
+class TestDispatchImplParity:
+    """scatter (index routing) vs einsum (dense GShard masks) must agree
+    bit-for-bit in fp32: every token owns a unique (expert, slot), so the
+    scatter-add and the masked einsum compute the same sums."""
+
+    @pytest.mark.parametrize("k", [1, 2])
+    def test_scatter_matches_einsum(self, k):
+        from deepspeed_tpu.moe.layer import MoE
+        rng = np.random.default_rng(0)
+        # capacity_factor < 1 forces real drops so the trash-row path runs
+        x = jnp.asarray(rng.standard_normal((2, 24, 16)), jnp.float32)
+        outs = {}
+        for impl in ("scatter", "einsum"):
+            m = MoE(hidden_size=16, num_experts=4, k=k,
+                    capacity_factor=0.5, use_rts=False,
+                    dispatch_impl=impl)
+            params = m.init(jax.random.PRNGKey(0), x)
+            out, l_aux, counts = m.apply(params, x)
+            outs[impl] = (np.asarray(out), float(l_aux), np.asarray(counts))
+        np.testing.assert_array_equal(outs["scatter"][0], outs["einsum"][0])
+        assert outs["scatter"][1] == outs["einsum"][1]
+        np.testing.assert_array_equal(outs["scatter"][2], outs["einsum"][2])
