@@ -211,6 +211,8 @@ def main():
             cfg = _dc.replace(cfg, remat=True)
         model = GPT2LMHeadModel(cfg)
         optimizer = {"type": "Adam", "params": {"lr": 1e-4}}
+        if os.environ.get("BENCH_FUSED_OPT", "") == "1":
+            optimizer["params"]["fused"] = True  # Pallas fused-Adam path
 
         def make_batch(seed):
             return synthetic_batch(batch_size, seq_len, cfg.vocab_size,
