@@ -13,6 +13,7 @@ does real work — it materialises fully-gathered host copies of sharded
 """
 
 import contextlib
+import enum
 
 import jax
 
@@ -22,8 +23,42 @@ from deepspeed_tpu.runtime.zero.partition import (  # noqa: F401
     estimate_zero_mem)
 from deepspeed_tpu.runtime.zero.param_offload import (  # noqa: F401
     HostParamStore, Zero3OffloadEngine)
-from deepspeed_tpu.runtime.zero.tiling import TiledLinear  # noqa: F401
+from deepspeed_tpu.runtime.zero.tiling import (  # noqa: F401
+    TiledLinear, TiledLinearReturnBias)
 from deepspeed_tpu.utils.logging import logger
+
+
+class ZeroParamType(enum.Enum):
+    """Reference partition_parameters.py:182. Informational here: XLA
+    array shardings carry the partitioning state the reference tracks
+    per-parameter."""
+    NORMAL = 1
+    PARTITIONED = 2
+    REMOTE = 3
+
+
+class ZeroParamStatus(enum.Enum):
+    """Reference partition_parameters.py:195."""
+    AVAILABLE = 1
+    NOT_AVAILABLE = 2
+    INFLIGHT = 3
+
+
+def register_external_parameter(module, parameter):
+    """Reference partition_parameters.py:108: tells the ZeRO-3 hook
+    machinery to gather ``parameter`` around ANOTHER module's forward.
+    Under XLA there are no fetch/release hooks to inform — a traced
+    forward that reads a sharded param makes the compiler insert the
+    allgather exactly where it is used, whichever module reads it — so
+    cross-module parameter use needs no registration. Kept as an
+    accepted no-op so reference training code runs unchanged."""
+    del module, parameter
+
+
+def unregister_external_parameter(module, parameter):
+    """Reverse of :func:`register_external_parameter` (reference
+    partition_parameters.py:160) — equally a no-op under XLA."""
+    del module, parameter
 
 
 class Init:

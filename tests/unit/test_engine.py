@@ -240,3 +240,20 @@ class TestGradAccumDtype:
         assert acc_dtypes == {jnp.dtype(jnp.bfloat16)}
         losses = train_losses(engine, 32)
         assert losses[-1] < losses[0]
+
+
+def test_zero_public_surface_parity():
+    """deepspeed.zero exports (reference runtime/zero/__init__.py): the
+    enums, the external-parameter registry (accepted no-ops under XLA —
+    the compiler gathers params wherever a traced forward reads them),
+    Init/GatheredParameters, and both tiled linears."""
+    from deepspeed_tpu import zero
+    for name in ("ZeroParamType", "ZeroParamStatus", "Init",
+                 "GatheredParameters", "register_external_parameter",
+                 "unregister_external_parameter", "TiledLinear",
+                 "TiledLinearReturnBias"):
+        assert hasattr(zero, name), name
+    assert zero.ZeroParamType.REMOTE.value == 3
+    assert zero.ZeroParamStatus.INFLIGHT.value == 3
+    zero.register_external_parameter(object(), object())
+    zero.unregister_external_parameter(object(), object())
