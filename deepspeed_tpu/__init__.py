@@ -5,13 +5,24 @@ Public API parity with ``deepspeed/__init__.py``: ``initialize`` (:50),
 module surface (``ops``, ``moe``, ``pipe`` via runtime, ``zero``).
 """
 
+from typing import Callable  # noqa: E402
+
 __version__ = "0.1.0"
 version = __version__
+__version_major__, __version_minor__, __version_patch__ = (
+    int(x) for x in __version__.split("."))
 
 import jax.numpy as jnp
 
-from deepspeed_tpu.runtime.config import DeepSpeedConfig, DeepSpeedConfigError
+from deepspeed_tpu.runtime.config import (ADAM_OPTIMIZER, LAMB_OPTIMIZER,
+                                           DeepSpeedConfig,
+                                           DeepSpeedConfigError)
 from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+
+# reference engine.py:72-74 type aliases: a callable producing the
+# optimizer (resp. scheduler) from params — same contract, torch-free
+DeepSpeedOptimizerCallable = Callable
+DeepSpeedSchedulerCallable = Callable
 from deepspeed_tpu.runtime import lr_schedules
 from deepspeed_tpu.utils import groups
 from deepspeed_tpu.utils.logging import logger, log_dist
