@@ -1,0 +1,5 @@
+"""ops.adagrad (reference deepspeed/ops/adagrad/): the host CPU-Adagrad
+shares the AVX C library with CPU-Adam (csrc/cpu_adam.cpp
+ds_adagrad_step), so the class lives beside it."""
+
+from deepspeed_tpu.ops.adam.cpu_adam import DeepSpeedCPUAdagrad  # noqa: F401

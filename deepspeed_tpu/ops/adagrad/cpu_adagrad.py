@@ -1,0 +1,3 @@
+"""Reference module path ops/adagrad/cpu_adagrad.py."""
+
+from deepspeed_tpu.ops.adam.cpu_adam import DeepSpeedCPUAdagrad  # noqa: F401

@@ -1,0 +1,21 @@
+"""Public ``deepspeed_tpu.utils`` surface (reference deepspeed/utils/
+__init__.py): logging, the distributed bootstrap, group queries, the
+profiler annotation decorator, and the RepeatingLoader convenience."""
+
+from deepspeed_tpu.utils.logging import log_dist, logger  # noqa: F401
+from deepspeed_tpu.utils.nvtx import instrument_w_nvtx  # noqa: F401
+
+
+def init_distributed(*args, **kwargs):
+    """Reference utils/__init__.py re-export of the comm bootstrap."""
+    from deepspeed_tpu import comm
+    return comm.init_distributed(*args, **kwargs)
+
+
+def __getattr__(name):
+    # lazy: RepeatingLoader pulls in the runtime package, and groups is
+    # itself a submodule callers import as `from ...utils import groups`
+    if name == "RepeatingLoader":
+        from deepspeed_tpu.runtime.dataloader import RepeatingLoader
+        return RepeatingLoader
+    raise AttributeError(name)

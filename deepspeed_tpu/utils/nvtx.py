@@ -1,0 +1,23 @@
+"""Profiler range annotation (reference deepspeed/utils/nvtx.py).
+
+The reference wraps functions in NVTX ranges for nsys timelines; the TPU
+analogue is a ``jax.profiler.TraceAnnotation`` (shows up as a named range
+in the XLA/TensorBoard profiler) combined with ``jax.named_scope`` so the
+annotation also lands in HLO op metadata of anything traced inside.
+"""
+
+import functools
+
+import jax
+
+
+def instrument_w_nvtx(func):
+    """Decorator: record ``func``'s span in the JAX profiler timeline."""
+
+    @functools.wraps(func)
+    def wrapped(*args, **kwargs):
+        with jax.profiler.TraceAnnotation(func.__qualname__), \
+                jax.named_scope(func.__qualname__):
+            return func(*args, **kwargs)
+
+    return wrapped

@@ -257,3 +257,22 @@ def test_zero_public_surface_parity():
     assert zero.ZeroParamStatus.INFLIGHT.value == 3
     zero.register_external_parameter(object(), object())
     zero.unregister_external_parameter(object(), object())
+
+
+def test_utils_and_ops_public_surface_parity():
+    """deepspeed.utils / deepspeed.ops exports (reference
+    deepspeed/utils/__init__.py, deepspeed/ops/__init__.py)."""
+    import deepspeed_tpu.ops as ops
+    import deepspeed_tpu.utils as utils
+    for n in ("logger", "log_dist", "init_distributed",
+              "instrument_w_nvtx", "RepeatingLoader"):
+        assert hasattr(utils, n), n
+    for n in ("adam", "adagrad", "lamb", "sparse_attention", "transformer",
+              "DeepSpeedTransformerLayer", "DeepSpeedTransformerConfig"):
+        assert getattr(ops, n) is not None, n
+
+    @utils.instrument_w_nvtx
+    def traced(x):
+        return x * 2
+
+    assert traced(3) == 6
