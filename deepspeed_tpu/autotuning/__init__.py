@@ -1,0 +1,3 @@
+"""Reference deepspeed/autotuning/__init__.py surface."""
+
+from deepspeed_tpu.autotuning.autotuner import Autotuner  # noqa: F401

@@ -1,0 +1,5 @@
+"""Public ``deepspeed_tpu.pipe`` namespace (reference deepspeed/pipe/
+__init__.py re-exports the pipeline module surface)."""
+
+from deepspeed_tpu.runtime.pipe.module import (  # noqa: F401
+    LayerSpec, PipelineModule, TiedLayerSpec)

@@ -1,0 +1,6 @@
+"""Reference deepspeed/profiling/flops_profiler/__init__.py surface."""
+
+from deepspeed_tpu.profiling.flops_profiler.module_profile import (  # noqa: F401,E501
+    format_model_profile, profile_fn_by_scope)
+from deepspeed_tpu.profiling.flops_profiler.profiler import (  # noqa: F401
+    FlopsProfiler, analyze_fn, get_model_profile)

@@ -1,0 +1,6 @@
+"""Reference deepspeed/runtime/pipe/__init__.py export surface."""
+
+from deepspeed_tpu.runtime.pipe.module import (  # noqa: F401
+    LayerSpec, PipelineModule, TiedLayerSpec)
+from deepspeed_tpu.runtime.pipe.topology import (  # noqa: F401
+    ProcessTopology)

@@ -276,3 +276,17 @@ def test_utils_and_ops_public_surface_parity():
         return x * 2
 
     assert traced(3) == 6
+
+
+def test_namespace_packages_parity():
+    """Reference package-level imports users rely on (deepspeed/pipe,
+    autotuning, elasticity, profiling.flops_profiler __init__ exports)."""
+    from deepspeed_tpu.autotuning import Autotuner  # noqa: F401
+    from deepspeed_tpu.elasticity import (  # noqa: F401
+        compute_elastic_config, elasticity_enabled,
+        ensure_immutable_elastic_config)
+    from deepspeed_tpu.pipe import (  # noqa: F401
+        LayerSpec, PipelineModule, TiedLayerSpec)
+    from deepspeed_tpu.profiling.flops_profiler import (  # noqa: F401
+        FlopsProfiler, format_model_profile, get_model_profile)
+    from deepspeed_tpu.runtime.pipe import ProcessTopology  # noqa: F401
