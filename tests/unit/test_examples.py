@@ -1,0 +1,48 @@
+"""The examples/ scripts must keep running end-to-end (hermetic synthetic
+data): they are the "switch from the reference" on-ramp."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+EXAMPLES = os.path.join(REPO, "examples")
+
+
+def _run(script, *args, timeout=420):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PYTEST_CURRENT_TEST", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, script), *args],
+        capture_output=True, text=True, timeout=timeout, env=env)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    return proc.stdout
+
+
+def test_gpt2_pretrain_example(tmp_path):
+    out = _run("gpt2_pretrain_zero.py", "--model", "tiny", "--steps", "3",
+               "--batch-size", "4", "--seq", "64", "--zero", "1",
+               "--save", str(tmp_path / "ck"))
+    assert "done: 3 steps" in out
+    assert (tmp_path / "ck" / "latest").exists()
+
+
+def test_bert_lamb_example():
+    out = _run("bert_pretrain_lamb.py", "--steps", "3",
+               "--batch-size", "4", "--seq", "32")
+    assert "done: 3 MLM steps" in out
+
+
+def test_generate_int8_example():
+    out = _run("generate_int8.py", "--new", "4")
+    assert "int8 generate" in out
+
+
+def test_cifar_example():
+    out = _run("cifar10_deepspeed.py", "--steps", "3")
+    assert out.strip()
