@@ -197,7 +197,8 @@ def main():
         if name == "gpt2-moe":
             import dataclasses as _dc
             cfg = _dc.replace(PRESETS["gpt2"], moe_num_experts=8,
-                              moe_expert_interval=2, moe_k=1)
+                              moe_expert_interval=2,
+                              moe_k=int(os.environ.get("BENCH_MOE_K", "1")))
         else:
             cfg = (PRESETS[name] if name in PRESETS else
                    GPT2Config(vocab_size=2048, n_positions=256, n_embd=128,

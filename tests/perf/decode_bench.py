@@ -30,6 +30,10 @@ def main():
     prompt_len = int(os.environ.get("DECODE_PROMPT", "32"))
     new_tokens = int(os.environ.get("DECODE_NEW", "128"))
     cfg = PRESETS[name]
+    kv = os.environ.get("DECODE_KV", "auto")   # auto | int8 (KV cache)
+    if kv != "auto":
+        import dataclasses
+        cfg = dataclasses.replace(cfg, kv_cache_dtype=kv)
 
     from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel
     model = GPT2LMHeadModel(cfg)
@@ -69,7 +73,7 @@ def main():
     total_new = bs * new_tokens
     print(json.dumps({
         "metric": f"{name} cached decode (bs={bs} prompt={prompt_len} "
-                  f"new={new_tokens}, {dt_name})",
+                  f"new={new_tokens}, {dt_name}, kv={kv})",
         "tokens_per_s": round(total_new / dt, 1),
         "ms_per_token_step": round(per_step_ms, 3),
         "batch_latency_s": round(dt, 3),
