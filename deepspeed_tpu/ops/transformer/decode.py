@@ -66,8 +66,10 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, sm_scale,
         if quantized:
             # int8 cache: one absmax scale per cached row (the reference's
             # int8 dequant, csrc/transformer/inference/csrc/dequantize.cu)
-            # folds into the score/value matmuls column-wise
-            ks = ks_ref[0, pl.ds(j * block_k, block_k), 0]      # [BK]
+            # folds into the score/value matmuls column-wise. Scales ride
+            # the LANE dim ([1, 1, T] blocks): a [T, 1] layout pads each
+            # row to 128 lanes and streams 128x the scale bytes.
+            ks = ks_ref[0, 0, pl.ds(j * block_k, block_k)]      # [BK]
             s = s * ks[None, :]
         cols = j * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (QROWS, block_k), 1)
@@ -77,7 +79,7 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, sm_scale,
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=1)
         if quantized:
-            vs = vs_ref[0, pl.ds(j * block_k, block_k), 0]      # [BK]
+            vs = vs_ref[0, 0, pl.ds(j * block_k, block_k)]      # [BK]
             # int8 magnitudes (≤127) are exact in bf16, so the value
             # matmul runs at full bf16 MXU rate like the fp path
             pv = (p * vs[None, :]).astype(jnp.bfloat16)
@@ -152,15 +154,15 @@ def decode_attention(q, k_cache, v_cache, cache_len, *, k_scale=None,
     len_arr = jnp.asarray(cache_len, jnp.int32).reshape(1)
 
     cache_spec = pl.BlockSpec((1, Tp, D), lambda b: (b, 0, 0))
-    scale_spec = pl.BlockSpec((1, Tp, 1), lambda b: (b, 0, 0))
+    scale_spec = pl.BlockSpec((1, 1, Tp), lambda b: (b, 0, 0))
     in_specs = [pl.BlockSpec(memory_space=_SMEM),
                 pl.BlockSpec((1, QROWS, D), lambda b: (b, 0, 0)),
                 cache_spec, cache_spec]
     operands = [len_arr, qf, kf, vf]
     if quantized:
         in_specs += [scale_spec, scale_spec]
-        operands += [k_scale.reshape(B * H, Tp, 1).astype(jnp.float32),
-                     v_scale.reshape(B * H, Tp, 1).astype(jnp.float32)]
+        operands += [k_scale.reshape(B * H, 1, Tp).astype(jnp.float32),
+                     v_scale.reshape(B * H, 1, Tp).astype(jnp.float32)]
 
         def kernel(len_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref):
             _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref,
