@@ -175,8 +175,13 @@ def main():
         cfg = BERT_PRESETS["bert-large"]
         import dataclasses as _dc
         if name == "bert-sparse":
+            sb = int(os.environ.get("BENCH_SPARSE_BLOCK", "64"))
+            assert 256 % sb == 0 and sb <= 256, (
+                f"BENCH_SPARSE_BLOCK={sb}: must divide the 256-token "
+                "local window so rows stay comparable")
             cfg = _dc.replace(cfg, sparse_attention_mode="fixed",
-                              sparse_block=64, sparse_num_local_blocks=4,
+                              sparse_block=sb,
+                              sparse_num_local_blocks=256 // sb,
                               sparse_num_global_blocks=1)
         if seq_len > cfg.max_position_embeddings:
             # widen the position table — otherwise XLA silently clamps

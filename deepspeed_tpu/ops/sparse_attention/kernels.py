@@ -189,6 +189,102 @@ def block_sparse_attention(q, k, v, layout, key_padding_bias=None,
     return out
 
 
+def block_sparse_attention_gathered(q, k, v, layout, key_padding_bias=None,
+                                    block=None, causal=False, sm_scale=None):
+    """Gather-then-dense block-sparse attention — same semantics as
+    :func:`block_sparse_attention`, different execution strategy.
+
+    The layout is STATIC, so each q-row-block's live kv blocks are known
+    at trace time: a static ``jnp.take`` packs only the live K/V blocks
+    into ``[nq, max_live, block, D]`` and dense MXU-shaped einsums run
+    over the packed keys — compute and memory scale with the layout
+    density (× the per-row ragged-padding to ``max_live``), NOT with
+    S². Backward falls out of autodiff (the gather's transpose is the
+    scatter-add), so numerics match the predicated-sweep kernel path to
+    rounding. Memory: packed K/V is ``density·nq`` × a kv copy — fine for
+    the local+global layouts this exists for."""
+    B, H, S, D = q.shape
+    if block is None:
+        block = S // layout.shape[-1]
+    if sm_scale is None:
+        sm_scale = D ** -0.5
+    if isinstance(layout, jax.core.Tracer):
+        raise TypeError(
+            "block_sparse_attention_gathered needs a CONCRETE layout "
+            "(numpy) — the live-block LUT is built at trace time; pass "
+            "the sparsity config's numpy layout, not a traced array")
+    lay = np.asarray(layout) != 0
+    Hh, nq, nk = lay.shape
+    assert nq * block == S, (lay.shape, block, S)
+    max_live = max(int(lay.sum(axis=-1).max()), 1)
+    # static LUT: idx[h, i, t] = t-th live kv block of q-row-block i
+    idx = np.zeros((Hh, nq, max_live), np.int32)
+    valid = np.zeros((Hh, nq, max_live), bool)
+    for h in range(Hh):
+        for i in range(nq):
+            live = np.nonzero(lay[h, i])[0]
+            idx[h, i, :len(live)] = live
+            valid[h, i, :len(live)] = True
+    idx_j = jnp.asarray(idx)
+    # gathered key COLUMN ids per (h, i, t, c): for causal + padding masks
+    cols = idx[..., None] * block + np.arange(block)    # [H,nq,L,blk]
+    col_ok = np.broadcast_to(valid[..., None], cols.shape)
+
+    def _attend(q, k, v, kpb):
+        return _gathered_attend(q, k, v, kpb, idx_j=idx_j, cols=cols,
+                                col_ok=col_ok, block=block, causal=causal,
+                                sm_scale=sm_scale, max_live=max_live)
+
+    kpb_in = (None if key_padding_bias is None
+              else jnp.asarray(key_padding_bias, jnp.float32))
+    # remat: the packed [B,H,nq,blk,L,blk] score/weight tensors would
+    # otherwise be SAVED for backward across every layer (OOMed at
+    # BERT-large seq 2048); recompute-in-backward keeps residency at the
+    # inputs, the same trade flash attention makes
+    return jax.checkpoint(_attend)(q, k, v, kpb_in)
+
+
+def _gathered_attend(q, k, v, kpb, *, idx_j, cols, col_ok, block, causal,
+                     sm_scale, max_live):
+    B, H, S, D = q.shape
+    Hh, nq, _ = idx_j.shape
+    nk = S // block
+    kb = k.reshape(B, H, nk, block, D)
+    vb = v.reshape(B, H, nk, block, D)
+    # pack live kv blocks: [B, H, nq, L, blk, D] (static gather per head)
+    kg = jnp.take_along_axis(
+        kb[:, :, None], idx_j[None, :, :, :, None, None], axis=3)
+    vg = jnp.take_along_axis(
+        vb[:, :, None], idx_j[None, :, :, :, None, None], axis=3)
+    qb = q.reshape(B, H, nq, block, D)
+
+    s = jnp.einsum("bhipd,bhilcd->bhiplc", qb, kg,
+                   preferred_element_type=jnp.float32) * sm_scale
+    neg = jnp.float32(NEG_INF)
+    mask = jnp.asarray(col_ok)[None, :, :, None]          # [1,H,nq,1,L,blk]
+    if causal:
+        rows = (np.arange(nq)[:, None] * block
+                + np.arange(block)[None, :])              # [nq, blk]
+        cmask = cols[:, :, None, :, :] <= rows[None, :, :, None, None]
+        mask = mask & jnp.asarray(cmask)[None]            # [1,H,nq,blk,L,blk]
+    s = jnp.where(mask, s, neg)
+    if kpb is not None:
+        kpb_g = kpb[:, jnp.asarray(cols.reshape(Hh, -1))] \
+            .reshape(B, Hh, nq, max_live, block)
+        s = s + kpb_g[:, :, :, None]
+    sf = s.reshape(B, H, nq, block, max_live * block)
+    m = jnp.max(sf, axis=-1, keepdims=True)
+    # rows with NO live key (fully masked) must output zeros, not NaN
+    p = jnp.exp(sf - jnp.maximum(m, neg / 2))
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    p = p / jnp.where(l == 0.0, 1.0, l)
+    out = jnp.einsum("bhiplc,bhilcd->bhipd",
+                     p.reshape(B, H, nq, block, max_live, block)
+                     .astype(vg.dtype), vg,
+                     preferred_element_type=jnp.float32)
+    return out.reshape(B, H, S, D).astype(q.dtype)
+
+
 def _specs(H, block, nq, D, S):
     # the layout LUT lives in SMEM: the kernels read layout[0, qi, j] at a
     # DYNAMIC j, and Mosaic only allows unaligned dynamic scalar loads

@@ -188,3 +188,63 @@ def test_bert_sparse_attention_mask():
     assert np.abs(np.asarray(un[:, :S // 2]) -
                   np.asarray(un2[:, :S // 2])).max() > 1e-4
 
+
+
+class TestGatheredImpl:
+    """gather-then-dense vs the dense-mask oracle and vs the predicated
+    kernel: same semantics, trace-time LUT, autodiff backward."""
+
+    def _setup(self):
+        from deepspeed_tpu.ops.sparse_attention.sparsity_config import \
+            FixedSparsityConfig
+        rng = np.random.default_rng(0)
+        B, H, S, D, blk = 2, 2, 128, 32, 16
+        layout = FixedSparsityConfig(
+            num_heads=H, block=blk, num_local_blocks=4,
+            num_global_blocks=1).make_layout(S)
+        q, k, v = [jnp.asarray(rng.standard_normal((B, H, S, D)),
+                               jnp.float32) for _ in range(3)]
+        kpb = jnp.where(jnp.asarray(rng.random((B, S))) < 0.1,
+                        -1e9, 0.0).astype(jnp.float32)
+        return layout, blk, q, k, v, kpb
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_dense_oracle(self, causal):
+        from deepspeed_tpu.ops.sparse_attention.kernels import (
+            block_sparse_attention_gathered, layout_to_dense_mask)
+        from deepspeed_tpu.ops.transformer.attention import mha_reference
+        layout, blk, q, k, v, kpb = self._setup()
+        S = q.shape[2]
+        mask = jnp.asarray(layout_to_dense_mask(layout, blk, S))[None]
+        if causal:
+            mask = mask & jnp.tril(jnp.ones((S, S), bool))[None, None]
+        bias = kpb[:, None, None, :]
+        ref = mha_reference(q, k, v, causal=False, mask=mask, bias=bias)
+        got = block_sparse_attention_gathered(q, k, v, layout, kpb, blk,
+                                              causal)
+        np.testing.assert_allclose(got, ref, atol=1e-5, rtol=1e-5)
+
+    def test_grads_match_predicated(self):
+        from deepspeed_tpu.ops.sparse_attention.kernels import (
+            block_sparse_attention, block_sparse_attention_gathered)
+        layout, blk, q, k, v, kpb = self._setup()
+
+        def loss(fn, layout_arg):
+            return lambda q, k, v: jnp.sum(
+                fn(q, k, v, layout_arg, kpb, blk, True) ** 2)
+
+        ga = jax.grad(loss(block_sparse_attention, jnp.asarray(layout)),
+                      argnums=(0, 1, 2))(q, k, v)
+        gg = jax.grad(loss(block_sparse_attention_gathered, layout),
+                      argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(ga, gg):
+            np.testing.assert_allclose(a, b, atol=5e-3, rtol=5e-3)
+
+    def test_traced_layout_rejected(self):
+        from deepspeed_tpu.ops.sparse_attention.kernels import \
+            block_sparse_attention_gathered
+        layout, blk, q, k, v, _ = self._setup()
+
+        with pytest.raises(TypeError, match="CONCRETE layout"):
+            jax.jit(lambda lay: block_sparse_attention_gathered(
+                q, k, v, lay, None, blk, False))(jnp.asarray(layout))
