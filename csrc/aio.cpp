@@ -60,6 +60,9 @@ bool kernel_aio_disabled() {
   return e && e[0] && e[0] != '0';
 }
 
+int64_t blocked_rw(bool write, const char* path, char* buf, int64_t nbytes,
+                   int64_t file_offset, int block_size);
+
 // One transfer through kernel AIO. Returns bytes transferred, -errno, or
 // kUseFallback when the environment can't do it (caller then takes the
 // thread-pool pread/pwrite path; nothing has been read/written yet).
@@ -116,6 +119,22 @@ int64_t kernel_aio_rw(bool write, const char* path, char* buf,
         memcpy(buf + slot_user_off[slot], bounce + slot * block_size,
                static_cast<size_t>(res));
       completed += res;
+      if (res > 0 && res < slot_len[slot]) {
+        // short transfer: the unserved tail of this block would otherwise
+        // be silently dropped (round-4 advisory). res need not stay
+        // kAlign-aligned, so finish the remainder through the buffered
+        // engine (coherent with the O_DIRECT body on Linux, same as the
+        // unaligned-tail path below). res == 0 is EOF on a read shorter
+        // than the request — partial byte count returned, like the
+        // thread-pool fallback.
+        int64_t rem_off = slot_user_off[slot] + res;
+        int64_t rem_len = slot_len[slot] - res;
+        int64_t r2 = blocked_rw(write, path, buf + rem_off, rem_len,
+                                file_offset + rem_off,
+                                static_cast<int>(block_size));
+        if (r2 < 0) return r2;
+        completed += r2;
+      }
       free_slots.push_back(slot);
       --inflight;
     }

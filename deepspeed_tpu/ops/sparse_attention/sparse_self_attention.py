@@ -18,6 +18,7 @@ from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
     FixedSparsityConfig, SparsityConfig)
 
 _layout_cache = {}
+_density_warned = set()
 
 
 def _config_key(cfg: SparsityConfig):
@@ -99,6 +100,25 @@ class SparseSelfAttention(nn.Module):
                 f"DS_SPARSE_IMPL must be 'gathered' or 'predicated', "
                 f"got {impl!r}")
         if impl == "gathered":
+            # the gathered form packs max_live kv blocks PER q-row-block:
+            # for dense-ish layouts (max_live -> nk) that is near-O(S^2)
+            # packed K/V memory with ragged padding — warn once per
+            # layout so the degradation is not silent (round-4 advisory)
+            wkey = (_config_key(cfg), S)
+            if wkey not in _density_warned:
+                _density_warned.add(wkey)
+                import numpy as _np
+                _lay = _np.asarray(layout)
+                max_live = int(_lay.sum(axis=-1).max())
+                nk = max(1, _lay.shape[-1])
+                if max_live >= 0.75 * nk:
+                    from deepspeed_tpu.utils.logging import logger
+                    logger.warning(
+                        "SparseSelfAttention: layout density %.2f (max %d "
+                        "live of %d kv blocks) — the gathered impl packs "
+                        "near-full K/V copies at this density; dense flash "
+                        "attention or DS_SPARSE_IMPL=predicated will use "
+                        "less memory", max_live / nk, max_live, nk)
             # the layout stays CONCRETE numpy: the live-block LUT is
             # built at trace time
             return block_sparse_attention_gathered(

@@ -23,6 +23,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from deepspeed_tpu.ops.quantizer.int8_linear import (QuantDense,
+                                                     int8_matmul)
 from deepspeed_tpu.ops.transformer.attention import attention
 from deepspeed_tpu.ops.transformer.fused import (fused_bias_gelu,
                                                  fused_layer_norm)
@@ -92,14 +94,15 @@ class DeepSpeedTransformerLayer(nn.Module):
             else:
                 attn_in = x
 
-            qkv = nn.Dense(3 * H, name="attn_qkv", kernel_init=init)(attn_in)
+            qkv = QuantDense(3 * H, name="attn_qkv",
+                             kernel_init=init)(attn_in)
             q, k, v = jnp.split(qkv, 3, axis=-1)
             q = q.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
             k = k.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
             v = v.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
             ctx = attention(q, k, v, causal=False, mask=mask)
             ctx = ctx.transpose(0, 2, 1, 3).reshape(B, S, H)
-            attn_out = nn.Dense(H, name="attn_out", kernel_init=init)(ctx)
+            attn_out = QuantDense(H, name="attn_out", kernel_init=init)(ctx)
             if cfg.attn_dropout_ratio > 0:
                 attn_out = nn.Dropout(cfg.attn_dropout_ratio)(
                     attn_out, deterministic=deterministic)
@@ -114,8 +117,23 @@ class DeepSpeedTransformerLayer(nn.Module):
                                       (H, cfg.intermediate))
             inter_bias = self.param("inter_b", nn.initializers.zeros,
                                     (cfg.intermediate,))
-            h = fused_bias_gelu(mlp_in @ inter_kernel, inter_bias)
-            out = nn.Dense(H, name="output_w", kernel_init=init)(h)
+            if inter_kernel.dtype == jnp.int8:
+                # module_quantize stored inter_w as int8 with its
+                # per-column scale at this module's scope (raw param, so
+                # the scale leaf lands beside it as 'kernel_scale')
+                if not self.has_variable("quant_scales", "kernel_scale"):
+                    raise ValueError(
+                        "DeepSpeedTransformerLayer: int8 inter_w but no "
+                        "'quant_scales'/'kernel_scale' variable — pass the "
+                        "scales tree from module_quantize alongside params")
+                inter_scale = self.get_variable("quant_scales",
+                                                "kernel_scale")
+                h = fused_bias_gelu(
+                    int8_matmul(mlp_in, inter_kernel, inter_scale),
+                    inter_bias)
+            else:
+                h = fused_bias_gelu(mlp_in @ inter_kernel, inter_bias)
+            out = QuantDense(H, name="output_w", kernel_init=init)(h)
             if cfg.hidden_dropout_ratio > 0:
                 out = nn.Dropout(cfg.hidden_dropout_ratio)(
                     out, deterministic=deterministic)

@@ -186,6 +186,9 @@ class DeepSpeedEngine:
         # REAL compressed collective (dp > 1): step fns then keep grads
         # rank-local under shard_map (_build_onebit_step_fns)
         self._onebit_dist = False
+        # broadcast batch leaves checksum-verified across processes, by
+        # (path, shape, dtype) — first occurrence only (_globalize_batch)
+        self._broadcast_leaves_checked = set()
 
         # ---- precision ----------------------------------------------------
         if self.config.fp16_enabled:
@@ -1054,20 +1057,72 @@ class DeepSpeedEngine:
         self._last_batch = batch
         return loss
 
-    def _globalize_batch(self, batch):
+    def _globalize_batch(self, batch, for_train=True):
         """Place the host batch onto the mesh as the GLOBAL batch.
 
-        Single process: device_put against the batch sharding. Multi
-        process: each host holds only its slice (deepspeed_io loads
-        global_micro/process_count rows), so the global array must be
-        assembled from per-process shards — device_put would silently
-        treat the local slice as the whole batch (ADVICE round 1)."""
+        A scalar, or a dim0==1 leaf in a batch whose OTHER leaves carry
+        real rows (a [1,S] broadcast mask, a shared table), is NOT a
+        per-row batch slice — it is replicated whole (round-4 advisory:
+        the old blanket row check spuriously rejected these, and the
+        single-process device_put tried to row-shard them). A batch
+        whose every leaf has one row is NOT reinterpreted — that shape
+        is a mis-sliced loader, and the loud uneven-rows rejection was
+        built for exactly that. Single process: device_put against the
+        per-leaf sharding. Multi process: each host holds only its
+        slice (deepspeed_io loads global_micro/process_count rows), so
+        the global array is assembled from per-process shards —
+        device_put would silently treat the local slice as the whole
+        batch (ADVICE round 1); broadcast leaves are checksum-verified
+        identical across processes before being stamped 'replicated'."""
+        import numpy as _np
         shardings = self._batch_sharding(batch)
-        if jax.process_count() == 1:
+        n_proc = jax.process_count()
+        global_rows = (self.train_micro_batch_size_per_gpu()
+                       * self.dp_world_size)
+        expect = global_rows // n_proc  # batch rows each process holds
+        repl = NamedSharding(self.mesh, P())
+        all_single_row = all(
+            _np.ndim(x) == 0 or _np.shape(x)[0] == 1
+            for x in jax.tree.leaves(batch))
+
+        def _is_broadcast(x):
+            # only on the DEFAULT sharding path: an explicit batch_spec
+            # is the user's word and is honored verbatim for every leaf
+            if self._batch_spec is not None:
+                return False
+            if _np.ndim(x) == 0:
+                return True
+            return (_np.shape(x)[0] == 1 and expect != 1
+                    and not all_single_row)
+
+        if (for_train and (self._onebit_dist or self._sparse_grads)
+                and any(_is_broadcast(x) and _np.ndim(x) > 0
+                        for x in jax.tree.leaves(batch))):
+            # the 1-bit / sparse-grad TRAIN step fns shard_map the whole
+            # batch tree with in_specs=P(data) — a dim0==1 leaf fails
+            # divisibility there with an opaque trace error, so reject
+            # it loudly here (eval_batch jits without shard_map and
+            # handles replicated leaves fine)
+            raise NotImplementedError(
+                "broadcast batch leaves (leading dim 1) are not supported "
+                "with 1-bit optimizers or sparse_gradients: their step "
+                "functions shard the whole batch over the data axis; "
+                "give the leaf the batch's leading dimension")
+        shardings = jax.tree.map(
+            lambda x, sh: repl if _is_broadcast(x) else sh,
+            batch, shardings)
+        if n_proc == 1:
             return jax.device_put(batch, shardings)
-        # replicated batch sharding can't be assembled from differing
-        # per-process slices — every host would need the FULL batch
-        for sh in jax.tree.leaves(shardings):
+        # Validate the WHOLE tree before any placement or collective so a
+        # uniform loader bug raises on every rank instead of deadlocking
+        # a later collective (rank-DIVERGENT tree shapes can still hang —
+        # the same failure class as any diverged SPMD program).
+        for x, sh in zip(jax.tree.leaves(batch),
+                         jax.tree.leaves(shardings)):
+            if _is_broadcast(x):
+                continue
+            # replicated BATCH sharding can't be assembled from differing
+            # per-process slices — every host would need the FULL batch
             if sh.is_fully_replicated:
                 raise NotImplementedError(
                     "multi-process run with a replicated batch sharding: "
@@ -1075,28 +1130,52 @@ class DeepSpeedEngine:
                     "a replicated global batch cannot be assembled; use a "
                     "data-parallel mesh axis or load the full batch per "
                     "process via model_parameters/batch_spec")
-        import numpy as _np
-        # loud rejection of uneven per-host slices: every process must
-        # hold exactly global_rows/process_count rows, or the assembled
-        # global array would be silently misaligned (rank-dependent rows
-        # duplicated/dropped)
-        n_proc = jax.process_count()
-        global_rows = (self.train_micro_batch_size_per_gpu()
-                       * self.dp_world_size)
-        expect = global_rows // n_proc
-        for leaf in jax.tree.leaves(batch):
-            rows = _np.shape(leaf)[0] if _np.ndim(leaf) else None
-            if rows is not None and rows != expect:
+            rows = _np.shape(x)[0]
+            if rows != expect:
                 raise ValueError(
                     f"uneven per-process batch slice: this process holds "
                     f"{rows} rows but the global micro-batch "
                     f"({global_rows}) over {n_proc} processes requires "
                     f"exactly {expect} per process (deepspeed_io slices "
-                    f"evenly; feed each rank its own equal slice)")
-        return jax.tree.map(
-            lambda x, sh: jax.make_array_from_process_local_data(
-                sh, _np.asarray(x)),
-            batch, shardings)
+                    f"evenly; feed each rank its own equal slice; "
+                    f"broadcast leaves must have leading dim 1)")
+
+        def _place(path, x, sh):
+            if _is_broadcast(x):
+                # make_array_from_process_local_data does not cross-check
+                # replicated content, so a mis-sliced loader feeding each
+                # rank a different single row would silently diverge —
+                # checksum-verify the first time each leaf path is seen
+                # (steady-state cost zero; content drift after the first
+                # batch is the cross-rank-assert debug tier's job)
+                key = (tuple(str(p) for p in path), _np.shape(x),
+                       str(_np.asarray(x).dtype))
+                if key not in self._broadcast_leaves_checked:
+                    self._broadcast_leaves_checked.add(key)
+                    self._assert_identical_across_processes(x)
+            return jax.make_array_from_process_local_data(
+                sh, _np.asarray(x))
+
+        return jax.tree_util.tree_map_with_path(_place, batch, shardings)
+
+    def _assert_identical_across_processes(self, x):
+        """Raise if ``x``'s bytes differ on any process (sha256 checksum
+        allgather; guards the replicated broadcast-leaf path)."""
+        import hashlib
+
+        import numpy as _np
+        from jax.experimental import multihost_utils
+        digest = hashlib.sha256(
+            _np.ascontiguousarray(_np.asarray(x)).tobytes()).digest()
+        h = _np.frombuffer(digest[:8], dtype=_np.uint64)
+        all_h = _np.asarray(multihost_utils.process_allgather(h))
+        if not (all_h == all_h.ravel()[0]).all():
+            raise ValueError(
+                "broadcast batch leaf (leading dim 1) differs across "
+                "processes — a dim0==1 leaf is replicated whole, so every "
+                "process must feed the identical array; if this leaf is "
+                "really a per-process batch slice, give it the batch's "
+                "leading dimension")
 
     def backward(self, loss=None, allreduce_gradients=True, release_loss=False):
         """Bookkeeping half of the fused forward/backward (see ``forward``)."""
@@ -1294,7 +1373,7 @@ class DeepSpeedEngine:
 
     def eval_batch(self, batch):
         with self.mesh:
-            batch = self._globalize_batch(batch)
+            batch = self._globalize_batch(batch, for_train=False)
             return self._jit_eval(self.state.params, batch)
 
     def __call__(self, batch):

@@ -99,6 +99,12 @@ class Quantizer:
             return parameter_group
         self.qsteps += 1
         block_eigenvalue = block_eigenvalue or {}
+        # reference calls update_fp16_ratio() BEFORE its param loop
+        # (quantize.py step ordering), so the decremented ratio is the one
+        # the blend below uses
+        if self.q_mixed_fp16:
+            self.quantize_real_ratio = max(
+                0.0, self.quantize_real_ratio - self.q_change_ratio)
 
         def q(path, x):
             # reference quantizes only matrices (len(p.size()) > 1)
@@ -127,8 +133,4 @@ class Quantizer:
                 return ratio * x + (1.0 - ratio) * qx
             return qx
 
-        out = jax.tree_util.tree_map_with_path(q, parameter_group)
-        if self.q_mixed_fp16:
-            self.quantize_real_ratio = max(
-                0.0, self.quantize_real_ratio - self.q_change_ratio)
-        return out
+        return jax.tree_util.tree_map_with_path(q, parameter_group)

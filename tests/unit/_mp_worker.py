@@ -85,6 +85,16 @@ def main():
                                       gy[:GLOBAL_BATCH // nproc - 1]))
         except ValueError as e:
             assert "uneven per-process batch slice" in str(e), e
+            # round-5 advisory fix: a dim0==1 broadcast leaf (e.g. a
+            # [1,S] shared mask) must NOT trip the row check — it is
+            # assembled replicated, with the full leaf on every process
+            per = GLOBAL_BATCH // nproc
+            mask = np.ones((1, HIDDEN), np.float32)
+            placed = engine._globalize_batch(
+                {"x": gx[rank * per:(rank + 1) * per], "mask": mask})
+            assert placed["x"].shape == (GLOBAL_BATCH, HIDDEN)
+            assert placed["mask"].shape == (1, HIDDEN)
+            assert placed["mask"].sharding.is_fully_replicated
             print(f"worker {rank} UNEVEN-REJECTED OK", flush=True)
             return
         raise SystemExit("uneven slice was NOT rejected")

@@ -98,6 +98,41 @@ class TestQuantizeTransformerLayer:
             model.apply({"params": qp}, batch, return_logits=True)
 
 
+class TestQuantizeDeepSpeedTransformerLayer:
+    """Round-5 advisory fix: DEFAULT_PATTERNS match DeepSpeedTransformerLayer
+    kernels, so the layer itself must consume int8 + scales (previously its
+    plain nn.Dense/raw-param matmuls silently dropped the scales)."""
+
+    @pytest.fixture(scope="class")
+    def layer(self):
+        from deepspeed_tpu.ops.transformer.transformer import (
+            DeepSpeedTransformerConfig, DeepSpeedTransformerLayer)
+        cfg = DeepSpeedTransformerConfig(hidden_size=32, heads=2,
+                                         training=False)
+        mod = DeepSpeedTransformerLayer(cfg)
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 16, 32))
+        variables = mod.init(jax.random.PRNGKey(1), x)
+        return mod, variables["params"], x
+
+    def test_int8_parity(self, layer):
+        mod, params, x = layer
+        ref = mod.apply({"params": params}, x)
+        qp, scales = quantize_transformer_layer(params)
+        int8_leaves = [v for v in jax.tree.leaves(qp) if v.dtype == jnp.int8]
+        assert len(int8_leaves) == 4  # qkv, attn_out, inter_w, output_w
+        out = mod.apply({"params": qp, "quant_scales": scales}, x)
+        ref_n, out_n = np.asarray(ref, np.float32), np.asarray(out, np.float32)
+        cos = np.sum(ref_n * out_n) / (np.linalg.norm(ref_n)
+                                       * np.linalg.norm(out_n))
+        assert cos > 0.999, cos
+
+    def test_int8_without_scales_raises(self, layer):
+        mod, params, x = layer
+        qp, _ = quantize_transformer_layer(params)
+        with pytest.raises(ValueError, match="quant_scales"):
+            mod.apply({"params": qp}, x)
+
+
 class TestInferenceEngineInt8:
     def test_generate_matches_fp32_greedy(self, tiny):
         import deepspeed_tpu
