@@ -176,12 +176,17 @@ def main():
         import dataclasses as _dc
         if name == "bert-sparse":
             sb = int(os.environ.get("BENCH_SPARSE_BLOCK", "64"))
-            assert 256 % sb == 0 and sb <= 256, (
-                f"BENCH_SPARSE_BLOCK={sb}: must divide the 256-token "
-                "local window so rows stay comparable")
+            # BENCH_SPARSE_WINDOW: local-window tokens (round-5 long-seq
+            # rows use window 1024 @ block 128 — the fused kernel's
+            # MXU-sized tiling; default 256 keeps the round-4 rows
+            # comparable)
+            win = int(os.environ.get("BENCH_SPARSE_WINDOW", "256"))
+            assert win % sb == 0 and sb <= win, (
+                f"BENCH_SPARSE_BLOCK={sb}: must divide the {win}-token "
+                "local window (BENCH_SPARSE_WINDOW)")
             cfg = _dc.replace(cfg, sparse_attention_mode="fixed",
                               sparse_block=sb,
-                              sparse_num_local_blocks=256 // sb,
+                              sparse_num_local_blocks=win // sb,
                               sparse_num_global_blocks=1)
         if seq_len > cfg.max_position_embeddings:
             # widen the position table — otherwise XLA silently clamps

@@ -86,19 +86,25 @@ class SparseSelfAttention(nn.Module):
         layout = get_layout(cfg, S)
         causal = getattr(cfg, "attention", "bidirectional") == "unidirectional"
         import os
-        # 'gathered' (default): static-LUT gather packs only the live kv
-        # blocks and dense einsums run over them — oracle-exact to 1e-7
-        # (the gather's autodiff transpose IS the backward scatter) and
-        # measured modestly faster than the predicated Pallas sweep
-        # (793 -> 759 ms at seq 2048 block 64; 521 ms at block 128 —
-        # PERF.md). 'predicated' keeps the in-kernel online sweep.
+        # 'fused' (default): LUT-driven streaming flash kernels — the
+        # work list walks only live tiles, global columns are packed
+        # (fused_kernels.py) — the round-5 strategy that finally BEATS
+        # dense flash at long seq (PERF.md). 'gathered': static-LUT
+        # jnp.take packing + dense einsums (oracle-exact, portable).
+        # 'predicated': the in-kernel online sweep over all blocks.
         # NOTE: read at TRACE time — changing the env after a jitted
         # call reuses the cached trace
-        impl = os.environ.get("DS_SPARSE_IMPL", "gathered")
-        if impl not in ("gathered", "predicated"):
+        impl = os.environ.get("DS_SPARSE_IMPL", "fused")
+        if impl not in ("fused", "gathered", "predicated"):
             raise ValueError(
-                f"DS_SPARSE_IMPL must be 'gathered' or 'predicated', "
-                f"got {impl!r}")
+                f"DS_SPARSE_IMPL must be 'fused', 'gathered' or "
+                f"'predicated', got {impl!r}")
+        if impl == "fused":
+            from deepspeed_tpu.ops.sparse_attention.fused_kernels import \
+                block_sparse_attention_fused
+            return block_sparse_attention_fused(
+                query, key, value, layout,
+                key_padding_bias=kpb, block=cfg.block, causal=causal)
         if impl == "gathered":
             # the gathered form packs max_live kv blocks PER q-row-block:
             # for dense-ish layouts (max_live -> nk) that is near-O(S^2)

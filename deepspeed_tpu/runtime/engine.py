@@ -131,7 +131,8 @@ class DeepSpeedEngine:
                  dont_change_device=False,
                  sparse_embedding_rules=None,
                  sparse_ids_fn=None,
-                 seed=42):
+                 seed=42,
+                 abstract_init=False):
         import deepspeed_tpu.comm as dist
         dist.init_distributed(verbose=False)
 
@@ -152,6 +153,12 @@ class DeepSpeedEngine:
         self.micro_steps = 0
         self.skipped_steps = 0
         self._seed = seed
+        # abstract_init: build every step function against
+        # ShapeDtypeStructs WITHOUT materialising params/optimizer state —
+        # the AOT-lowering mode that proves a config's sharded program
+        # builds at true scale (lower_train_step) on meshes far larger
+        # than this host could hold in memory
+        self._abstract_init = abstract_init
 
         # ---- mesh (reference: groups.initialize, engine.py:1031) ----------
         if not groups.mesh_is_initialized():
@@ -542,8 +549,27 @@ class DeepSpeedEngine:
             nvme_path=nvme_path)
 
     def _init_state(self, model_parameters, sample_batch):
-        if model_parameters is not None:
-            params = model_parameters
+        if self._abstract_init:
+            assert sample_batch is not None, (
+                "abstract_init needs sample_batch for shape inference")
+            assert model_parameters is None, (
+                "abstract_init derives shapes from module.init and would "
+                "silently ignore model_parameters — pass one or the other")
+            assert not (self._offload or self._onebit_dist
+                        or self._sparse_grads), (
+                "abstract_init supports the monolithic (non-offload, "
+                "non-1-bit, dense-grad) engine paths")
+            rng = jax.random.PRNGKey(self._seed)
+            params = jax.eval_shape(self.module.init, rng, sample_batch)
+            if isinstance(params, dict) and set(params.keys()) == {"params"}:
+                params = params["params"]
+            params = jax.tree.map(
+                lambda s: jax.ShapeDtypeStruct(
+                    s.shape,
+                    jnp.float32 if jnp.issubdtype(s.dtype, jnp.floating)
+                    else s.dtype), params)
+        elif model_parameters is not None:
+            params = _cast_tree(model_parameters, jnp.float32)
         else:
             assert sample_batch is not None, (
                 "need model_parameters or sample_batch to initialise the model")
@@ -555,8 +581,8 @@ class DeepSpeedEngine:
             params = jax.jit(self.module.init)(rng, sample_batch)
             if isinstance(params, dict) and set(params.keys()) == {"params"}:
                 params = params["params"]
-        # fp32 master copy (reference FP16_Optimizer master weights)
-        params = _cast_tree(params, jnp.float32)
+            # fp32 master copy (reference FP16_Optimizer master weights)
+            params = _cast_tree(params, jnp.float32)
 
         min_numel = self.config.zero_config.param_persistence_threshold
         self.param_shardings = build_param_shardings(
@@ -642,10 +668,15 @@ class DeepSpeedEngine:
                     self._init_scale,
                     delayed_shift=self.config.fp16.hysteresis))
 
-        with self.mesh:
-            params = jax.device_put(params, self.param_shardings)
-            self.state = jax.jit(
-                make_state, out_shardings=self.state_shardings)(params)
+        if self._abstract_init:
+            # no materialisation: the state is a ShapeDtypeStruct tree the
+            # step fns lower against (lower_train_step)
+            self.state = jax.eval_shape(make_state, params)
+        else:
+            with self.mesh:
+                params = jax.device_put(params, self.param_shardings)
+                self.state = jax.jit(
+                    make_state, out_shardings=self.state_shardings)(params)
 
         if self._offload:
             self._offload_opt = self._make_offload_optimizer()
@@ -662,6 +693,34 @@ class DeepSpeedEngine:
         self._pending_loss = None
         self._last_grad_norm = None
         self._last_batch = None
+
+    def lower_train_step(self, batch):
+        """AOT-lower the fused global train step (gas=1) at the engine's
+        shapes WITHOUT executing anything — the at-scale proof for
+        configs (e.g. GPT-2 1.5B ZeRO-3 over 16 chips) that no single
+        host could materialise. ``batch`` may be arrays or
+        ShapeDtypeStructs. Returns the ``jax.stages.Lowered``; call
+        ``.compile().memory_analysis()`` for the per-chip footprint."""
+        assert self._abstract_init, (
+            "lower_train_step is the abstract_init=True surface; a "
+            "materialised engine can just run train_batch")
+        assert self._jit_train is not None, (
+            "lower_train_step needs the fused gas=1 step (gradient "
+            "accumulation > 1 lowers per-microbatch programs instead)")
+        import numpy as _np
+        batch_sds = jax.tree.map(
+            lambda x: x if isinstance(x, jax.ShapeDtypeStruct)
+            else jax.ShapeDtypeStruct(_np.shape(x), _np.asarray(x).dtype),
+            batch)
+        rng_sds = jax.eval_shape(lambda: jax.random.PRNGKey(0))
+        theta_sds = jax.ShapeDtypeStruct((), jnp.float32)
+        with self.mesh:
+            batch_sharded = jax.tree.map(
+                lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype,
+                                                   sharding=sh),
+                batch_sds, self._batch_sharding(batch_sds))
+            return self._jit_train.lower(self.state, batch_sharded,
+                                         rng_sds, theta_sds)
 
     def _build_sparse_mask(self, params):
         """Flat boolean mask over the param leaves: True = embedding table
@@ -1092,8 +1151,10 @@ class DeepSpeedEngine:
                 return False
             if _np.ndim(x) == 0:
                 return True
-            return (_np.shape(x)[0] == 1 and expect != 1
-                    and not all_single_row)
+            # eval batches are not bound to the train micro-batch size,
+            # so there dim0==1 in a mixed tree is broadcast regardless
+            return (_np.shape(x)[0] == 1 and not all_single_row
+                    and (expect != 1 or not for_train))
 
         if (for_train and (self._onebit_dist or self._sparse_grads)
                 and any(_is_broadcast(x) and _np.ndim(x) > 0
@@ -1131,7 +1192,9 @@ class DeepSpeedEngine:
                     "data-parallel mesh axis or load the full batch per "
                     "process via model_parameters/batch_spec")
             rows = _np.shape(x)[0]
-            if rows != expect:
+            # the train micro-batch geometry does not bind eval batches —
+            # any equal-per-rank slice assembles fine there
+            if for_train and rows != expect:
                 raise ValueError(
                     f"uneven per-process batch slice: this process holds "
                     f"{rows} rows but the global micro-batch "

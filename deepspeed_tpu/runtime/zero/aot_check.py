@@ -1,0 +1,171 @@
+"""At-shape AOT proof for the north-star config (GPT-2 1.5B ZeRO-3, 16 chips).
+
+BASELINE.json's one named target — "GPT-2 1.5B ZeRO-3 on v5e-16 matches
+8xA100 NCCL step time" (reference claim:
+docs/_posts/2021-03-08-zero3-offload.md:16) — cannot be *executed* in this
+environment (one real chip, no 16-chip slice). What CAN be proven, and
+what this module proves, is that the full ZeRO-3 engine step **builds at
+true scale**: the train step is jitted with ``abstract_init=True`` (no
+array is ever materialised), lowered over a 16-device mesh at the real
+1.5B shapes, SPMD-partitioned, and compiled; the artifact records
+
+- the EXACT per-chip state footprint (params + Adam moments + grad
+  accumulator + scalars, every leaf's sharded slice counted from its
+  NamedSharding) — the ZeRO-3 partitioning claim, asserted <= HBM;
+- the collective structure of the compiled program (all-gather /
+  all-reduce counts — the param-gather traffic ZeRO-3 is made of);
+- the compiler's own memory analysis. Caveat, recorded in the artifact:
+  the only 16-device compile target this environment offers is the CPU
+  backend, whose scheduler does not optimise temp liveness the way the
+  TPU's latency-hiding scheduler does, and whose attention path is the
+  XLA O(S^2) fallback (Pallas flash lowers only for TPU). Its temp
+  number is therefore an upper bound of the wrong schedule, and a
+  TPU-semantics activation budget is derived analytically beside it.
+
+Run as a module to (re)generate the committed artifact::
+
+    python -m deepspeed_tpu.runtime.zero.aot_check NORTHSTAR_AOT.json
+"""
+
+import dataclasses
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+HBM_BYTES = 16 * 1024 ** 3          # v5e: 16 GiB per chip
+
+
+def _leaf_sharded_bytes(leaf, sharding):
+    """Bytes of ONE device's slice of a (possibly sharded) leaf —
+    ``shard_shape`` is the sharding's own answer, correct even for
+    padded/uneven shards."""
+    return (int(np.prod(sharding.shard_shape(leaf.shape)))
+            * np.dtype(leaf.dtype).itemsize)
+
+
+def state_footprint_per_chip(engine):
+    """EXACT per-chip bytes of the engine state, by component, from the
+    abstract state tree and its shardings (no compile needed)."""
+    out = {}
+    for name in ("params", "opt_state", "acc_grads"):
+        leaves = jax.tree.leaves(getattr(engine.state, name))
+        shards = jax.tree.leaves(getattr(engine.state_shardings, name))
+        assert len(leaves) == len(shards)
+        out[name] = sum(_leaf_sharded_bytes(l, s)
+                        for l, s in zip(leaves, shards))
+    out["total"] = sum(out.values())
+    return out
+
+
+def northstar_aot_report(n_devices=16, seq=1024, per_chip_batch=1,
+                         compile_program=True):
+    """Build the 1.5B ZeRO-3 engine abstractly over ``n_devices``, lower
+    the fused train step, and return the report dict."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import (GPT2LMHeadModel, PRESETS,
+                                           synthetic_batch)
+    from deepspeed_tpu.utils import groups
+
+    assert len(jax.devices()) >= n_devices, (
+        f"need {n_devices} (virtual) devices; got {len(jax.devices())} — "
+        "force them BEFORE importing anything that initialises a backend "
+        "(see __graft_entry__._force_virtual_cpu_devices)")
+    groups.destroy()
+    groups.initialize(devices=jax.devices()[:n_devices])
+    # activation checkpointing on, as the reference's 1.5B configs run
+    cfg = dataclasses.replace(PRESETS["gpt2-xl"], remat=True)
+    global_batch = per_chip_batch * n_devices
+    batch = synthetic_batch(global_batch, seq, cfg.vocab_size)
+    t0 = time.time()
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=GPT2LMHeadModel(cfg),
+        config={"train_batch_size": global_batch,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+                "zero_optimization": {"stage": 3},
+                "bf16": {"enabled": True}},
+        sample_batch=batch,
+        abstract_init=True)
+    n_params = sum(int(np.prod(l.shape))
+                   for l in jax.tree.leaves(engine.state.params))
+    state = state_footprint_per_chip(engine)
+
+    lowered = engine.lower_train_step(batch)
+    lower_s = time.time() - t0
+
+    report = {
+        "config": {
+            "model": "gpt2-xl (1.5B)", "n_embd": cfg.n_embd,
+            "n_layer": cfg.n_layer, "n_head": cfg.n_head,
+            "seq": seq, "per_chip_batch": per_chip_batch,
+            "n_devices": n_devices, "zero_stage": 3, "remat": True,
+            "dtype": "bf16 compute, f32 masters+moments+acc",
+        },
+        "n_params": n_params,
+        "per_chip_state_bytes": state,
+        "per_chip_state_gb": round(state["total"] / 1024 ** 3, 3),
+        "hbm_bytes": HBM_BYTES,
+        "state_fits_hbm": state["total"] <= HBM_BYTES,
+        "lower_seconds": round(lower_s, 1),
+    }
+
+    E, L, V = cfg.n_embd, cfg.n_layer, cfg.vocab_size
+    B, S = per_chip_batch, seq
+    act = {
+        "remat_residuals": L * B * S * E * 2,
+        "block_working_set": B * S * (9 * E) * 2,
+        "ce_logits_fwd_bwd": 2 * B * S * V * 4,
+        "gathered_bf16_params_all_live": n_params * 2,
+        "transient_f32_grads_all_live": n_params * 4,
+    }
+    act["total"] = sum(act.values())
+    report["tpu_activation_budget_bytes"] = act
+    report["tpu_budget_total_gb"] = round(
+        (state["total"] + act["total"]) / 1024 ** 3, 3)
+    report["tpu_budget_fits_hbm"] = \
+        state["total"] + act["total"] <= HBM_BYTES
+
+    if compile_program:
+        t0 = time.time()
+        compiled = lowered.compile()
+        report["compile_seconds"] = round(time.time() - t0, 1)
+        ma = compiled.memory_analysis()
+        report["cpu_backend_memory_analysis"] = {
+            "argument_bytes": ma.argument_size_in_bytes,
+            "output_bytes": ma.output_size_in_bytes,
+            "alias_bytes": ma.alias_size_in_bytes,
+            "temp_bytes": ma.temp_size_in_bytes,
+            "caveat": (
+                "CPU is the only 16-device compile target here: its "
+                "scheduler does not minimise temp liveness and its "
+                "attention is the XLA O(S^2) fallback (flash is "
+                "TPU-only), so temp_bytes is an upper bound of the "
+                "wrong schedule; the TPU budget above is the "
+                "schedule-independent estimate"),
+        }
+        txt = compiled.as_text()
+        report["collectives"] = {
+            op: txt.count(op + "(")
+            for op in ("all-gather", "reduce-scatter", "all-reduce",
+                       "collective-permute", "all-to-all")}
+    return report
+
+
+def main(out_path="NORTHSTAR_AOT.json"):
+    import sys
+    sys.path.insert(0, ".")
+    from __graft_entry__ import _force_virtual_cpu_devices
+    _force_virtual_cpu_devices(16)
+    report = northstar_aot_report()
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=1)
+    print(json.dumps({k: v for k, v in report.items()
+                      if k != "cpu_backend_memory_analysis"}, indent=1))
+    assert report["state_fits_hbm"] and report["tpu_budget_fits_hbm"]
+
+
+if __name__ == "__main__":
+    import sys
+    main(*sys.argv[1:])

@@ -1,0 +1,72 @@
+"""At-shape AOT proof of the north-star config (GPT-2 1.5B ZeRO-3 x 16).
+
+BASELINE.json's named target (reference claim:
+docs/_posts/2021-03-08-zero3-offload.md:16) has no executable path in this
+environment; this test proves the program BUILDS at true scale — full
+engine step lowered over a 16-device mesh at real 1.5B shapes, with the
+per-chip state footprint (the ZeRO-3 partitioning claim) asserted under
+the 16 GiB HBM budget. The committed NORTHSTAR_AOT.json carries the
+additional compile-level evidence (collective counts, compiler memory
+analysis); regenerate with
+``python -m deepspeed_tpu.runtime.zero.aot_check``.
+
+Runs in a subprocess: the suite's conftest pins an 8-device platform and
+this proof needs 16.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+_CHILD = r"""
+import json, os, sys
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=16")
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, {repo!r})
+from deepspeed_tpu.runtime.zero.aot_check import northstar_aot_report
+report = northstar_aot_report(compile_program=False)
+print("REPORT::" + json.dumps(report))
+"""
+
+
+def test_northstar_1p5b_zero3_lowers_at_shape():
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    out = subprocess.run(
+        [sys.executable, "-c", _CHILD.format(repo=REPO)],
+        capture_output=True, text=True, timeout=900, env=env)
+    assert out.returncode == 0, out.stderr[-3000:]
+    line = [l for l in out.stdout.splitlines()
+            if l.startswith("REPORT::")][-1]
+    report = json.loads(line[len("REPORT::"):])
+
+    assert report["n_params"] > 1.5e9               # truly at 1.5B shape
+    assert report["config"]["n_devices"] == 16
+    # the ZeRO-3 claim: per-chip state is ~1/16th of the full fp32
+    # state (params + 2 Adam moments + acc = 16 bytes/param)
+    full_state = report["n_params"] * 16
+    per_chip = report["per_chip_state_bytes"]["total"]
+    assert per_chip < full_state / 15.5             # genuinely partitioned
+    assert report["state_fits_hbm"]
+    assert report["tpu_budget_fits_hbm"]
+
+    # committed artifact agrees with the live lowering on the exact parts
+    art_path = os.path.join(REPO, "NORTHSTAR_AOT.json")
+    if os.path.exists(art_path):
+        with open(art_path) as f:
+            art = json.load(f)
+        assert art["n_params"] == report["n_params"]
+        assert (art["per_chip_state_bytes"]["total"]
+                == report["per_chip_state_bytes"]["total"])
+        assert art["collectives"]["all-gather"] > 0
