@@ -208,7 +208,11 @@ def main():
             import dataclasses as _dc
             cfg = _dc.replace(PRESETS["gpt2"], moe_num_experts=8,
                               moe_expert_interval=2,
-                              moe_k=int(os.environ.get("BENCH_MOE_K", "1")))
+                              moe_k=int(os.environ.get("BENCH_MOE_K", "1")),
+                              moe_capacity_factor=float(os.environ.get(
+                                  "BENCH_MOE_CF", "1.25")),
+                              moe_dispatch_impl=os.environ.get(
+                                  "BENCH_MOE_DISPATCH", "scatter"))
         else:
             cfg = (PRESETS[name] if name in PRESETS else
                    GPT2Config(vocab_size=2048, n_positions=256, n_embd=128,

@@ -60,6 +60,19 @@ bool kernel_aio_disabled() {
   return e && e[0] && e[0] != '0';
 }
 
+// high-water mark of simultaneously in-flight kernel-AIO requests since
+// the last reset — the enforceable proof that the queue-depth engine
+// actually overlaps I/O (bandwidth ratios are hostage to the
+// hypervisor's virtio cache; this is not)
+std::atomic<long> g_max_inflight{0};
+
+void note_inflight(int inflight) {
+  long cur = g_max_inflight.load(std::memory_order_relaxed);
+  while (inflight > cur &&
+         !g_max_inflight.compare_exchange_weak(cur, inflight)) {
+  }
+}
+
 int64_t blocked_rw(bool write, const char* path, char* buf, int64_t nbytes,
                    int64_t file_offset, int block_size);
 
@@ -173,6 +186,7 @@ int64_t kernel_aio_rw(bool write, const char* path, char* buf,
     if (rc == 0 && !batch.empty()) {
       if (sys_io_submit(ctx, batch.size(), batch.data()) < 0) rc = -errno;
     }
+    note_inflight(inflight);
     if (rc == 0 && inflight > 0) {
       if (overlap_events) {
         // overlap: free at least one slot, then go refill — submission
@@ -410,6 +424,11 @@ int64_t aio_sync_pwrite(int64_t handle, const char* buffer, const char* path,
   if (id < 0) return id;
   return aio_wait(handle, id);
 }
+
+// observability: high-water mark of in-flight kernel-AIO requests since
+// the last reset (0 = everything went through the fallback)
+int64_t aio_max_inflight() { return g_max_inflight.load(); }
+void aio_reset_max_inflight() { g_max_inflight.store(0); }
 
 // 1 when the kernel io_submit engine can run for files under probe_dir:
 // io_setup permitted AND O_DIRECT opens there (tmpfs/overlayfs reject it,

@@ -51,6 +51,17 @@ def _as_config_dict(config):
     return None
 
 
+def _make_curriculum(cfg):
+    """CurriculumScheduler when the config enables curriculum learning
+    (reference threads curriculum_seqlen through the pipe engine too,
+    runtime/pipe/engine.py:307)."""
+    if not cfg.curriculum_enabled:
+        return None
+    from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import \
+        CurriculumScheduler
+    return CurriculumScheduler(cfg.curriculum_config.params)
+
+
 def initialize(args=None,
                model=None,
                optimizer=None,
@@ -203,7 +214,8 @@ def initialize(args=None,
             min_scale=cfg.fp16.min_loss_scale,
             hysteresis=cfg.fp16.hysteresis,
             lr_scheduler=sched,
-            gradient_clipping=cfg.gradient_clipping)
+            gradient_clipping=cfg.gradient_clipping,
+            curriculum_scheduler=_make_curriculum(cfg))
         return engine, None, None, engine.lr_scheduler
 
     engine = DeepSpeedEngine(args=args,

@@ -63,6 +63,7 @@ class GPT2Config:
     moe_capacity_factor: float = 1.25
     moe_eval_capacity_factor: float = 1.25
     moe_aux_loss_coef: float = 0.01
+    moe_dispatch_impl: str = "scatter"  # 'grouped'|'scatter'|'einsum'
     # "auto" keeps K/V in the activation dtype; "int8" stores the decode
     # cache quantized (per-row absmax scales) — half the cache HBM, the
     # dequant folds into the decode kernel's matmuls
@@ -257,6 +258,7 @@ class MoEBlock(nn.Module):
                             capacity_factor=cfg.moe_capacity_factor,
                             eval_capacity_factor=(
                                 cfg.moe_eval_capacity_factor),
+                            dispatch_impl=cfg.moe_dispatch_impl,
                             name="moe")(h.reshape(B * S, E),
                                         train=not deterministic)
         return x + out.reshape(B, S, E), l_aux

@@ -55,6 +55,10 @@ class MoE(nn.Module):
     noisy_gate_policy: Optional[str] = None
     drop_tokens: bool = True
     use_rts: bool = True
+    # reference ctor parity (moe/layer.py:30): tutel's optimization IS
+    # index-routed dispatch, which this build always has — True maps to
+    # the scatter impl, False keeps whatever dispatch_impl says
+    use_tutel: bool = False
     dispatch_impl: str = "scatter"      # see MOELayer.dispatch_impl
 
     @nn.compact
@@ -63,6 +67,8 @@ class MoE(nn.Module):
         kwargs = dict(self.expert_kwargs or {})
         if expert_cls is MLPExpert and "hidden_size" not in kwargs:
             kwargs["hidden_size"] = self.hidden_size
+        dispatch_impl = ("scatter" if self.use_tutel
+                         else self.dispatch_impl)
 
         out, l_aux, exp_counts = MOELayer(
             expert_module=expert_cls,
@@ -75,7 +81,7 @@ class MoE(nn.Module):
             noisy_gate_policy=self.noisy_gate_policy,
             drop_tokens=self.drop_tokens,
             use_rts=self.use_rts,
-            dispatch_impl=self.dispatch_impl,
+            dispatch_impl=dispatch_impl,
             name="deepspeed_moe")(hidden_states, train,
                                   used_token=used_token)
 

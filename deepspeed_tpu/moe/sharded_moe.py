@@ -181,6 +181,72 @@ def top2gating(logits, capacity_factor=1.0, min_capacity=4, noise_rng=None,
     return l_aux, combine, dispatch, exp_counts
 
 
+_warned_grouped_ep = False
+
+# dw = x^T @ dy contracted over the RAGGED token dim, grouped output
+# [E, in, out] — the '[m,k],[k,n]->[g,m,n]' ragged_dot_general mode
+_DW_DIMS = jax.lax.RaggedDotDimensionNumbers(
+    dot_dimension_numbers=(((0,), (0,)), ((), ())),
+    lhs_ragged_dimensions=[0], rhs_group_dimensions=[])
+
+
+@jax.custom_vjp
+def _grouped_expert_mlp(sorted_x, group_sizes, sorted_eid, w1, b1, w2, b2):
+    """Megablocks-style grouped expert MLP: tokens arrive SORTED by
+    expert, and each matmul is one ``jax.lax.ragged_dot`` over the
+    contiguous per-expert groups — S*k rows total, NO capacity padding
+    (the padded [E, C, M] form computes capacity_factor x as many rows).
+    Dropped tokens still flow through (per-row MLPs make their compute
+    side-effect-free) and are discarded by the combine's valid mask —
+    identical outputs to the padded form.
+
+    Custom VJP: jax's built-in ragged_dot transpose lowers
+    catastrophically on TPU (measured 88 ms vs 1.4 ms for the same math
+    at the bench shape); the hand-written backward keeps dx on
+    ragged_dot with transposed per-expert weights and dw on the
+    ragged-contraction ragged_dot_general mode."""
+    out, _ = _grouped_mlp_fwd(sorted_x, group_sizes, sorted_eid,
+                              w1, b1, w2, b2)
+    return out
+
+
+def _grouped_mlp_fwd(sorted_x, group_sizes, sorted_eid, w1, b1, w2, b2):
+    h1 = jax.lax.ragged_dot(sorted_x, w1.astype(sorted_x.dtype),
+                            group_sizes)
+    h1 = h1 + b1.astype(h1.dtype)[sorted_eid]
+    a, gelu_vjp = jax.vjp(lambda t: nn.gelu(t, approximate=True), h1)
+    out = jax.lax.ragged_dot(a, w2.astype(a.dtype), group_sizes)
+    out = out + b2.astype(out.dtype)[sorted_eid]
+    return out, (sorted_x, group_sizes, sorted_eid, w1, w2, a, gelu_vjp)
+
+
+def _grouped_mlp_bwd(res, g):
+    sorted_x, gs, eid_s, w1, w2, a, gelu_vjp = res
+    E = w1.shape[0]
+    db2 = jax.ops.segment_sum(g.astype(jnp.float32), eid_s,
+                              num_segments=E).astype(w2.dtype)
+    da = jax.lax.ragged_dot(g, w2.transpose(0, 2, 1).astype(g.dtype), gs)
+    dh1 = gelu_vjp(da)[0]
+    db1 = jax.ops.segment_sum(dh1.astype(jnp.float32), eid_s,
+                              num_segments=E).astype(w1.dtype)
+    dw2 = jax.lax.ragged_dot_general(
+        a, g, gs, _DW_DIMS,
+        preferred_element_type=jnp.float32).astype(w2.dtype)
+    dw1 = jax.lax.ragged_dot_general(
+        sorted_x, dh1, gs, _DW_DIMS,
+        preferred_element_type=jnp.float32).astype(w1.dtype)
+    dx = jax.lax.ragged_dot(
+        dh1, w1.transpose(0, 2, 1).astype(dh1.dtype), gs
+    ).astype(sorted_x.dtype)
+    return dx, None, None, dw1, db1, dw2, db2
+
+
+_grouped_expert_mlp.defvjp(
+    lambda sorted_x, gs, eid_s, w1, b1, w2, b2:
+    _grouped_mlp_fwd(sorted_x, gs, eid_s, w1, b1, w2, b2),
+    _grouped_mlp_bwd)
+
+
 class TopKGate(nn.Module):
     """Gate network (reference TopKGate :343): fp32 linear + top-k."""
     num_experts: int
@@ -254,10 +320,70 @@ class MOELayer(nn.Module):
             drop_tokens=self.drop_tokens, use_rts=self.use_rts,
             name="gate")
         E = self.num_experts
-        if self.dispatch_impl not in ("scatter", "einsum"):
+        if self.dispatch_impl not in ("grouped", "scatter", "einsum"):
             raise ValueError(
-                f"dispatch_impl must be 'scatter' or 'einsum', got "
-                f"{self.dispatch_impl!r}")
+                f"dispatch_impl must be 'grouped', 'scatter' or 'einsum', "
+                f"got {self.dispatch_impl!r}")
+
+        if self.dispatch_impl == "grouped":
+            # sort-based grouped GEMM (megablocks-style): no [E, C, M]
+            # operand, no capacity padding — per-step expert compute is
+            # S*k rows instead of E*C = capacity_factor*S*k
+            from deepspeed_tpu.moe.layer import MLPExpert
+            if (groups.mesh_is_initialized()
+                    and groups.get_mesh().shape[groups.EXPERT_AXIS] > 1):
+                # no [E, ...] activation exists on this path, so there is
+                # no constraint point to force the expert all-to-all —
+                # XLA resolves the ragged GEMMs by gathering the expert
+                # weights instead. Correct (the ep goldens pass) but it
+                # forfeits EP's bandwidth win; say so once.
+                global _warned_grouped_ep
+                if not _warned_grouped_ep:
+                    _warned_grouped_ep = True
+                    from deepspeed_tpu.utils.logging import logger
+                    logger.warning(
+                        "dispatch_impl='grouped' under expert parallelism "
+                        "gathers expert weights instead of exchanging "
+                        "tokens (no all-to-all constraint point); use "
+                        "'scatter' for ep>1 performance")
+            if self.expert_module is not MLPExpert:
+                raise NotImplementedError(
+                    "dispatch_impl='grouped' implements the standard "
+                    "MLPExpert (fc1-gelu-fc2) as ragged grouped matmuls; "
+                    f"expert {self.expert_module.__name__} needs "
+                    "dispatch_impl='scatter'")
+            l_aux, routing, C, exp_counts = gate(
+                xf, train, used_token=used_token, sparse=True)
+            S = xf.shape[0]
+            eid = jnp.concatenate([r[0] for r in routing])       # [S*k]
+            gate_w = jnp.concatenate(
+                [r[2] * r[3] for r in routing])                  # gate*valid
+            tok = jnp.tile(jnp.arange(S), len(routing))
+            order = jnp.argsort(eid)
+            sorted_eid = eid[order]
+            sorted_tok = tok[order]
+            group_sizes = jnp.bincount(eid, length=E).astype(jnp.int32)
+            # params come from the SAME vmapped module as the padded
+            # impls — bound on a zero-row dummy (free), so init values,
+            # tree layout, and checkpoints are identical across impls
+            experts = nn.vmap(
+                self.expert_module,
+                in_axes=0, out_axes=0,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                metadata_params={nn.PARTITION_NAME: "expert"},
+            )(name="deepspeed_experts", **self.expert_kwargs)
+            experts(jnp.zeros((E, 0, M), xf.dtype))
+            ev = experts.variables["params"]
+            expert_out = _grouped_expert_mlp(
+                xf[sorted_tok], group_sizes, sorted_eid,
+                ev["fc1"]["kernel"], ev["fc1"]["bias"],
+                ev["fc2"]["kernel"], ev["fc2"]["bias"])
+            combined = jnp.zeros((S, M), expert_out.dtype).at[
+                sorted_tok].add(
+                    gate_w[order][:, None].astype(expert_out.dtype)
+                    * expert_out)
+            return combined.reshape(orig_shape), l_aux, exp_counts
 
         if self.dispatch_impl == "scatter":
             l_aux, routing, C, exp_counts = gate(

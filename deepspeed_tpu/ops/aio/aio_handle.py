@@ -43,6 +43,15 @@ class AsyncIOHandle:
         d = probe_dir.encode() if probe_dir is not None else None
         return bool(self.lib.aio_kernel_available(d))
 
+    def max_inflight(self):
+        """High-water mark of simultaneously in-flight kernel-AIO
+        requests since the last reset (0 = fallback path only) — the
+        cache-independent proof the queue-depth engine overlaps I/O."""
+        return int(self.lib.aio_max_inflight())
+
+    def reset_max_inflight(self):
+        self.lib.aio_reset_max_inflight()
+
     def sync_pread(self, buffer: np.ndarray, path: str, offset=0):
         n = self.lib.aio_sync_pread(self.handle, _buf(buffer),
                                     path.encode(), buffer.nbytes, offset)

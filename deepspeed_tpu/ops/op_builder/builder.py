@@ -140,6 +140,10 @@ class AsyncIOBuilder(CPUOpBuilder):
         lib.aio_pending.restype = i64
         lib.aio_kernel_available.argtypes = [ctypes.c_char_p]
         lib.aio_kernel_available.restype = ctypes.c_int
+        lib.aio_max_inflight.argtypes = []
+        lib.aio_max_inflight.restype = i64
+        lib.aio_reset_max_inflight.argtypes = []
+        lib.aio_reset_max_inflight.restype = None
 
 
 ALL_OPS = {

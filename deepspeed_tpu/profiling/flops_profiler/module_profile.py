@@ -147,6 +147,71 @@ def profile_fn_by_scope(fn: Callable, *args, **kwargs
     return acc
 
 
+def profile_durations_by_scope(fn: Callable, *args, iters: int = 3
+                               ) -> Dict[Tuple[str, ...], float]:
+    """Measured per-scope durations (seconds, exclusive) for one call of
+    ``fn(*args)`` — the reference profiler's per-module latency column
+    (profiler.py:104/:152 duration hooks).
+
+    How: the jitted fn runs ``iters`` times under ``jax.profiler.trace``;
+    the trace's device events carry each op's ``hlo_op`` name, and the
+    compiled module's HLO metadata (``op_name=...``) maps that op back to
+    the SAME flax ``named_scope`` name-stack the flops walk keys on. A
+    fused op attributes its whole duration to its root op's scope."""
+    import glob
+    import gzip
+    import json
+    import shutil
+    import tempfile
+
+    jitted = jax.jit(fn)
+    compiled = jitted.lower(*args).compile()
+    hlo_txt = compiled.as_text()
+    # HLO instruction name -> op_name metadata (the name-stack string)
+    op_scope: Dict[str, str] = {}
+    for m in re.finditer(
+            r'%?([\w.\-]+)\s*=\s*[^\n]*metadata=\{[^}]*op_name="([^"]+)"',
+            hlo_txt):
+        op_scope[m.group(1)] = m.group(2)
+
+    tmp = tempfile.mkdtemp(prefix="ds_prof_")
+    try:
+        # execute the ALREADY-compiled executable — calling jitted()
+        # would compile a second time through the dispatch cache
+        out = compiled(*args)
+        jax.block_until_ready(out)
+        with jax.profiler.trace(tmp):
+            for _ in range(iters):
+                out = compiled(*args)
+            jax.block_until_ready(out)
+        files = sorted(glob.glob(
+            tmp + "/**/*.trace.json.gz", recursive=True))
+        if not files:
+            raise RuntimeError("jax.profiler produced no trace file")
+        with gzip.open(files[-1], "rt") as fh:
+            events = json.load(fh).get("traceEvents", [])
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    acc: Dict[Tuple[str, ...], float] = {}
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        hlo_op = (e.get("args") or {}).get("hlo_op")
+        if not hlo_op:
+            continue
+        scope = op_scope.get(hlo_op)
+        if scope is None:
+            continue
+        # 'jit(f)/Model/h_0/attn/dot_general' -> ('Model','h_0','attn'):
+        # drop jit wrappers and the trailing primitive segment
+        segs = [s for s in scope.split("/")
+                if s and not (s.startswith("jit(") and s.endswith(")"))]
+        path = tuple(segs[:-1])
+        acc[path] = acc.get(path, 0.0) + e.get("dur", 0.0) * 1e-6
+    return {k: v / iters for k, v in acc.items()}
+
+
 def aggregate_by_module(scope_flops: Dict[Tuple[str, ...], float],
                         merge_transforms: bool = True
                         ) -> Dict[Tuple[str, ...], float]:
@@ -182,13 +247,21 @@ def _params_by_module(params: Any) -> Dict[Tuple[str, ...], int]:
 def format_model_profile(scope_flops: Dict[Tuple[str, ...], float],
                          params: Any = None, total_duration: float = 0.0,
                          module_depth: int = -1, top_modules: int = 1,
-                         detailed: bool = True) -> str:
+                         detailed: bool = True,
+                         scope_durations: Dict[Tuple[str, ...], float]
+                         = None) -> str:
     """The reference's detailed ``print_model_profile`` table
-    (profiler.py:975): per module — params, MACs, flops, % of total —
-    ordered depth-first, truncated at ``module_depth`` (-1 = all)."""
+    (profiler.py:975): per module — params, MACs, flops, % of total, and
+    (when ``scope_durations`` from :func:`profile_durations_by_scope` is
+    given) measured latency — ordered depth-first, truncated at
+    ``module_depth`` (-1 = all)."""
     inclusive = aggregate_by_module(scope_flops)
     total = inclusive.get((), 0.0) or 1.0
     pcounts = _params_by_module(params) if params is not None else {}
+    durs = (aggregate_by_module(scope_durations)
+            if scope_durations else {})
+    if durs and not total_duration:
+        total_duration = durs.get((), 0.0)
 
     def fmt(n):
         for unit, div in [("T", 1e12), ("G", 1e9), ("M", 1e6), ("K", 1e3)]:
@@ -208,8 +281,10 @@ def format_model_profile(scope_flops: Dict[Tuple[str, ...], float],
         best = sorted(by_depth[depth], reverse=True)[:max(1, top_modules)]
         lines.append(f"  depth {depth}: " + ", ".join(
             f"{k[-1]} ({100 * fl / total:.1f}%)" for fl, k in best))
-    lines += ["-" * 72,
-              f"{'module':<40}{'params':>10}{'MACs':>12}{'% flops':>10}"]
+    header = f"{'module':<40}{'params':>10}{'MACs':>12}{'% flops':>10}"
+    if durs:
+        header += f"{'latency':>12}"
+    lines += ["-" * 72, header]
     keys = sorted(k for k in inclusive if k)
     for key in keys:
         depth = len(key)
@@ -221,8 +296,11 @@ def format_model_profile(scope_flops: Dict[Tuple[str, ...], float],
         # param paths lack the root module segment
         p = pcounts.get(key[1:], 0)
         name = "  " * (depth - 1) + key[-1]
-        lines.append(f"{name:<40}{fmt(p):>10}{fmt(fl / 2):>12}"
-                     f"{100 * fl / total:>9.1f}%")
+        row = (f"{name:<40}{fmt(p):>10}{fmt(fl / 2):>12}"
+               f"{100 * fl / total:>9.1f}%")
+        if durs:
+            row += f"{durs.get(key, 0.0) * 1e3:>10.2f} ms"
+        lines.append(row)
     lines.append("-" * 72)
     lines.append(f"total flops: {fmt(total)}"
                  + (f"  duration: {total_duration * 1e3:.1f} ms"

@@ -48,6 +48,7 @@ class FlopsProfiler:
         self._start_time = None
         self._duration = 0.0
         self._scope_flops = {}
+        self._scope_durations = {}
 
     def get_scope_flops(self):
         """{name-stack path tuple: flops} from the per-module jaxpr walk
@@ -71,10 +72,28 @@ class FlopsProfiler:
                 self._bytes = costs.get("bytes accessed", 0.0)
                 # per-module attribution from the SAME traced step
                 from deepspeed_tpu.profiling.flops_profiler.module_profile \
-                    import profile_fn_by_scope
+                    import (profile_durations_by_scope,
+                            profile_fn_by_scope)
                 self._scope_flops = profile_fn_by_scope(
                     self.ds_engine._jit_micro, state, batch,
                     jax.random.PRNGKey(0), jnp.float32(1.0))
+                # measured per-module latency (reference profiler.py:104
+                # duration hooks): a fresh NON-donating jit of the micro
+                # fn runs under jax.profiler.trace — calling the engine's
+                # donating _jit_micro here would free the live state
+                try:
+                    micro_fn = self.ds_engine._jit_micro.__wrapped__
+                    with self.ds_engine.mesh:
+                        self._scope_durations = profile_durations_by_scope(
+                            micro_fn, state, batch,
+                            jax.random.PRNGKey(0), jnp.float32(1.0))
+                except Exception as e:  # profiling is best-effort: some
+                    # backends (remote tunnels) cannot trace
+                    from deepspeed_tpu.utils.logging import logger
+                    logger.warning(
+                        "per-module duration profiling unavailable "
+                        "(%s); table will carry flops only", e)
+                    self._scope_durations = {}
 
     def stop_profile(self):
         if self._start_time is not None:
@@ -112,7 +131,8 @@ class FlopsProfiler:
                 self._scope_flops, params=params,
                 total_duration=self._duration,
                 module_depth=module_depth, top_modules=top_modules,
-                detailed=detailed)
+                detailed=detailed,
+                scope_durations=self._scope_durations)
         if output_file:
             with open(output_file, "w") as f:
                 f.write(out + "\n")

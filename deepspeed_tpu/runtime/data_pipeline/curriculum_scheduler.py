@@ -117,3 +117,19 @@ class CurriculumScheduler:
                 self.state[CURRICULUM_LEARNING_MAX_DIFFICULTY]:
             self.state["current_difficulty"] = self.get_difficulty(global_steps)
         return self.state["current_difficulty"]
+
+
+def apply_seqlen_truncation(scheduler, global_steps, batch):
+    """Truncate every >=2-D batch leaf's axis 1 to the scheduled
+    difficulty (the reference injects curriculum_seqlen into forward,
+    engine.py:1577 / pipe engine.py:307; here the batch is sliced so each
+    difficulty plateau compiles once). Shared by the fused DP engine and
+    the host-loop pipe engine — one truncation rule, two executors."""
+    import jax
+    seqlen = scheduler.update_difficulty(global_steps + 1)
+
+    def trunc(x):
+        if hasattr(x, "ndim") and x.ndim >= 2 and x.shape[1] > seqlen:
+            return x[:, :seqlen]
+        return x
+    return jax.tree.map(trunc, batch)

@@ -1081,14 +1081,10 @@ class DeepSpeedEngine:
         """Truncate sequence dims to the scheduled difficulty (reference
         engine.py:1577-1583 injects curriculum_seqlen; here the engine
         slices the batch — each plateau compiles once)."""
-        seqlen = self.curriculum_scheduler.update_difficulty(
-            self.global_steps + 1)
-
-        def trunc(x):
-            if hasattr(x, "ndim") and x.ndim >= 2 and x.shape[1] > seqlen:
-                return x[:, :seqlen]
-            return x
-        return jax.tree.map(trunc, batch)
+        from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler \
+            import apply_seqlen_truncation
+        return apply_seqlen_truncation(self.curriculum_scheduler,
+                                       self.global_steps, batch)
 
     def forward(self, batch):
         """Compute loss for one micro-batch (and, fused, its gradients).
@@ -1192,8 +1188,6 @@ class DeepSpeedEngine:
                     "data-parallel mesh axis or load the full batch per "
                     "process via model_parameters/batch_spec")
             rows = _np.shape(x)[0]
-            # the train micro-batch geometry does not bind eval batches —
-            # any equal-per-rank slice assembles fine there
             if for_train and rows != expect:
                 raise ValueError(
                     f"uneven per-process batch slice: this process holds "
@@ -1202,6 +1196,19 @@ class DeepSpeedEngine:
                     f"exactly {expect} per process (deepspeed_io slices "
                     f"evenly; feed each rank its own equal slice; "
                     f"broadcast leaves must have leading dim 1)")
+            if not for_train:
+                # eval batches are not bound to the train micro-batch
+                # geometry, but ranks must still agree on the row count —
+                # a mismatch would compile divergent programs and hang
+                # at the next collective instead of raising
+                from jax.experimental import multihost_utils
+                all_rows = _np.asarray(multihost_utils.process_allgather(
+                    _np.asarray([rows], _np.int64)))
+                if not (all_rows == rows).all():
+                    raise ValueError(
+                        f"eval batch slices disagree across processes: "
+                        f"row counts {sorted(set(all_rows.ravel().tolist()))}"
+                        f" — every rank must feed an equal slice")
 
         def _place(path, x, sh):
             if _is_broadcast(x):

@@ -171,8 +171,14 @@ class PipelineEngine:
                  compute_dtype=None, dynamic_loss_scale: bool = False,
                  initial_scale: float = 1.0, scale_window: int = 1000,
                  min_scale: float = 1.0, hysteresis: int = 1,
-                 lr_scheduler=None, gradient_clipping: float = 0.0):
+                 lr_scheduler=None, gradient_clipping: float = 0.0,
+                 curriculum_scheduler=None):
         self.pm = pipe_module
+        # curriculum learning inside the pipe engine (reference
+        # runtime/pipe/engine.py:307-308 injects curriculum_seqlen):
+        # train_batch truncates the sequence dim to the scheduled
+        # difficulty; each plateau compiles once
+        self.curriculum_scheduler = curriculum_scheduler
         self.S = pipe_module.num_stages
         self.M = num_microbatches
         self.dp = dp
@@ -305,6 +311,11 @@ class PipelineEngine:
         GAS in the reference pipeline IS the micro-batch count
         (train_batch_size = micro_batch * gas * dp, pipe engine.py:46),
         so there is no separate accumulation loop here."""
+        if self.curriculum_scheduler is not None:
+            from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler \
+                import apply_seqlen_truncation
+            batch = apply_seqlen_truncation(self.curriculum_scheduler,
+                                            self.global_steps, batch)
         x, labels = batch[0], batch[1]
         B = x.shape[0]
         D, M, S = self.dp, self.M, self.S

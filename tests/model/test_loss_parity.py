@@ -229,7 +229,8 @@ class TestMoEGpt:
     """Config #4: tiny MoE-GPT2 (4 experts, top-1, RTS) vs the oracle with
     the engine rng protocol."""
 
-    def _run(self, ep_size, n_devices=None):
+    def _run(self, ep_size, n_devices=None, dispatch="scatter"):
+        import dataclasses as _dc
         import jax
         from deepspeed_tpu.moe.layer import moe_sharding_rules
         from deepspeed_tpu.runtime.zero.partition import ModelParallelRules
@@ -244,8 +245,10 @@ class TestMoEGpt:
             "optimizer": {"type": "Adam", "params": {"lr": oracle.LR}},
         }
         batches = oracle.make_batches(20)
+        model_cfg = _dc.replace(GPT2Config(**oracle.TINY_MOE),
+                                moe_dispatch_impl=dispatch)
         engine, _, _, _ = deepspeed_tpu.initialize(
-            model=GPT2LMHeadModel(GPT2Config(**oracle.TINY_MOE)),
+            model=GPT2LMHeadModel(model_cfg),
             config=cfg, sample_batch=batches[0], seed=oracle.SEED,
             mp_rules=ModelParallelRules(moe_sharding_rules()))
         return [float(engine.train_batch(batch=b)) for b in batches]
@@ -259,6 +262,18 @@ class TestMoEGpt:
         """Expert-parallel (ep=4 over the dp dim): same math, sharded
         experts + all-to-all."""
         losses = self._run(ep_size=4)
+        np.testing.assert_allclose(losses, _golden_named(
+            "gpt2_moe_tiny_fp32_adam.json"), rtol=1e-4, atol=1e-4)
+
+    def test_moe_grouped_matches_golden(self):
+        """Round-5 sort-based grouped dispatch: same init (params come
+        from the identical vmapped module), same curve."""
+        losses = self._run(ep_size=1, n_devices=1, dispatch="grouped")
+        np.testing.assert_allclose(losses, _golden_named(
+            "gpt2_moe_tiny_fp32_adam.json"), rtol=1e-4, atol=1e-4)
+
+    def test_moe_grouped_ep4_matches_golden(self):
+        losses = self._run(ep_size=4, dispatch="grouped")
         np.testing.assert_allclose(losses, _golden_named(
             "gpt2_moe_tiny_fp32_adam.json"), rtol=1e-4, atol=1e-4)
 

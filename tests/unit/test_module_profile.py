@@ -88,6 +88,44 @@ class TestScopeAttribution:
         assert row and not re.search(r"\s0\s", row[0].split()[1])
 
 
+class TestScopeDurations:
+    """Round-5: measured per-module latency (reference profiler.py:104
+    duration hooks) — trace-event durations keyed back to the flops
+    walk's name-stack scopes via the compiled HLO's op_name metadata."""
+
+    def test_layer_durations_sum_to_total(self, tiny_gpt2):
+        from deepspeed_tpu.profiling.flops_profiler.module_profile import \
+            profile_durations_by_scope
+        model, params, batch = tiny_gpt2
+        durs = profile_durations_by_scope(
+            lambda v: model.apply(v, batch), params, iters=5)
+        assert durs, "no attributed device events"
+        inclusive = aggregate_by_module(durs)
+        total = inclusive[()]
+        assert total > 0
+        # the model's submodule durations account for (nearly) the whole
+        # device time of the program
+        root = inclusive.get(("GPT2LMHeadModel",), 0.0)
+        assert root >= 0.7 * total
+        # and each block shows up with nonzero measured time
+        assert inclusive.get(("GPT2LMHeadModel", "h_0"), 0.0) > 0
+        assert inclusive.get(("GPT2LMHeadModel", "h_1"), 0.0) > 0
+
+    def test_table_gains_latency_column(self, tiny_gpt2):
+        from deepspeed_tpu.profiling.flops_profiler.module_profile import \
+            profile_durations_by_scope
+        model, params, batch = tiny_gpt2
+        scope = profile_fn_by_scope(lambda v: model.apply(v, batch), params)
+        durs = profile_durations_by_scope(
+            lambda v: model.apply(v, batch), params, iters=3)
+        table = format_model_profile(scope, params=params["params"],
+                                     scope_durations=durs)
+        assert "latency" in table
+        row = [ln for ln in table.splitlines()
+               if re.match(r"\s*h_0\s", ln)]
+        assert row and row[0].rstrip().endswith("ms")
+
+
 class TestEngineProfiler:
     def test_profile_step_prints_table(self, capsys):
         cfg = GPT2Config(vocab_size=256, n_positions=64, n_embd=32,

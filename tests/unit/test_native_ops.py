@@ -107,6 +107,65 @@ def test_tensor_swapper_tree_roundtrip(tmp_path):
     np.testing.assert_array_equal(back["nu"]["w"], tree["nu"]["w"])
 
 
+def test_swap_in_then_updates_and_persists(tmp_path):
+    """swap_in_then: per-leaf pipelined read -> update -> write-back; the
+    updated values must land both in the returned tree AND on disk."""
+    from deepspeed_tpu.runtime.swap_tensor.swapper import OptimizerSwapper
+    rng = np.random.default_rng(5)
+    tree = {f"l{i}": rng.standard_normal((32, 16)).astype(np.float32)
+            for i in range(4)}
+    sw = OptimizerSwapper(str(tmp_path / "swap"))
+    sw.swap_out_tree(tree)
+    updated = sw.swap_in_then(tree, lambda a: a * 2.0)
+    for k in tree:
+        np.testing.assert_allclose(updated[k], tree[k] * 2.0, rtol=1e-6)
+    back = sw.swap_in_tree(tree)
+    for k in tree:
+        np.testing.assert_allclose(back[k], tree[k] * 2.0, rtol=1e-6)
+
+
+@pytest.mark.slow
+def test_swap_in_then_overlaps_reads_with_updates(tmp_path):
+    """The pipelining A/B (reference PipelinedOptimizerSwapper): with a
+    fixed per-leaf update cost, the pipelined loop's wall-clock must be
+    clearly below the serial sum of (read + update) — leaf N+1's read
+    runs during leaf N's update. The update sleeps (releases the GIL)
+    so the proof is deterministic on a 1-core host."""
+    import time as _time
+    from deepspeed_tpu.runtime.swap_tensor.swapper import OptimizerSwapper
+    rng = np.random.default_rng(6)
+    n_leaves, leaf_mb, upd_s = 6, 8, 0.08
+    tree = {f"l{i}": rng.standard_normal(
+        (leaf_mb << 20) // 4).astype(np.float32) for i in range(n_leaves)}
+    sw = OptimizerSwapper(str(tmp_path / "swap"))
+    sw.swap_out_tree(tree)
+
+    def slow_update(a):
+        _time.sleep(upd_s)
+        return a
+
+    # serial baseline: blocking read then update, per leaf
+    t0 = _time.perf_counter()
+    serial_reads = 0.0
+    for i in range(n_leaves):
+        r0 = _time.perf_counter()
+        buf = sw.swapper.swap_in(f"['l{i}']")
+        serial_reads += _time.perf_counter() - r0
+        slow_update(buf)
+    t_serial = _time.perf_counter() - t0
+
+    t0 = _time.perf_counter()
+    sw.swap_in_then(tree, slow_update)
+    t_pipe = _time.perf_counter() - t0
+    print(f"\nswap pipeline: serial {t_serial * 1e3:.0f} ms "
+          f"(reads {serial_reads * 1e3:.0f}) vs pipelined "
+          f"{t_pipe * 1e3:.0f} ms")
+    # pipelined must hide (most of) the reads behind the updates; allow
+    # the write-back it additionally does, which serial skips
+    assert t_pipe < t_serial - 0.5 * serial_reads + 0.05, (
+        t_pipe, t_serial, serial_reads)
+
+
 @pytest.mark.parametrize("single_submit,overlap_events",
                          [(False, True), (True, True),
                           (False, False), (True, False)])
@@ -191,8 +250,26 @@ def test_aio_kernel_beats_threadpool(tmp_path, monkeypatch):
             best = max(best, n / (time.perf_counter() - t0))
         return best
 
+    h = AsyncIOHandle(block_size=1 << 20, queue_depth=32)
+    h.reset_max_inflight()
     kernel = read_bw(False)
+    inflight = h.max_inflight()
     pool = read_bw(True)
-    print(f"\naio read bandwidth: kernel {kernel / 1e6:.0f} MB/s, "
-          f"threadpool {pool / 1e6:.0f} MB/s")
+    print(f"\naio read bandwidth: kernel {kernel / 1e6:.0f} MB/s "
+          f"(max inflight {inflight}), threadpool {pool / 1e6:.0f} MB/s")
+    # ENFORCEABLE guards (round-5; was kernel > 0.3*pool, which let the
+    # kernel engine regress to 3x SLOWER than its own fallback). A
+    # bandwidth RATIO cannot be enforced from inside this guest: the
+    # hypervisor's virtio cache serves buffered preads from HOST RAM
+    # (measured 2 GB/s pool vs 0.9 GB/s O_DIRECT in a warm window), and
+    # guest drop_caches cannot touch it. What IS cache-independent:
+    # (a) the queue-depth engine must actually OVERLAP — the in-flight
+    # high-water mark reaches a meaningful fraction of queue_depth 32 (a
+    # serialization regression, the way an engine goes slower than its
+    # fallback, pins this at 1);
+    # (b) an absolute O_DIRECT floor far below every measured window
+    # (672-1037 MB/s) but far above a synchronous-per-block regression.
+    assert inflight >= 8, f"kernel AIO failed to overlap: {inflight}"
+    assert kernel >= 200e6, f"cold-cache kernel read {kernel / 1e6:.0f} MB/s"
+    # the old relative check stays as a weak sanity floor
     assert kernel > 0.3 * pool, (kernel / 1e6, pool / 1e6)
