@@ -192,6 +192,8 @@ def main():
             # widen the position table — otherwise XLA silently clamps
             # out-of-range position gathers and benches a degenerate model
             cfg = _dc.replace(cfg, max_position_embeddings=seq_len)
+        if os.environ.get("BENCH_REMAT", "") == "1":
+            cfg = _dc.replace(cfg, remat=True)
         model = BertForPreTraining(cfg)
         optimizer = {"type": "Lamb", "params": {"lr": 1e-4, "fused": True}}
         # BENCH_MLM=masked: the reference pretraining data format
