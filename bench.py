@@ -226,6 +226,11 @@ def main():
             # activation rematerialisation: longest contexts trade ~30%
             # recompute flops for O(layers) less activation HBM
             cfg = _dc.replace(cfg, remat=True)
+        if os.environ.get("BENCH_ATTN_MODE"):
+            # e.g. BENCH_ATTN_MODE=sparse:1024/128 — causal block-sparse
+            # GPT rows (PERF.md round 5)
+            cfg = _dc.replace(
+                cfg, attention_mode=os.environ["BENCH_ATTN_MODE"])
         model = GPT2LMHeadModel(cfg)
         optimizer = {"type": "Adam", "params": {"lr": 1e-4}}
         if os.environ.get("BENCH_FUSED_OPT", "") == "1":
@@ -419,6 +424,23 @@ def main():
             num_heads=cfg.num_attention_heads, block=cfg.sparse_block,
             num_local_blocks=cfg.sparse_num_local_blocks,
             num_global_blocks=cfg.sparse_num_global_blocks,
+        ).make_layout(seq_len)
+        density = float(layout.sum()) / layout.size
+        flops_per_token -= 12 * n_layer * width * seq_len * (1 - density)
+    if (os.environ.get("BENCH_ATTN_MODE", "").startswith("sparse")
+            and name not in ("bert-large", "bert-sparse")):
+        # causal sparse GPT rows: scale the attention term by the
+        # unidirectional layout's density over the FULL [S, S] matrix —
+        # conservative vs the dense rows' convention, which counts the
+        # full square for causal models too
+        from deepspeed_tpu.ops.sparse_attention.fused_kernels import \
+            parse_sparse_mode
+        from deepspeed_tpu.ops.sparse_attention.sparsity_config import \
+            FixedSparsityConfig
+        win, blk = parse_sparse_mode(os.environ["BENCH_ATTN_MODE"])
+        layout = FixedSparsityConfig(
+            num_heads=cfg.n_head, block=blk, num_local_blocks=win // blk,
+            num_global_blocks=1, attention="unidirectional",
         ).make_layout(seq_len)
         density = float(layout.sum()) / layout.size
         flops_per_token -= 12 * n_layer * width * seq_len * (1 - density)

@@ -51,7 +51,11 @@ class GPT2Config:
     pp_microbatches: int = 1           # GPipe microbatches when pp_stages>1
     # sequence/context parallelism: "ring:<axis>" or "ulysses:<axis>"
     # shards the SEQUENCE over the named mesh axis (SURVEY.md §5.7 — the
-    # modern long-context equivalent of the reference's sparse attention)
+    # modern long-context equivalent of the reference's sparse attention);
+    # "sparse" / "sparse:<window_tokens>/<block>" runs block-sparse
+    # attention (unidirectional Fixed layout through the round-5 fused
+    # kernels — the reference applied sparse attention to GPT-style
+    # models via SparseAttentionUtils too)
     attention_mode: str = "auto"
     # MoE-GPT (BASELINE.json config #4): >0 turns every
     # ``moe_expert_interval``-th block's MLP into a deepspeed MoE layer
@@ -197,6 +201,23 @@ class CausalSelfAttention(nn.Module):
             fn = ring_attention if kind == "ring" else ulysses_attention
             out = fn(q, k, v, groups.get_mesh(), axis, causal=True,
                      use_flash=cfg.use_flash)
+        elif cfg.attention_mode.startswith("sparse"):
+            # causal block-sparse: unidirectional Fixed layout through
+            # the fused LUT kernels; "sparse:<window_tokens>/<block>"
+            # (default 1024/128 — the measured long-seq optimum)
+            from deepspeed_tpu.ops.sparse_attention.fused_kernels import (
+                block_sparse_attention_fused, parse_sparse_mode)
+            from deepspeed_tpu.ops.sparse_attention.sparse_self_attention \
+                import get_layout
+            from deepspeed_tpu.ops.sparse_attention.sparsity_config import \
+                FixedSparsityConfig
+            win, blk = parse_sparse_mode(cfg.attention_mode)
+            assert S % blk == 0, (S, blk)
+            layout = get_layout(FixedSparsityConfig(
+                num_heads=H, block=blk, num_local_blocks=win // blk,
+                num_global_blocks=1, attention="unidirectional"), S)
+            out = block_sparse_attention_fused(q, k, v, layout, block=blk,
+                                               causal=True)
         else:
             out = attention(q, k, v, causal=True, use_flash=cfg.use_flash)
         out = out.transpose(0, 2, 1, 3).reshape(B, S, E)
@@ -362,6 +383,9 @@ class GPT2LMHeadModel(nn.Module):
             assert cfg.pp_stages == 1, "KV-cache decode incompatible with pp"
             assert not cfg.attention_mode.startswith(("ring:", "ulysses:")), \
                 "KV-cache decode incompatible with sequence parallelism"
+            assert not cfg.attention_mode.startswith("sparse"), (
+                "KV-cache decode would silently run DENSE attention on a "
+                "sparse-trained model; decode with attention_mode='auto'")
             return_logits = True
             is_step = self.has_variable("cache", "pos_index")
             pi = self.variable("cache", "pos_index",

@@ -696,6 +696,27 @@ def _expand_mask(bm, blk):
             H, nq * blk, g * blk)
 
 
+def parse_sparse_mode(mode):
+    """'sparse' or 'sparse:<window_tokens>/<block>' -> (window, block).
+
+    ONE home for the defaults (1024/128 — the measured long-seq optimum,
+    PERF.md) so the model wiring and bench flop accounting can never
+    disagree on what layout a mode string means."""
+    win, blk = 1024, 128
+    if ":" in mode:
+        parts = mode.split(":", 1)[1].split("/")
+        if len(parts) != 2:
+            raise ValueError(
+                f"sparse attention mode {mode!r}: expected "
+                "'sparse:<window_tokens>/<block>' (e.g. 'sparse:1024/128')")
+        win, blk = int(parts[0]), int(parts[1])
+    if win % blk:
+        raise ValueError(
+            f"sparse attention mode {mode!r}: window {win} must be a "
+            f"multiple of block {blk}")
+    return win, blk
+
+
 def block_sparse_attention_fused(q, k, v, layout, key_padding_bias=None,
                                  block=None, causal=False, sm_scale=None):
     """LUT-driven streaming block-sparse attention (band + global split).

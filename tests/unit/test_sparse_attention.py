@@ -423,3 +423,29 @@ class TestGatheredImpl:
         with pytest.raises(TypeError, match="CONCRETE layout"):
             jax.jit(lambda lay: block_sparse_attention_gathered(
                 q, k, v, lay, None, blk, False))(jnp.asarray(layout))
+
+
+@pytest.mark.slow
+def test_gpt2_sparse_attention_mode_trains():
+    """Round-5: attention_mode='sparse:<window>/<block>' routes GPT-2's
+    causal attention through the fused block-sparse kernels (the
+    reference applied sparse attention to GPT-style models via
+    SparseAttentionUtils); the tiny model must jit and train."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import (GPT2Config, GPT2LMHeadModel,
+                                           synthetic_batch)
+    from deepspeed_tpu.utils import groups
+    groups.destroy()
+    groups.initialize(devices=jax.devices()[:1])
+    cfg = GPT2Config(vocab_size=128, n_positions=64, n_embd=32,
+                     n_layer=2, n_head=2, attention_mode="sparse:32/16")
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=GPT2LMHeadModel(cfg),
+        config={"train_batch_size": 2,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}},
+        sample_batch=synthetic_batch(2, 64, cfg.vocab_size))
+    losses = [float(engine.train_batch(
+        batch=synthetic_batch(2, 64, cfg.vocab_size, seed=s)))
+        for s in range(5)]
+    assert all(np.isfinite(l) for l in losses), losses
+    assert losses[-1] < losses[0], losses
