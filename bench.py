@@ -434,14 +434,9 @@ def main():
         # conservative vs the dense rows' convention, which counts the
         # full square for causal models too
         from deepspeed_tpu.ops.sparse_attention.fused_kernels import \
-            parse_sparse_mode
-        from deepspeed_tpu.ops.sparse_attention.sparsity_config import \
-            FixedSparsityConfig
-        win, blk = parse_sparse_mode(os.environ["BENCH_ATTN_MODE"])
-        layout = FixedSparsityConfig(
-            num_heads=cfg.n_head, block=blk, num_local_blocks=win // blk,
-            num_global_blocks=1, attention="unidirectional",
-        ).make_layout(seq_len)
+            sparse_mode_layout
+        layout, _ = sparse_mode_layout(os.environ["BENCH_ATTN_MODE"],
+                                       cfg.n_head, seq_len)
         density = float(layout.sum()) / layout.size
         flops_per_token -= 12 * n_layer * width * seq_len * (1 - density)
     if name in ("bert-large", "bert-sparse") and masked_fmt:

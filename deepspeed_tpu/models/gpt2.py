@@ -206,16 +206,8 @@ class CausalSelfAttention(nn.Module):
             # the fused LUT kernels; "sparse:<window_tokens>/<block>"
             # (default 1024/128 — the measured long-seq optimum)
             from deepspeed_tpu.ops.sparse_attention.fused_kernels import (
-                block_sparse_attention_fused, parse_sparse_mode)
-            from deepspeed_tpu.ops.sparse_attention.sparse_self_attention \
-                import get_layout
-            from deepspeed_tpu.ops.sparse_attention.sparsity_config import \
-                FixedSparsityConfig
-            win, blk = parse_sparse_mode(cfg.attention_mode)
-            assert S % blk == 0, (S, blk)
-            layout = get_layout(FixedSparsityConfig(
-                num_heads=H, block=blk, num_local_blocks=win // blk,
-                num_global_blocks=1, attention="unidirectional"), S)
+                block_sparse_attention_fused, sparse_mode_layout)
+            layout, blk = sparse_mode_layout(cfg.attention_mode, H, S)
             out = block_sparse_attention_fused(q, k, v, layout, block=blk,
                                                causal=True)
         else:

@@ -702,19 +702,45 @@ def parse_sparse_mode(mode):
     ONE home for the defaults (1024/128 — the measured long-seq optimum,
     PERF.md) so the model wiring and bench flop accounting can never
     disagree on what layout a mode string means."""
-    win, blk = 1024, 128
-    if ":" in mode:
-        parts = mode.split(":", 1)[1].split("/")
-        if len(parts) != 2:
-            raise ValueError(
-                f"sparse attention mode {mode!r}: expected "
-                "'sparse:<window_tokens>/<block>' (e.g. 'sparse:1024/128')")
+    bad = ValueError(
+        f"sparse attention mode {mode!r}: expected 'sparse' or "
+        "'sparse:<window_tokens>/<block>' (e.g. 'sparse:1024/128')")
+    if mode == "sparse":
+        return 1024, 128
+    if not mode.startswith("sparse:"):
+        raise bad
+    parts = mode.split(":", 1)[1].split("/")
+    if len(parts) != 2:
+        raise bad
+    try:
         win, blk = int(parts[0]), int(parts[1])
-    if win % blk:
+    except ValueError:
+        raise bad from None
+    if blk <= 0 or win <= 0 or win % blk:
         raise ValueError(
             f"sparse attention mode {mode!r}: window {win} must be a "
-            f"multiple of block {blk}")
+            f"positive multiple of block {blk}")
     return win, blk
+
+
+def sparse_mode_layout(mode, num_heads, seq_len):
+    """The CAUSAL layout a mode string means — unidirectional Fixed with
+    ``window//block`` local blocks + 1 global. Shared by the GPT-2 model
+    wiring AND bench.py's flop accounting, so a layout retune can never
+    silently desynchronize the two. Returns (layout, block)."""
+    from deepspeed_tpu.ops.sparse_attention.sparse_self_attention import \
+        get_layout
+    from deepspeed_tpu.ops.sparse_attention.sparsity_config import \
+        FixedSparsityConfig
+    win, blk = parse_sparse_mode(mode)
+    if seq_len % blk:
+        raise ValueError(
+            f"sparse attention mode {mode!r}: sequence length {seq_len} "
+            f"must be a multiple of block {blk}")
+    layout = get_layout(FixedSparsityConfig(
+        num_heads=num_heads, block=blk, num_local_blocks=win // blk,
+        num_global_blocks=1, attention="unidirectional"), seq_len)
+    return layout, blk
 
 
 def block_sparse_attention_fused(q, k, v, layout, key_padding_bias=None,

@@ -449,3 +449,66 @@ def test_gpt2_sparse_attention_mode_trains():
         for s in range(5)]
     assert all(np.isfinite(l) for l in losses), losses
     assert losses[-1] < losses[0], losses
+
+
+class TestFusedPackedEdgeCases:
+    """Geometry edges of the fused impl's packed-global-column path:
+    pad columns (g_pad > |gc|), causal + packed + key-padding bias
+    together, and an odd global-column count."""
+
+    def _layout_with_globals(self, H, nk, blk, gcols):
+        lay = np.zeros((H, nk, nk), np.int64)
+        for i in range(nk):                       # narrow local band
+            lay[:, i, max(0, i - 1):i + 1] = 1
+        for j in gcols:                           # global columns
+            lay[:, :, j] = 1
+        return lay
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_padded_globals_parity(self, causal):
+        from deepspeed_tpu.ops.sparse_attention.fused_kernels import (
+            _decompose_layout, block_sparse_attention_fused)
+        H, blk, nk = 2, 16, 8
+        S = nk * blk
+        # 3 global columns: with c0 = 4 fine blocks per coarse tile the
+        # packed region pads 3 -> 4 (one dead pad column)
+        layout = self._layout_with_globals(H, nk, blk, [0, 3, 6])
+        gr, gc, _ = _decompose_layout(np.asarray(layout) != 0, causal)
+        assert len(gc) >= 3, gc                  # the split path engages
+        q, k, v = _qkv(H=H, S=S)
+        rng = np.random.default_rng(7)
+        valid = rng.random((1, S)) > 0.2
+        valid[:, 0] = True
+        kpb = jnp.where(jnp.asarray(valid), 0.0, -1e9).astype(jnp.float32)
+        out = block_sparse_attention_fused(
+            q, k, v, layout, key_padding_bias=kpb, block=blk,
+            causal=causal)
+        mask = jnp.asarray(layout_to_dense_mask(layout, blk, S))[None]
+        ref = mha_reference(q, k, v, causal=causal, mask=mask,
+                            bias=kpb[:, None, None, :])
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=3e-5, rtol=3e-5)
+        # grads through the packed concat/gather path
+        gs = jax.grad(lambda *a: jnp.sum(block_sparse_attention_fused(
+            *a, layout, key_padding_bias=kpb, block=blk,
+            causal=causal) ** 2), (0, 1, 2))(q, k, v)
+        gref = jax.grad(lambda *a: jnp.sum(mha_reference(
+            *a, causal=causal, mask=mask,
+            bias=kpb[:, None, None, :]) ** 2), (0, 1, 2))(q, k, v)
+        for a, b, n in zip(gs, gref, "qkv"):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-3, rtol=2e-3, err_msg=n)
+
+    def test_parse_sparse_mode(self):
+        from deepspeed_tpu.ops.sparse_attention.fused_kernels import \
+            parse_sparse_mode
+        assert parse_sparse_mode("sparse") == (1024, 128)
+        assert parse_sparse_mode("sparse:512/64") == (512, 64)
+        with pytest.raises(ValueError, match="expected"):
+            parse_sparse_mode("sparse:1024")
+        with pytest.raises(ValueError, match="expected"):
+            parse_sparse_mode("sparse1024/128")   # missing colon
+        with pytest.raises(ValueError, match="multiple"):
+            parse_sparse_mode("sparse:100/64")
+        with pytest.raises(ValueError, match="multiple"):
+            parse_sparse_mode("sparse:1024/0")
