@@ -109,17 +109,15 @@ def make_compressed_allreduce(mesh, axis_name="data"):
     shard_map.
     """
     from jax.sharding import PartitionSpec as P
-    try:
-        from jax import shard_map
-    except ImportError:  # pre-0.8 jax
-        from jax.experimental.shard_map import shard_map
+    from deepspeed_tpu.utils.jax_compat import get_shard_map
+    shard_map, smap_kw = get_shard_map()
 
     spec = P(axis_name)
 
     @functools.partial(
         shard_map, mesh=mesh,
         in_specs=(spec, spec, spec),
-        out_specs=(spec, spec, spec))
+        out_specs=(spec, spec, spec), **smap_kw)
     def run(x, we, se):
         out, we2, se2 = compressed_allreduce(
             x[0], we[0], se[0], axis_name)

@@ -775,12 +775,11 @@ class DeepSpeedEngine:
         (token-id, row) pairs + scatter-add — the reference
         ``sparse_allreduce_bucket`` dataflow (engine.py:2196-2268). Wire
         cost per table: dp*k*(D+1) elements instead of dp*V*D."""
-        try:
-            from jax import shard_map
-        except ImportError:  # pre-0.8 jax
-            from jax.experimental.shard_map import shard_map
         import functools
+
         from deepspeed_tpu.runtime.sparse_tensor import sparse_all_reduce
+        from deepspeed_tpu.utils.jax_compat import get_shard_map
+        shard_map, smap_kw = get_shard_map()
         axis = groups.DATA_AXIS
         mask = self._sparse_mask
         ids_fn = self._sparse_ids_fn
@@ -819,7 +818,7 @@ class DeepSpeedEngine:
 
         smap = functools.partial(shard_map, mesh=self.mesh)
         return smap(body, in_specs=(P(), P(axis), P(), P(), P()),
-                    out_specs=(P(), P()), check_vma=False)
+                    out_specs=(P(), P()), **smap_kw)
 
     def _build_step_fns(self):
         if self._onebit_dist:
@@ -975,11 +974,10 @@ class DeepSpeedEngine:
         gas = self.gradient_accumulation_steps()
         cfg = self.config
         axis = groups.DATA_AXIS
-        try:
-            from jax import shard_map
-        except ImportError:  # pre-0.8 jax
-            from jax.experimental.shard_map import shard_map
         import functools
+
+        from deepspeed_tpu.utils.jax_compat import get_shard_map
+        shard_map, smap_kw = get_shard_map()
         smap = functools.partial(shard_map, mesh=self.mesh)
 
         opt_spec = type(self.state.opt_state)(
@@ -1002,7 +1000,7 @@ class DeepSpeedEngine:
             acc, loss = smap(
                 body,
                 in_specs=(P(), P(axis), P(), P(axis), P(), P()),
-                out_specs=(P(axis), P()), check_vma=False)(
+                out_specs=(P(axis), P()), **smap_kw)(
                     state.params, state.acc_grads, state.scale.loss_scale,
                     batch, rng, pld_theta)
             return state._replace(acc_grads=acc), loss
@@ -1035,7 +1033,7 @@ class DeepSpeedEngine:
                 body,
                 in_specs=(P(), opt_spec, P(axis), P(), P()),
                 out_specs=(P(), opt_spec, P(axis), P()),
-                check_vma=False)(
+                **smap_kw)(
                     state.params, state.opt_state, state.acc_grads,
                     1.0 / state.scale.loss_scale, lr)
             new_scale = update_scale(
