@@ -253,6 +253,15 @@ def main():
     groups.initialize()
     offload_mode = os.environ.get("BENCH_OFFLOAD", "").lower()
     layered = offload_mode == "layered"
+    # Telemetry rides along by default (BENCH_TELEMETRY=0 disables): spans
+    # + compile watch + metrics cost ~µs against ms-scale steps, and the
+    # artifact answers "why was this bench slow" (retraces, stalls)
+    # without a rerun. Files land in telemetry/ next to this script; a
+    # summary JSON (TELEMETRY_BENCH.json) is written next to BENCH_*.json.
+    telemetry_on = os.environ.get("BENCH_TELEMETRY", "1").lower() in (
+        "1", "true", "yes")
+    bench_dir = os.path.dirname(os.path.abspath(__file__))
+    telemetry_dir = os.path.join(bench_dir, "telemetry")
     ds_config = {
         "train_batch_size": batch_size,
         "train_micro_batch_size_per_gpu": batch_size // max(
@@ -261,6 +270,14 @@ def main():
         "optimizer": optimizer,
         "zero_optimization": {"stage": zero_stage},
         "bf16": {"enabled": True},
+        # scalar fan-out fires at steps_per_print cadence, which the
+        # bench pins to 1e9 — the jsonl/prom sinks would only ever hold
+        # empty/partial data, so keep them off and snapshot the registry
+        # into TELEMETRY_BENCH.json instead
+        "telemetry": {"enabled": telemetry_on,
+                      "output_path": telemetry_dir,
+                      "job_name": f"bench_{name}",
+                      "jsonl": False, "prometheus": False},
     }
     if layered:
         # beyond-HBM training: params streamed from host RAM layer by
@@ -480,6 +497,23 @@ def main():
         # above reflects a degraded environment, NOT engine speed
         "tunnel_healthy": healthy,
     }))
+
+    # telemetry artifact next to BENCH_*.json: where the trace/sink files
+    # are + the full metrics snapshot (step-time histogram, compile
+    # counts/seconds, retraces, memory) for the perf PRs that follow
+    tel = getattr(engine, "telemetry", None)
+    if tel is not None and tel.enabled:
+        tel.close()   # forces the final complete trace export
+        engine.monitor.close()
+        summary = {
+            "bench": name,
+            "trace_json": tel.trace_path,
+            "sinks": {type(m).__name__: getattr(m, "path", None)
+                      for m in engine.monitor.monitors},
+            "metrics": tel.registry.snapshot(),
+        }
+        with open(os.path.join(bench_dir, "TELEMETRY_BENCH.json"), "w") as f:
+            json.dump(summary, f, indent=2, default=repr)
 
 
 if __name__ == "__main__":

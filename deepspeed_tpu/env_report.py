@@ -63,9 +63,53 @@ def debug_report():
         print(f"{name} {'.' * (30 - len(name))} {value}")
 
 
+def telemetry_report():
+    """Availability of each telemetry backend (telemetry/)."""
+    print("-" * 64)
+    print("DeepSpeed-TPU telemetry backend report")
+    print("-" * 64)
+    max_dots = 30
+
+    def row(name, ok, note=""):
+        print(name + "." * (max_dots - len(name)) +
+              f" {OKAY if ok else NO}" + (f"  {note}" if note else ""))
+
+    # pure-stdlib backends: always available
+    row("trace spans (chrome json)", True)
+    row("jsonl sink", True)
+    row("prometheus text exporter", True)
+    row("compile watch (signatures)", True)
+    try:
+        from jax import monitoring
+        row("jax.monitoring listener",
+            hasattr(monitoring, "register_event_duration_secs_listener"))
+    except Exception:
+        row("jax.monitoring listener", False)
+    try:
+        from jax.profiler import TraceAnnotation  # noqa: F401
+        row("jax.profiler annotations", True)
+    except Exception:
+        row("jax.profiler annotations", False)
+    try:
+        import jax
+        stats = jax.local_devices()[0].memory_stats()
+        row("device memory_stats", bool(stats),
+            "" if stats else "(backend returns none; host RSS fallback)")
+    except Exception:
+        row("device memory_stats", False, "(host RSS fallback)")
+    row("psutil (host RSS fallback)",
+        importlib.util.find_spec("psutil") is not None)
+    try:
+        import torch.utils.tensorboard  # noqa: F401
+        row("tensorboard monitor", True)
+    except Exception:
+        row("tensorboard monitor", False, "(csv fallback)")
+
+
 def main():
     op_report()
     debug_report()
+    telemetry_report()
 
 
 def cli_main():

@@ -1,14 +1,20 @@
-"""Training telemetry — TensorBoard + CSV writers.
+"""Training telemetry — TensorBoard + CSV + JSONL + Prometheus writers.
 
 Rebuild of the reference's rank-0 TensorBoard wiring
 (engine.get_summary_writer engine.py:510; scalar writes :1686/:1911-1939/
 _write_tensorboard :2011). A CSV fallback keeps telemetry alive on hosts
-without the tensorboard package.
+without the tensorboard package. The ``telemetry`` config block adds the
+structured sinks (telemetry/sinks.py) as extra backends, so every
+existing ``write_events`` call site fans out to them unchanged.
+
+All backends share the ``write_scalar``/``flush``/``close`` protocol;
+``MonitorMaster.close()`` (or using it as a context manager) releases the
+file handles — backends hold open files, so teardown matters for anything
+longer-lived than the process.
 """
 
 import csv
 import os
-from typing import Optional
 
 
 class TensorBoardMonitor:
@@ -24,6 +30,9 @@ class TensorBoardMonitor:
     def flush(self):
         self.writer.flush()
 
+    def close(self):
+        self.writer.close()
+
 
 class CSVMonitor:
     def __init__(self, output_path="runs/", job_name="DeepSpeedJobName"):
@@ -38,13 +47,26 @@ class CSVMonitor:
         self._writer.writerow([step, name, float(value)])
 
     def flush(self):
-        self._file.flush()
+        if not self._file.closed:
+            self._file.flush()
+
+    def close(self):
+        if not self._file.closed:
+            self._file.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
 
 
 class MonitorMaster:
     """Fans scalars out to every enabled backend (rank 0 only)."""
 
-    def __init__(self, tensorboard_config=None, rank=0):
+    def __init__(self, tensorboard_config=None, rank=0,
+                 telemetry_config=None, metrics_registry=None):
         self.monitors = []
         self.enabled = rank == 0
         if not self.enabled:
@@ -56,6 +78,23 @@ class MonitorMaster:
                 self.monitors.append(TensorBoardMonitor(path, job))
             except Exception:
                 self.monitors.append(CSVMonitor(path, job))
+        if telemetry_config is not None and telemetry_config.enabled:
+            from deepspeed_tpu.telemetry.sinks import (JSONLMonitor,
+                                                       PrometheusMonitor)
+            path = telemetry_config.output_path or "telemetry/"
+            job = telemetry_config.job_name or "DeepSpeedJobName"
+            if telemetry_config.jsonl:
+                self.monitors.append(JSONLMonitor(path, job))
+            if telemetry_config.prometheus:
+                # shares the TelemetryManager's registry so engine metrics
+                # (step times, compile counts, ...) land in the same .prom
+                self.monitors.append(PrometheusMonitor(
+                    path, job, registry=metrics_registry))
+        if self.monitors:
+            # backends hold open file handles; a run that never tears the
+            # engine down still flushes + closes at interpreter exit
+            import atexit
+            atexit.register(self.close)
 
     def write_events(self, event_list, flush=True):
         """event_list: [(name, value, step), ...] — reference signature."""
@@ -67,3 +106,27 @@ class MonitorMaster:
         if flush:
             for m in self.monitors:
                 m.flush()
+
+    def close(self):
+        """Flush and release every backend (idempotent)."""
+        if not self.enabled:
+            return
+        for m in self.monitors:
+            try:
+                m.close()
+            except Exception:
+                pass
+        # drop the exit hook so long-lived processes constructing many
+        # masters (sweeps, test suites) don't pin closed instances
+        import atexit
+        try:
+            atexit.unregister(self.close)
+        except Exception:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -11,11 +11,43 @@ dp-resharded loads (elastic resume, reference stage_1_and_2.py:2023) work
 because reassembly is index-based, not rank-based.
 """
 
+import os
 import pickle
 from typing import Any, Callable, Dict, List, Tuple
 
 import jax
 import numpy as np
+
+from deepspeed_tpu.telemetry import trace_span
+from deepspeed_tpu.telemetry.metrics import get_registry
+
+
+def dump_file(obj, path: str, kind: str = "checkpoint") -> int:
+    """``pickle.dump`` wrapped in an I/O trace span, with the written
+    bytes counted into ``checkpoint_write_bytes_total{kind=...}``. All
+    checkpoint writers (engine + this module) route through here so the
+    telemetry byte accounting covers every file of a save."""
+    with trace_span(f"checkpoint/write/{kind}",
+                    path=os.path.basename(path)):
+        with open(path, "wb") as f:
+            pickle.dump(obj, f)
+        nbytes = os.path.getsize(path)
+    get_registry().counter("checkpoint_write_bytes_total",
+                           "bytes written by checkpoint saves",
+                           labels={"kind": kind}).inc(nbytes)
+    return nbytes
+
+
+def load_file(path: str, kind: str = "checkpoint"):
+    """``pickle.load`` counterpart of ``dump_file`` (read span + bytes)."""
+    with trace_span(f"checkpoint/read/{kind}",
+                    path=os.path.basename(path)):
+        with open(path, "rb") as f:
+            obj = pickle.load(f)
+    get_registry().counter("checkpoint_read_bytes_total",
+                           "bytes read by checkpoint loads",
+                           labels={"kind": kind}).inc(os.path.getsize(path))
+    return obj
 
 
 def _index_to_key(index, shape) -> Tuple:
@@ -54,8 +86,9 @@ def tree_local_shards(tree) -> Dict[str, dict]:
 
 
 def save_tree(tree, path: str):
-    with open(path, "wb") as f:
-        pickle.dump(tree_local_shards(tree), f)
+    with trace_span("checkpoint/shard_tree"):
+        payload = tree_local_shards(tree)
+    dump_file(payload, path, kind="shards")
 
 
 def assemble(files_payloads: List[Dict[str, dict]]) -> Dict[str, np.ndarray]:
@@ -104,8 +137,7 @@ def restore_tree(template, files_payloads: List[Dict[str, dict]],
 
 
 def load_payload(path: str) -> Dict[str, dict]:
-    with open(path, "rb") as f:
-        return pickle.load(f)
+    return load_file(path, kind="shards")
 
 
 # ---------------------------------------------------------------------------
@@ -175,13 +207,15 @@ def save_moe_experts(tag_dir, params_np, mp_rank=0):
                 f"layer_*_expert_*_mp_rank_{mp_rank:02d}_model_states.pt")):
             os.remove(f)
     counts = []
-    for lid, layer in enumerate(experts):
-        num = next(iter(layer.values())).shape[0]
-        counts.append(num)
-        for eid in range(num):
-            sd = {path: np.asarray(leaf[eid]) for path, leaf in layer.items()}
-            with open(moe_expert_file(tag_dir, lid, eid, mp_rank), "wb") as f:
-                pickle.dump(sd, f)
+    with trace_span("checkpoint/save_moe_experts"):
+        for lid, layer in enumerate(experts):
+            num = next(iter(layer.values())).shape[0]
+            counts.append(num)
+            for eid in range(num):
+                sd = {path: np.asarray(leaf[eid])
+                      for path, leaf in layer.items()}
+                dump_file(sd, moe_expert_file(tag_dir, lid, eid, mp_rank),
+                          kind="moe_expert")
     return non_moe, prefixes, counts
 
 
@@ -220,10 +254,7 @@ def restore_moe_experts(tag_dir, module_np, prefixes, mp_rank=0,
                 f"MoE checkpoint layer {lid} has {len(eids)} expert files "
                 f"but the checkpoint metadata records "
                 f"{expert_counts[lid]} experts")
-        payloads = []
-        for _, f in by_eid:
-            with open(f, "rb") as fh:
-                payloads.append(pickle.load(fh))
+        payloads = [load_file(f, kind="moe_expert") for _, f in by_eid]
         for path in payloads[0]:
             stacked = np.stack([p[path] for p in payloads], axis=0)
             node = module_np

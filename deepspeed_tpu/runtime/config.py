@@ -87,6 +87,41 @@ class DeepSpeedTensorboardConfig(DeepSpeedConfigObject):
         self.job_name = tb.get(C.TENSORBOARD_JOB_NAME, C.TENSORBOARD_JOB_NAME_DEFAULT)
 
 
+class DeepSpeedTelemetryConfig(DeepSpeedConfigObject):
+    """``telemetry`` block (TPU-native, beyond the reference schema):
+    structured spans + compile watch + metrics sinks (telemetry/).
+
+    Env overrides (sweep ergonomics, applied after JSON): ``DS_TELEMETRY``
+    = 1/0 force-toggles ``enabled``; ``DS_TELEMETRY_DIR`` overrides
+    ``output_path``."""
+
+    def __init__(self, param_dict):
+        t = param_dict.get(C.TELEMETRY, {}) or {}
+        self.enabled = t.get(C.TELEMETRY_ENABLED, C.TELEMETRY_ENABLED_DEFAULT)
+        self.output_path = t.get(C.TELEMETRY_OUTPUT_PATH,
+                                 C.TELEMETRY_OUTPUT_PATH_DEFAULT)
+        self.job_name = t.get(C.TELEMETRY_JOB_NAME,
+                              C.TELEMETRY_JOB_NAME_DEFAULT)
+        self.trace = t.get(C.TELEMETRY_TRACE, C.TELEMETRY_TRACE_DEFAULT)
+        self.jax_annotations = t.get(C.TELEMETRY_JAX_ANNOTATIONS,
+                                     C.TELEMETRY_JAX_ANNOTATIONS_DEFAULT)
+        self.compile_watch = t.get(C.TELEMETRY_COMPILE_WATCH,
+                                   C.TELEMETRY_COMPILE_WATCH_DEFAULT)
+        self.jsonl = t.get(C.TELEMETRY_JSONL, C.TELEMETRY_JSONL_DEFAULT)
+        self.prometheus = t.get(C.TELEMETRY_PROMETHEUS,
+                                C.TELEMETRY_PROMETHEUS_DEFAULT)
+        self.memory_metrics = t.get(C.TELEMETRY_MEMORY_METRICS,
+                                    C.TELEMETRY_MEMORY_METRICS_DEFAULT)
+        self.max_trace_events = t.get(C.TELEMETRY_MAX_TRACE_EVENTS,
+                                      C.TELEMETRY_MAX_TRACE_EVENTS_DEFAULT)
+        env = os.environ.get("DS_TELEMETRY")
+        if env is not None:
+            self.enabled = env.lower() in ("1", "true", "yes", "on")
+        env_dir = os.environ.get("DS_TELEMETRY_DIR")
+        if env_dir:
+            self.output_path = env_dir
+
+
 class DeepSpeedFlopsProfilerConfig(DeepSpeedConfigObject):
     def __init__(self, param_dict):
         fp = param_dict.get(C.FLOPS_PROFILER, {}) or {}
@@ -347,6 +382,8 @@ class DeepSpeedConfig:
         self.tensorboard_enabled = self.tensorboard.enabled
         self.tensorboard_output_path = self.tensorboard.output_path
         self.tensorboard_job_name = self.tensorboard.job_name
+        self.telemetry = DeepSpeedTelemetryConfig(pd)
+        self.telemetry_enabled = self.telemetry.enabled
 
         self.flops_profiler_config = DeepSpeedFlopsProfilerConfig(pd)
         self.activation_checkpointing_config = DeepSpeedActivationCheckpointingConfig(pd)

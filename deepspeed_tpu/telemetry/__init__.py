@@ -1,0 +1,37 @@
+"""Structured telemetry subsystem.
+
+Four pieces (see the per-module docstrings):
+
+* ``tracer`` — nested ``trace_span`` contexts -> Chrome-trace JSON
+  (+ optional ``jax.profiler.TraceAnnotation`` forwarding);
+* ``compile_watch`` — XLA compile counting + retrace culprit reports;
+* ``metrics`` — counters / gauges / histograms + device-memory stats;
+* ``sinks`` — JSONL event writer and Prometheus text-format exporter
+  (both also usable as ``MonitorMaster`` backends).
+
+``TelemetryManager`` (manager.py) wires them per engine run, behind the
+``telemetry`` config block (see CONFIG.md). Everything is importable and
+near-free when disabled: ``trace_span`` on the default (disabled) global
+tracer is a shared no-op context manager.
+"""
+
+from deepspeed_tpu.telemetry.tracer import (Tracer, get_tracer, set_tracer,
+                                            trace_span)
+from deepspeed_tpu.telemetry.metrics import (Counter, Gauge, Histogram,
+                                             MetricsRegistry,
+                                             device_memory_stats,
+                                             get_registry, set_registry)
+from deepspeed_tpu.telemetry.compile_watch import CompileWatch
+from deepspeed_tpu.telemetry.sinks import (JSONLMonitor, JSONLSink,
+                                           PrometheusMonitor,
+                                           PrometheusSink,
+                                           render_prometheus)
+from deepspeed_tpu.telemetry.manager import TelemetryManager
+
+__all__ = [
+    "Tracer", "get_tracer", "set_tracer", "trace_span",
+    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "device_memory_stats", "get_registry", "set_registry",
+    "CompileWatch", "JSONLMonitor", "JSONLSink", "PrometheusMonitor",
+    "PrometheusSink", "render_prometheus", "TelemetryManager",
+]

@@ -1,0 +1,192 @@
+"""XLA compile/retrace watch.
+
+On TPU the dominant *silent* perf killer is retracing: a jitted entry
+point fed a new shape/dtype/tree-structure quietly recompiles (seconds to
+minutes) instead of erroring. ``CompileWatch.wrap`` instruments a callable
+with per-call signature tracking:
+
+* first signature -> counted as the expected compile;
+* every NEW signature after that -> counted as a retrace and reported with
+  a ONE-line culprit report naming the function and the argument path
+  whose abstract value (shape/dtype) changed, e.g.::
+
+    [compile-watch] retrace #1 of 'micro_step': arg batch['input_ids']
+    aval changed int32[8,128] -> int32[8,256] (2 signatures seen)
+
+Each distinct signature is reported exactly once — a steady alternation
+between two shapes warns on first sight of each, then stays quiet (the
+cache serves both programs; the *report* is about new compilations).
+
+The fast path is one shape/dtype tuple build over the call's leaves
+(~µs for step-sized trees); the with-path diff runs only when a new
+signature is actually seen. ``install_global_listener`` additionally taps
+``jax.monitoring`` so compiles triggered outside wrapped entry points
+still move the ``xla_compiles_total`` counter.
+"""
+
+import functools
+
+from deepspeed_tpu.telemetry import metrics as _metrics
+from deepspeed_tpu.utils.logging import logger
+
+
+def _leaf_sig(x):
+    """Abstract-value descriptor for one call-argument leaf."""
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:
+        return ("aval", tuple(shape), str(dtype))
+    # static leaf: identity by value when hashable, else by repr
+    try:
+        hash(x)
+        return ("static", x)
+    except TypeError:
+        return ("static", repr(x))
+
+
+def _fmt(sig):
+    if sig[0] == "aval":
+        _, shape, dtype = sig
+        short = {"float32": "f32", "float16": "f16", "bfloat16": "bf16",
+                 "int32": "i32", "int64": "i64", "uint32": "u32",
+                 "int8": "i8", "uint8": "u8", "bool": "pred"}.get(dtype,
+                                                                  dtype)
+        return f"{short}[{','.join(str(d) for d in shape)}]"
+    return f"static:{sig[1]!r}"
+
+
+class CompileWatch:
+    """Tracks compilations/retraces across any number of wrapped fns."""
+
+    def __init__(self, registry=None, log_fn=None):
+        self.registry = registry if registry is not None \
+            else _metrics.get_registry()
+        self.log_fn = log_fn or logger.warning
+        self.compiles = 0
+        self.retraces = 0
+        self._per_fn = {}
+
+    def wrap(self, fn, name=None):
+        """Return *fn* instrumented with signature tracking. The original
+        is kept on ``wrapped._compile_watch_target`` (AOT surfaces like
+        ``.lower`` live on the jitted original, not the wrapper —
+        ``__wrapped__`` won't do, jax.jit objects carry their own)."""
+        import jax
+        name = name or getattr(fn, "__name__", repr(fn))
+        state = self._per_fn.setdefault(name, {"sigs": set(), "last": None})
+
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            treedef = None
+            try:
+                leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+                sig = (treedef, tuple(_leaf_sig(x) for x in leaves))
+            except Exception:
+                sig = None
+            if sig is not None and sig not in state["sigs"]:
+                first = not state["sigs"]
+                state["sigs"].add(sig)
+                self.compiles += 1
+                self.registry.counter(
+                    "xla_compiles_total",
+                    "compilations observed by wrapped jit entry points",
+                    labels={"fn": name}).inc()
+                if not first:
+                    self.retraces += 1
+                    self.registry.counter(
+                        "xla_retraces_total",
+                        "NEW signatures after the first (retraces)",
+                        labels={"fn": name}).inc()
+                    self.log_fn(self._report(name, state["last"], sig))
+                state["last"] = sig
+            return fn(*args, **kwargs)
+
+        wrapped._compile_watch_target = fn
+        # preserve the unwrap contract of jit-wrapped targets: consumers
+        # (flops profiler) expect .__wrapped__ to be the RAW python
+        # function (jax.jit sets it), not the jitted/donating callable
+        # functools.wraps just pointed it at
+        wrapped.__wrapped__ = getattr(fn, "__wrapped__", fn)
+        return wrapped
+
+    def _report(self, name, prev, cur):
+        """One-line culprit report: diff *cur* against the previously seen
+        signature and name the offending arg path + avals."""
+        import jax
+        head = (f"[compile-watch] retrace #{self.retraces} of {name!r}")
+        tail = f" ({len(self._per_fn[name]['sigs'])} signatures seen)"
+        if prev is None or prev[0] != cur[0]:
+            return head + ": call tree structure changed" + tail
+        diffs = [(i, a, b) for i, (a, b)
+                 in enumerate(zip(prev[1], cur[1])) if a != b]
+        if not diffs:
+            return head + tail
+        # resolve leaf index -> human path via the treedef's unflatten
+        paths = None
+        try:
+            dummy = jax.tree_util.tree_unflatten(
+                cur[0], list(range(len(cur[1]))))
+            flat = jax.tree_util.tree_flatten_with_path(dummy)[0]
+            paths = {leaf: jax.tree_util.keystr(path) for path, leaf in flat}
+        except Exception:
+            pass
+        i, a, b = diffs[0]
+        where = paths.get(i, f"#{i}") if paths else f"#{i}"
+        more = f" (+{len(diffs) - 1} more)" if len(diffs) > 1 else ""
+        return (head + f": arg {where} aval changed "
+                f"{_fmt(a)} -> {_fmt(b)}{more}" + tail)
+
+
+# --------------------------------------------------------------------------
+# Global backend-compile listener: jax.monitoring publishes
+# '/jax/backend_compile' durations for EVERY XLA compilation, including
+# ones no wrapped entry point saw. Registered at most once per process
+# (jax has no unregister API); the listener routes through this mutable
+# holder so it can be retargeted or disabled (holder[0] = None).
+# --------------------------------------------------------------------------
+
+_LISTENER_TARGET = [None]
+_LISTENER_INSTALLED = False
+
+
+def install_global_listener(registry):
+    """Count backend compiles + compile seconds into *registry*. Returns
+    True when the listener is active (now or from a prior install)."""
+    global _LISTENER_INSTALLED
+    _LISTENER_TARGET[0] = registry
+    if _LISTENER_INSTALLED:
+        return True
+    try:
+        from jax import monitoring
+
+        def _on_duration(event, duration, **kw):
+            reg = _LISTENER_TARGET[0]
+            if reg is None or "compile" not in event:
+                return
+            # never raise into jax's dispatch path; note the persistent
+            # compilation cache reports cache HITS as negative durations
+            try:
+                if duration < 0:
+                    reg.counter(
+                        "xla_compile_cache_hits_total",
+                        "persistent-cache hits (negative-duration "
+                        "monitoring events)").inc()
+                    return
+                reg.counter("xla_backend_compiles_total",
+                            "XLA backend compilations (jax.monitoring)"
+                            ).inc()
+                reg.counter("xla_backend_compile_seconds_total",
+                            "time spent in XLA compilation").inc(duration)
+            except Exception:
+                pass
+
+        monitoring.register_event_duration_secs_listener(_on_duration)
+        _LISTENER_INSTALLED = True
+        return True
+    except Exception:
+        return False
+
+
+def uninstall_global_listener():
+    """Disarm (the registration itself stays; it becomes a no-op)."""
+    _LISTENER_TARGET[0] = None

@@ -1,0 +1,122 @@
+"""TelemetryManager — one object owning the per-run telemetry state.
+
+Constructed by the engine from the parsed ``telemetry`` config block.
+Rank-0 only (like ``MonitorMaster``): non-zero ranks get the disabled
+manager whose every surface is a no-op, so engine call sites need no rank
+checks. When enabled it:
+
+* installs its ``Tracer`` / ``MetricsRegistry`` as the process globals so
+  library code (``checkpoint_io``, timers) reaches them via
+  ``telemetry.trace_span`` / ``metrics.get_registry`` without plumbing;
+* arms the compile watch (wrapping happens at the engine, which knows its
+  jitted entry points) and the jax.monitoring backend-compile listener;
+* exports the Chrome trace on ``flush()`` (the engine calls it at
+  ``steps_per_print`` cadence) and once more at interpreter exit, so a
+  crashed or un-torn-down run still leaves a readable trace.
+
+File layout under ``<output_path>/``: ``<job>.trace.json`` (Chrome trace),
+``<job>.jsonl`` + ``<job>.prom`` (written by the MonitorMaster sinks which
+share this manager's registry).
+"""
+
+import atexit
+import os
+
+from deepspeed_tpu.telemetry import compile_watch as _cw
+from deepspeed_tpu.telemetry import metrics as _metrics
+from deepspeed_tpu.telemetry import tracer as _tracer_mod
+from deepspeed_tpu.telemetry.metrics import device_memory_stats
+
+
+class TelemetryManager:
+    def __init__(self, config=None, rank=0):
+        self.config = config
+        self.enabled = bool(config is not None
+                            and getattr(config, "enabled", False)
+                            and rank == 0)
+        if not self.enabled:
+            self.tracer = _tracer_mod.Tracer(enabled=False)
+            self.registry = None
+            self.compile_watch = None
+            self.trace_path = None
+            return
+
+        out = config.output_path or "telemetry/"
+        job = config.job_name or "DeepSpeedJobName"
+        os.makedirs(out, exist_ok=True)
+        self.output_path = out
+        self.job_name = job
+        self.trace_path = os.path.join(out, f"{job}.trace.json")
+
+        self.registry = _metrics.MetricsRegistry()
+        _metrics.set_registry(self.registry)
+        self.tracer = _tracer_mod.Tracer(
+            enabled=bool(config.trace),
+            jax_annotations=bool(config.jax_annotations),
+            max_events=int(config.max_trace_events))
+        _tracer_mod.set_tracer(self.tracer)
+        self.compile_watch = (_cw.CompileWatch(self.registry)
+                              if config.compile_watch else None)
+        if config.compile_watch:
+            _cw.install_global_listener(self.registry)
+        self._closed = False
+        self._last_export_t = float("-inf")
+        self._last_export_n = -1
+        atexit.register(self.close)
+
+    # ---------------------------------------------------------------- spans
+    def span(self, name, **args):
+        return self.tracer.span(name, **args)
+
+    def instant(self, name, **args):
+        self.tracer.instant(name, **args)
+
+    # -------------------------------------------------------------- compile
+    def wrap_compiled(self, fn, name):
+        """Compile-watch instrumentation for a jitted entry point; identity
+        when disabled (or fn is None)."""
+        if fn is None or self.compile_watch is None:
+            return fn
+        return self.compile_watch.wrap(fn, name)
+
+    # -------------------------------------------------------------- metrics
+    def publish_device_memory(self):
+        """Gauge the accelerator (or host-RSS fallback) memory stats."""
+        if not self.enabled or not getattr(self.config, "memory_metrics",
+                                           True):
+            return
+        stats = device_memory_stats()
+        src = stats.pop("source", "none")
+        for k, v in stats.items():
+            self.registry.gauge(f"device_memory_{k}",
+                                f"memory stat '{k}' (source: {src})").set(v)
+
+    # ----------------------------------------------------------------- sinks
+    # re-serialising the whole trace buffer is O(events); at print cadence
+    # on a long run that would stall the train thread. Periodic flushes
+    # are therefore throttled (skip if nothing new, at most one export per
+    # interval); close()/atexit force the final complete export.
+    EXPORT_MIN_INTERVAL_S = 5.0
+
+    def flush(self, force=False):
+        if not (self.enabled and self.config.trace):
+            return
+        import time
+        n = self.tracer.event_count()
+        if not force:
+            if n == self._last_export_n:
+                return
+            if time.monotonic() - self._last_export_t < \
+                    self.EXPORT_MIN_INTERVAL_S:
+                return
+        self._last_export_n = n
+        self._last_export_t = time.monotonic()
+        self.tracer.export(self.trace_path)
+
+    def close(self):
+        if not self.enabled or self._closed:
+            return
+        self._closed = True
+        self.flush(force=True)
+        _cw.uninstall_global_listener()
+        atexit.unregister(self.close)

@@ -1,0 +1,214 @@
+"""Metrics registry — counters, gauges, histograms.
+
+Prometheus-shaped data model (a counter only goes up; a histogram is
+cumulative buckets + sum + count) kept deliberately tiny: everything is
+host-side Python floats updated from the train loop at step cadence, so
+there is no contention worth optimising beyond one lock per metric family.
+
+``device_memory_stats`` reads the accelerator's own allocator counters
+(``Device.memory_stats()`` — populated on TPU/GPU backends) and falls back
+to host RSS where the backend reports nothing (CPU), so the device-memory
+gauge is always publishable.
+"""
+
+import threading
+import time
+
+# Prometheus histogram default buckets are latency-in-seconds oriented;
+# step/phase times here are milliseconds, so the default ladder spans
+# 0.1 ms .. 100 s.
+DEFAULT_BUCKETS = (0.1, 0.5, 1, 5, 10, 50, 100, 500, 1000, 5000, 10000,
+                   50000, 100000)
+
+
+def _label_key(labels):
+    return tuple(sorted((labels or {}).items()))
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name, help="", labels=None):
+        self.name = name
+        self.help = help
+        self.labels = dict(labels or {})
+        self._lock = threading.Lock()
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, name, help="", labels=None):
+        super().__init__(name, help, labels)
+        self.value = 0.0
+
+    def inc(self, amount=1.0):
+        if amount < 0:
+            raise ValueError("counters only go up; use a gauge")
+        with self._lock:
+            self.value += amount
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def __init__(self, name, help="", labels=None):
+        super().__init__(name, help, labels)
+        self.value = 0.0
+
+    def set(self, value):
+        with self._lock:
+            self.value = float(value)
+
+    def inc(self, amount=1.0):
+        with self._lock:
+            self.value += amount
+
+    def dec(self, amount=1.0):
+        self.inc(-amount)
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name, help="", labels=None, buckets=DEFAULT_BUCKETS):
+        super().__init__(name, help, labels)
+        self.buckets = tuple(sorted(buckets))
+        self.counts = [0] * (len(self.buckets) + 1)  # +1 for +Inf
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value):
+        value = float(value)
+        with self._lock:
+            self.sum += value
+            self.count += 1
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    self.counts[i] += 1
+                    break
+            else:
+                self.counts[-1] += 1
+
+    def cumulative_counts(self):
+        """Prometheus buckets are cumulative: count of observations <= le."""
+        out, acc = [], 0
+        with self._lock:
+            for c in self.counts:
+                acc += c
+                out.append(acc)
+        return out
+
+
+class MetricsRegistry:
+    """Name+labels -> metric instance. ``get_or_create`` semantics so call
+    sites can be one-liners (``reg.counter("x").inc()``); a kind clash on
+    an existing name raises instead of silently corrupting the series."""
+
+    def __init__(self):
+        self._metrics = {}
+        self._lock = threading.Lock()
+
+    def _get(self, cls, name, help, labels, **kw):
+        key = (name, _label_key(labels))
+        with self._lock:
+            m = self._metrics.get(key)
+            if m is None:
+                m = cls(name, help, labels, **kw)
+                self._metrics[key] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as {m.kind}")
+            return m
+
+    def counter(self, name, help="", labels=None):
+        return self._get(Counter, name, help, labels)
+
+    def gauge(self, name, help="", labels=None):
+        return self._get(Gauge, name, help, labels)
+
+    def histogram(self, name, help="", labels=None, buckets=DEFAULT_BUCKETS):
+        return self._get(Histogram, name, help, labels, buckets=buckets)
+
+    def collect(self):
+        """All metrics, grouped by family name (Prometheus exposition
+        wants one HELP/TYPE header per family)."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        families = {}
+        for m in metrics:
+            families.setdefault(m.name, []).append(m)
+        return families
+
+    def snapshot(self):
+        """Plain-dict dump (JSON-friendly) for bench artifacts."""
+        out = {}
+        for name, ms in self.collect().items():
+            rows = []
+            for m in ms:
+                row = {"labels": m.labels, "kind": m.kind}
+                if isinstance(m, Histogram):
+                    row.update(sum=m.sum, count=m.count,
+                               buckets=dict(zip(
+                                   [str(b) for b in m.buckets] + ["+Inf"],
+                                   m.cumulative_counts())))
+                else:
+                    row["value"] = m.value
+                rows.append(row)
+            out[name] = rows
+        return out
+
+    def clear(self):
+        with self._lock:
+            self._metrics.clear()
+
+
+def device_memory_stats(device=None):
+    """Best-effort memory stats dict.
+
+    TPU/GPU: the backend allocator's ``memory_stats()``
+    (``bytes_in_use``, ``peak_bytes_in_use``, ``bytes_limit`` when
+    present). CPU or unsupported backends: host RSS via psutil, then the
+    stdlib ``resource`` module. Never raises; empty dict worst case."""
+    try:
+        import jax
+        d = device if device is not None else jax.local_devices()[0]
+        stats = d.memory_stats()
+        if stats:
+            keep = {k: v for k, v in stats.items()
+                    if isinstance(v, (int, float))}
+            if keep:
+                keep["source"] = "device"
+                return keep
+    except Exception:
+        pass
+    try:
+        import psutil
+        return {"host_rss_bytes": psutil.Process().memory_info().rss,
+                "source": "host_rss"}
+    except Exception:
+        pass
+    try:
+        import resource
+        # ru_maxrss is KiB on Linux
+        peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+        return {"host_peak_rss_bytes": peak, "source": "host_peak_rss"}
+    except Exception:
+        return {}
+
+
+# Process-global registry, mirroring tracer.py's global: library code
+# records into whichever registry is installed; without telemetry the
+# records land in a registry nobody exports (cheap, not free — call sites
+# are step/checkpoint cadence, never per-element).
+_GLOBAL = MetricsRegistry()
+
+
+def get_registry():
+    return _GLOBAL
+
+
+def set_registry(registry):
+    global _GLOBAL
+    old, _GLOBAL = _GLOBAL, registry
+    return old

@@ -1,0 +1,198 @@
+"""Telemetry sinks — JSONL event writer and Prometheus text exporter.
+
+``JSONLMonitor`` / ``PrometheusMonitor`` speak the monitor-backend
+protocol (``write_scalar(name, value, step)`` + ``flush()`` + ``close()``)
+so ``MonitorMaster`` fans existing ``write_events`` call sites out to them
+unchanged.
+
+Prometheus side: scalars keep their slash-y reference names
+("Train/Samples/train_loss") by living as ONE family
+``deepspeed_scalar{name="..."}`` — the original name goes through label
+escaping instead of being mangled into a metric name. Registry metrics
+(counters/gauges/histograms) render under their own sanitised names. The
+.prom file is the *text-file-collector* pattern: node_exporter (or any
+scraper of textfile directories) picks it up; no HTTP server needed on a
+TPU host.
+"""
+
+import json
+import os
+import re
+import time
+
+from deepspeed_tpu.telemetry.metrics import Histogram, MetricsRegistry
+
+_NAME_OK = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def sanitize_metric_name(name):
+    """Coerce to the Prometheus metric-name charset
+    ``[a-zA-Z_:][a-zA-Z0-9_:]*``."""
+    name = _NAME_OK.sub("_", str(name))
+    if not name or not (name[0].isalpha() or name[0] in "_:"):
+        name = "_" + name
+    return name
+
+
+def escape_label_value(value):
+    r"""Label-value escaping per the exposition format: ``\`` -> ``\\``,
+    ``"`` -> ``\"``, newline -> ``\n``."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def escape_help(text):
+    r"""HELP-line escaping: ``\`` -> ``\\``, newline -> ``\n``."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _fmt_value(v):
+    if v != v:                      # NaN
+        return "NaN"
+    if v in (float("inf"), float("-inf")):
+        return "+Inf" if v > 0 else "-Inf"
+    if float(v).is_integer():
+        return str(int(v))
+    return repr(float(v))
+
+
+def _labels_str(labels, extra=None):
+    items = dict(labels or {})
+    if extra:
+        items.update(extra)
+    if not items:
+        return ""
+    inner = ",".join(
+        f'{sanitize_metric_name(k)}="{escape_label_value(v)}"'
+        for k, v in sorted(items.items()))
+    return "{" + inner + "}"
+
+
+def render_prometheus(registry):
+    """Render *registry* in the Prometheus text exposition format v0.0.4."""
+    lines = []
+    for family, ms in sorted(registry.collect().items()):
+        name = sanitize_metric_name(family)
+        help_text = next((m.help for m in ms if m.help), "")
+        if help_text:
+            lines.append(f"# HELP {name} {escape_help(help_text)}")
+        lines.append(f"# TYPE {name} {ms[0].kind}")
+        for m in ms:
+            if isinstance(m, Histogram):
+                cum = m.cumulative_counts()
+                for le, c in zip([*m.buckets, float("inf")], cum):
+                    lines.append(
+                        f"{name}_bucket"
+                        f"{_labels_str(m.labels, {'le': _fmt_value(float(le))})}"
+                        f" {c}")
+                lines.append(
+                    f"{name}_sum{_labels_str(m.labels)} {_fmt_value(m.sum)}")
+                lines.append(
+                    f"{name}_count{_labels_str(m.labels)} {m.count}")
+            else:
+                lines.append(
+                    f"{name}{_labels_str(m.labels)} {_fmt_value(m.value)}")
+    return "\n".join(lines) + "\n"
+
+
+class JSONLSink:
+    """Append-only structured event log; one JSON object per line."""
+
+    def __init__(self, path):
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self.path = path
+        self._file = open(path, "a")
+
+    def write(self, event_type, **fields):
+        rec = {"ts": round(time.time(), 6), "event": event_type}
+        rec.update(fields)
+        self._file.write(json.dumps(rec, default=repr) + "\n")
+
+    def flush(self):
+        if not self._file.closed:
+            self._file.flush()
+
+    def close(self):
+        if not self._file.closed:
+            self._file.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+class PrometheusSink:
+    """Atomically (re)writes a .prom text file from a registry."""
+
+    def __init__(self, path, registry):
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self.path = path
+        self.registry = registry
+
+    def write(self):
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(render_prometheus(self.registry))
+        os.replace(tmp, self.path)  # scrapers never see a partial file
+        return self.path
+
+    def close(self):
+        self.write()
+
+
+# ------------------------------------------------------------ monitor glue
+
+class JSONLMonitor:
+    """MonitorMaster backend: scalars as JSONL events."""
+
+    def __init__(self, output_path="runs/", job_name="DeepSpeedJobName"):
+        self.sink = JSONLSink(os.path.join(output_path,
+                                           f"{job_name}.jsonl"))
+        self.path = self.sink.path
+
+    def write_scalar(self, name, value, step):
+        self.sink.write("scalar", name=name, value=float(value),
+                        step=int(step))
+
+    def flush(self):
+        self.sink.flush()
+
+    def close(self):
+        self.sink.close()
+
+
+class PrometheusMonitor:
+    """MonitorMaster backend: scalars as one labelled gauge family,
+    flushed to a text-format file the registry's other metrics share."""
+
+    SCALAR_FAMILY = "deepspeed_scalar"
+
+    def __init__(self, output_path="runs/", job_name="DeepSpeedJobName",
+                 registry=None, path=None):
+        self.registry = registry if registry is not None \
+            else MetricsRegistry()
+        self.sink = PrometheusSink(
+            path or os.path.join(output_path, f"{job_name}.prom"),
+            self.registry)
+        self.path = self.sink.path
+
+    def write_scalar(self, name, value, step):
+        self.registry.gauge(self.SCALAR_FAMILY,
+                            "monitor scalars (reference names as labels)",
+                            labels={"name": str(name)}).set(value)
+        self.registry.gauge("deepspeed_scalar_step",
+                            "last step at which the scalar was written",
+                            labels={"name": str(name)}).set(step)
+
+    def flush(self):
+        self.sink.write()
+
+    def close(self):
+        self.sink.write()

@@ -72,13 +72,24 @@ class SynchronizedWallClockTimer:
             self.started_ = True
 
         def stop(self, reset=False, record=False):
+            """``record=True`` additionally observes this start->stop
+            interval into the telemetry metrics registry (histogram
+            ``timer_<name>_ms``) — the reference's dead parameter, given
+            the recording semantics its name promises."""
             assert self.started_, "timer is not started"
             _device_synchronize()
+            interval = time.time() - self.start_time
             if reset:
-                self.elapsed_ = time.time() - self.start_time
+                self.elapsed_ = interval
             else:
-                self.elapsed_ += time.time() - self.start_time
+                self.elapsed_ += interval
             self.started_ = False
+            if record:
+                from deepspeed_tpu.telemetry.metrics import get_registry
+                get_registry().histogram(
+                    f"timer_{self.name_}_ms",
+                    "SynchronizedWallClockTimer recorded intervals"
+                ).observe(interval * 1000.0)
 
         def reset(self):
             self.elapsed_ = 0.0
@@ -189,17 +200,23 @@ class ThroughputTimer:
 
             if global_step:
                 if report_speed and self.global_step_count % self.steps_per_output == 0:
+                    # clock-resolution zero (or an all-warmup window) must
+                    # not crash the log line
+                    curr = (self.batch_size / self.step_elapsed_time
+                            if self.step_elapsed_time > 0 else 0.0)
                     self.logging(
                         "epoch={}/micro_step={}/global_step={}, RunningAvgSamplesPerSec={}, "
                         "CurrSamplesPerSec={}".format(
                             self.epoch_count, self.micro_step_count, self.global_step_count,
-                            self.avg_samples_per_sec(),
-                            self.batch_size / self.step_elapsed_time))
+                            self.avg_samples_per_sec(), curr))
                 self.step_elapsed_time = 0
 
     def avg_samples_per_sec(self):
-        if self.global_step_count > 0:
+        """0.0 before any timed step (warmup: the first ``start_step``
+        steps are untimed) — not the reference's ``-inf``, which poisoned
+        every consumer that averaged or formatted it."""
+        if self.total_elapsed_time > 0:
             total_step_offset = self.global_step_count - self.start_step
             avg_time_per_step = self.total_elapsed_time / max(1, total_step_offset)
             return self.batch_size / avg_time_per_step
-        return float("-inf")
+        return 0.0

@@ -1,0 +1,51 @@
+"""Tracer-overhead microbenchmark (telemetry/tracer.py).
+
+Asserts the DISABLED ``trace_span`` path — the one every engine step pays
+whether or not telemetry is configured — costs < 2 µs/span, and reports
+the enabled-path cost for reference.
+
+Run manually:  python tests/perf/telemetry_overhead.py [iters] — not
+collected by pytest (no test_ prefix), like the other perf scripts here.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+DISABLED_BUDGET_US = 2.0
+
+
+def _per_span_us(tracer, iters):
+    span = tracer.span   # what a hot loop would hold
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        with span("bench"):
+            pass
+    return (time.perf_counter() - t0) / iters * 1e6
+
+
+def main(iters=200_000):
+    from deepspeed_tpu.telemetry import Tracer
+
+    disabled = Tracer(enabled=False)
+    # warm up, then best-of-3 (one-shot timings jitter with the GC)
+    _per_span_us(disabled, 1000)
+    disabled_us = min(_per_span_us(disabled, iters) for _ in range(3))
+
+    enabled = Tracer(enabled=True, max_events=iters * 3 + 10_000)
+    _per_span_us(enabled, 1000)
+    enabled_us = min(_per_span_us(enabled, iters) for _ in range(3))
+
+    print(f"disabled trace_span: {disabled_us:.3f} us/span "
+          f"(budget {DISABLED_BUDGET_US} us)")
+    print(f"enabled  trace_span: {enabled_us:.3f} us/span")
+    assert disabled_us < DISABLED_BUDGET_US, (
+        f"disabled tracer overhead {disabled_us:.3f} us/span exceeds the "
+        f"{DISABLED_BUDGET_US} us budget — the no-op path regressed")
+    print("OK")
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 200_000)

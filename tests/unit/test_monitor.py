@@ -1,0 +1,127 @@
+"""Monitor backends: CSV fallback, teardown, and the telemetry sinks as
+MonitorMaster backends (the ``write_events`` fan-out surface).
+"""
+
+import csv
+import json
+import types
+
+import pytest
+
+from deepspeed_tpu.monitor import monitor as monitor_mod
+from deepspeed_tpu.monitor.monitor import CSVMonitor, MonitorMaster
+
+
+def _tb_config(tmp_path, enabled=True):
+    return types.SimpleNamespace(enabled=enabled,
+                                 output_path=str(tmp_path),
+                                 job_name="job")
+
+
+def _tel_config(tmp_path, enabled=True, jsonl=True, prometheus=True):
+    return types.SimpleNamespace(enabled=enabled,
+                                 output_path=str(tmp_path),
+                                 job_name="job", jsonl=jsonl,
+                                 prometheus=prometheus)
+
+
+class TestCSVMonitor:
+    def test_write_flush_close(self, tmp_path):
+        m = CSVMonitor(str(tmp_path), "job")
+        m.write_scalar("loss", 1.5, 10)
+        m.flush()
+        rows = list(csv.reader(open(m.path)))
+        assert rows == [["step", "name", "value"], ["10", "loss", "1.5"]]
+        m.close()
+        assert m._file.closed
+        m.close()   # idempotent
+        m.flush()   # no-op after close, must not raise
+
+    def test_context_manager_closes(self, tmp_path):
+        with CSVMonitor(str(tmp_path), "job") as m:
+            m.write_scalar("x", 2.0, 1)
+        assert m._file.closed
+        assert len(list(csv.reader(open(m.path)))) == 2
+
+    def test_append_mode_keeps_single_header(self, tmp_path):
+        with CSVMonitor(str(tmp_path), "job") as m:
+            m.write_scalar("a", 1.0, 1)
+        with CSVMonitor(str(tmp_path), "job") as m:
+            m.write_scalar("b", 2.0, 2)
+        rows = list(csv.reader(open(m.path)))
+        assert rows[0] == ["step", "name", "value"]
+        assert len(rows) == 3
+
+
+class TestMonitorMaster:
+    def test_csv_fallback_when_tensorboard_unavailable(self, tmp_path,
+                                                       monkeypatch):
+        def boom(*a, **k):
+            raise ImportError("no tensorboard")
+
+        monkeypatch.setattr(monitor_mod, "TensorBoardMonitor", boom)
+        master = MonitorMaster(_tb_config(tmp_path), rank=0)
+        assert len(master.monitors) == 1
+        assert isinstance(master.monitors[0], CSVMonitor)
+        master.write_events([("Train/loss", 0.5, 1)])
+        rows = list(csv.reader(open(tmp_path / "job.csv")))
+        assert rows[1] == ["1", "Train/loss", "0.5"]
+        master.close()
+        assert master.monitors[0]._file.closed
+
+    def test_nonzero_rank_disabled(self, tmp_path):
+        master = MonitorMaster(_tb_config(tmp_path), rank=1,
+                               telemetry_config=_tel_config(tmp_path))
+        assert not master.enabled and master.monitors == []
+        master.write_events([("x", 1, 1)])   # no-op, no files
+        master.close()
+        assert list(tmp_path.iterdir()) == []
+
+    def test_jsonl_backend(self, tmp_path):
+        master = MonitorMaster(
+            None, rank=0,
+            telemetry_config=_tel_config(tmp_path, prometheus=False))
+        master.write_events([("Train/loss", 0.25, 3),
+                             ("Train/lr", 1e-3, 3)])
+        master.close()
+        recs = [json.loads(line) for line in open(tmp_path / "job.jsonl")]
+        assert [(r["name"], r["value"], r["step"]) for r in recs] == \
+            [("Train/loss", 0.25, 3), ("Train/lr", 0.001, 3)]
+        assert all(r["event"] == "scalar" and "ts" in r for r in recs)
+
+    def test_prometheus_backend(self, tmp_path):
+        master = MonitorMaster(
+            None, rank=0,
+            telemetry_config=_tel_config(tmp_path, jsonl=False))
+        master.write_events([("Train/loss", 0.25, 3)])
+        prom = open(tmp_path / "job.prom").read()
+        assert 'deepspeed_scalar{name="Train/loss"} 0.25' in prom
+        assert 'deepspeed_scalar_step{name="Train/loss"} 3' in prom
+        master.close()
+
+    def test_write_events_fans_out_to_all_backends(self, tmp_path,
+                                                   monkeypatch):
+        monkeypatch.setattr(
+            monitor_mod, "TensorBoardMonitor",
+            lambda *a, **k: (_ for _ in ()).throw(ImportError()))
+        master = MonitorMaster(_tb_config(tmp_path), rank=0,
+                               telemetry_config=_tel_config(tmp_path))
+        assert len(master.monitors) == 3   # csv + jsonl + prometheus
+        master.write_events([("m", 1.0, 1)])
+        master.close()
+        assert (tmp_path / "job.csv").exists()
+        assert (tmp_path / "job.jsonl").exists()
+        assert (tmp_path / "job.prom").exists()
+
+    def test_close_survives_backend_failure(self, tmp_path):
+        master = MonitorMaster(
+            None, rank=0,
+            telemetry_config=_tel_config(tmp_path, prometheus=False))
+
+        class Exploding:
+            def close(self):
+                raise RuntimeError("boom")
+
+        master.monitors.append(Exploding())
+        master.close()   # must not raise; the jsonl backend still closes
+        assert master.monitors[0].sink._file.closed

@@ -1,0 +1,363 @@
+"""Telemetry subsystem: tracer, compile watch, metrics, sinks, engine glue.
+
+Covers the acceptance criteria: valid Chrome-trace JSON from a real
+training run (plus JSONL + Prometheus files), NO files when disabled,
+and exactly one compile-watch warning on a forced retrace naming the
+function and the differing aval.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.simple import SimpleModel, random_dataloader, \
+    sample_batch
+from deepspeed_tpu.telemetry import (CompileWatch, MetricsRegistry, Tracer,
+                                     device_memory_stats, render_prometheus,
+                                     trace_span)
+
+
+# ------------------------------------------------------------------- tracer
+
+class TestTracer:
+    def test_span_nesting_and_chrome_json(self, tmp_path):
+        tr = Tracer(enabled=True)
+        with tr.span("outer", step=1):
+            with tr.span("inner"):
+                pass
+        path = str(tmp_path / "t.trace.json")
+        tr.export(path)
+        doc = json.load(open(path))          # must be valid JSON
+        evs = doc["traceEvents"]
+        assert len(evs) == 2
+        for ev in evs:
+            assert ev["ph"] == "X"
+            assert isinstance(ev["ts"], int)
+            assert isinstance(ev["dur"], int)
+        by_name = {e["name"]: e for e in evs}
+        outer, inner = by_name["outer"], by_name["inner"]
+        # nested span is contained within its parent's [ts, ts+dur]
+        assert outer["ts"] <= inner["ts"]
+        assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"]
+        assert outer["args"] == {"step": 1}
+
+    def test_disabled_tracer_records_nothing(self):
+        tr = Tracer(enabled=False)
+        with tr.span("x"):
+            pass
+        assert tr.events() == []
+        # and the shared no-op span is reused (no per-call allocation)
+        assert tr.span("a") is tr.span("b")
+
+    def test_global_trace_span_default_disabled(self):
+        from deepspeed_tpu.telemetry import get_tracer
+        with trace_span("anything"):
+            pass
+        assert not get_tracer().enabled
+
+    def test_buffer_cap_drops_and_reports(self):
+        tr = Tracer(enabled=True, max_events=3)
+        for i in range(5):
+            with tr.span(f"s{i}"):
+                pass
+        assert len(tr.events()) == 3
+        assert tr.dropped == 2
+
+    def test_instant_event(self):
+        tr = Tracer(enabled=True)
+        tr.instant("marker", k="v")
+        (ev,) = tr.events()
+        assert ev["ph"] == "i" and ev["args"] == {"k": "v"}
+
+
+# ------------------------------------------------------------ compile watch
+
+class TestCompileWatch:
+    def test_retrace_detection_on_shape_change(self):
+        logs = []
+        watch = CompileWatch(registry=MetricsRegistry(),
+                             log_fn=logs.append)
+        f = watch.wrap(jax.jit(lambda x: x * 2), name="double")
+        f(jnp.zeros((4, 8), jnp.float32))
+        f(jnp.ones((4, 8), jnp.float32))     # same signature: quiet
+        assert watch.compiles == 1 and watch.retraces == 0 and not logs
+        f(jnp.zeros((4, 16), jnp.float32))   # new shape: ONE warning
+        assert watch.retraces == 1
+        assert len(logs) == 1
+        # the culprit report names the fn and both avals
+        assert "double" in logs[0]
+        assert "f32[4,8]" in logs[0] and "f32[4,16]" in logs[0]
+        f(jnp.zeros((4, 16), jnp.float32))   # seen signature: quiet again
+        assert len(logs) == 1 and watch.compiles == 2
+
+    def test_dtype_change_detected(self):
+        logs = []
+        watch = CompileWatch(registry=MetricsRegistry(),
+                             log_fn=logs.append)
+        f = watch.wrap(jax.jit(lambda x: x + 1), name="incr")
+        f(jnp.zeros((2,), jnp.float32))
+        f(jnp.zeros((2,), jnp.bfloat16))
+        assert watch.retraces == 1
+        assert "f32[2]" in logs[0] and "bf16[2]" in logs[0]
+
+    def test_counters_move_in_registry(self):
+        reg = MetricsRegistry()
+        watch = CompileWatch(registry=reg, log_fn=lambda m: None)
+        f = watch.wrap(jax.jit(lambda x: x), name="ident")
+        f(jnp.zeros((1,)))
+        f(jnp.zeros((2,)))
+        snap = reg.snapshot()
+        assert snap["xla_compiles_total"][0]["value"] == 2
+        assert snap["xla_retraces_total"][0]["value"] == 1
+
+    def test_tree_argument_path_in_report(self):
+        logs = []
+        watch = CompileWatch(registry=MetricsRegistry(),
+                             log_fn=logs.append)
+        f = watch.wrap(jax.jit(lambda b: b["ids"].sum()), name="treefn")
+        f({"ids": jnp.zeros((8, 128), jnp.int32)})
+        f({"ids": jnp.zeros((8, 256), jnp.int32)})
+        assert len(logs) == 1
+        assert "ids" in logs[0]
+        assert "i32[8,128]" in logs[0] and "i32[8,256]" in logs[0]
+
+
+# ----------------------------------------------------------------- metrics
+
+class TestMetrics:
+    def test_counter_gauge_histogram(self):
+        reg = MetricsRegistry()
+        reg.counter("c").inc()
+        reg.counter("c").inc(2)
+        reg.gauge("g").set(7.5)
+        h = reg.histogram("h", buckets=(1, 10))
+        h.observe(0.5)
+        h.observe(5)
+        h.observe(100)
+        snap = reg.snapshot()
+        assert snap["c"][0]["value"] == 3
+        assert snap["g"][0]["value"] == 7.5
+        assert snap["h"][0]["count"] == 3
+        assert snap["h"][0]["buckets"] == {"1": 1, "10": 2, "+Inf": 3}
+
+    def test_counter_rejects_negative(self):
+        with pytest.raises(ValueError):
+            MetricsRegistry().counter("c").inc(-1)
+
+    def test_kind_clash_raises(self):
+        reg = MetricsRegistry()
+        reg.counter("m")
+        with pytest.raises(TypeError):
+            reg.gauge("m")
+
+    def test_labels_are_distinct_series(self):
+        reg = MetricsRegistry()
+        reg.counter("c", labels={"fn": "a"}).inc()
+        reg.counter("c", labels={"fn": "b"}).inc(5)
+        vals = {tuple(r["labels"].items()): r["value"]
+                for r in reg.snapshot()["c"]}
+        assert vals == {(("fn", "a"),): 1, (("fn", "b"),): 5}
+
+    def test_device_memory_stats_never_empty_source(self):
+        stats = device_memory_stats()
+        # CPU backend: host RSS fallback must kick in
+        assert stats and stats.get("source") in ("device", "host_rss",
+                                                 "host_peak_rss")
+
+
+# ------------------------------------------------------------- prometheus
+
+class TestPrometheusRender:
+    def test_label_value_escaping(self):
+        reg = MetricsRegistry()
+        weird = 'quote " backslash \\ newline \n end'
+        reg.gauge("deepspeed_scalar", labels={"name": weird}).set(1)
+        out = render_prometheus(reg)
+        assert ('deepspeed_scalar{name="quote \\" backslash \\\\ '
+                'newline \\n end"} 1') in out
+
+    def test_help_escaping_and_name_sanitization(self):
+        reg = MetricsRegistry()
+        reg.gauge("Train/Samples per-sec", "line1\nline2 \\ done").set(2)
+        out = render_prometheus(reg)
+        assert "# HELP Train_Samples_per_sec line1\\nline2 \\\\ done" in out
+        assert "Train_Samples_per_sec 2" in out
+
+    def test_histogram_exposition(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat_ms", buckets=(1, 5))
+        h.observe(0.3)
+        h.observe(3)
+        out = render_prometheus(reg)
+        assert 'lat_ms_bucket{le="1"} 1' in out
+        assert 'lat_ms_bucket{le="5"} 2' in out
+        assert 'lat_ms_bucket{le="+Inf"} 2' in out
+        assert "lat_ms_sum 3.3" in out
+        assert "lat_ms_count 2" in out
+
+    def test_special_float_values(self):
+        reg = MetricsRegistry()
+        reg.gauge("inf_g").set(float("inf"))
+        reg.gauge("nan_g").set(float("nan"))
+        out = render_prometheus(reg)
+        assert "inf_g +Inf" in out
+        assert "nan_g NaN" in out
+
+
+# ----------------------------------------------------------- engine glue
+
+def _engine_config(tmp_path, enabled=True, **over):
+    cfg = {
+        "train_batch_size": 16,
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "steps_per_print": 2,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "telemetry": {"enabled": enabled, "output_path": str(tmp_path),
+                      "job_name": "testrun"},
+    }
+    cfg.update(over)
+    return cfg
+
+
+def _run_engine(tmp_path, steps=4, **over):
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=32, nlayers=2),
+        config=_engine_config(tmp_path, **over),
+        sample_batch=sample_batch(2, 32), seed=42)
+    loader = random_dataloader(engine, total_samples=64,
+                               hidden_dim=32, seed=0)
+    it = iter(loader)
+    for _ in range(steps):
+        engine.train_batch(data_iter=it)
+    return engine
+
+
+class TestEngineTelemetry:
+    def test_enabled_run_produces_all_artifacts(self, tmp_path):
+        engine = _run_engine(tmp_path)
+        engine.telemetry.close()   # forced final export
+        engine.monitor.close()
+
+        # chrome trace: valid JSON, X events with ph/ts/dur
+        doc = json.load(open(tmp_path / "testrun.trace.json"))
+        evs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert evs
+        names = {e["name"] for e in evs}
+        assert "train_batch" in names
+        assert "engine/init_state" in names
+        for ev in evs:
+            assert "ts" in ev and "dur" in ev
+
+        # JSONL event log: every line parses, scalar events carry
+        # name/value/step
+        lines = [json.loads(line)
+                 for line in open(tmp_path / "testrun.jsonl")]
+        assert lines
+        scalars = [r for r in lines if r["event"] == "scalar"]
+        assert {"Train/Samples/train_loss", "Train/Samples/lr"} <= \
+            {r["name"] for r in scalars}
+
+        # prometheus text file: engine metrics present
+        prom = open(tmp_path / "testrun.prom").read()
+        assert "train_steps_total 4" in prom
+        assert "train_step_time_ms_bucket" in prom
+        assert 'xla_compiles_total{fn="fused_train_step"} 1' in prom
+
+    def test_disabled_writes_no_files(self, tmp_path):
+        engine = _run_engine(tmp_path, enabled=False)
+        assert engine.telemetry.enabled is False
+        assert list(tmp_path.iterdir()) == []
+        # fused fast path untouched, monitor has no telemetry backends
+        assert engine.monitor.monitors == []
+
+    def test_checkpoint_io_bytes_counted(self, tmp_path):
+        engine = _run_engine(tmp_path, steps=2)
+        ckpt_dir = tmp_path / "ckpt"
+        engine.save_checkpoint(str(ckpt_dir))
+        snap = engine.telemetry.registry.snapshot()
+        written = {tuple(r["labels"].items()): r["value"]
+                   for r in snap["checkpoint_write_bytes_total"]}
+        assert written[(("kind", "model_states"),)] > 0
+        assert written[(("kind", "zero_states"),)] > 0
+        engine.load_checkpoint(str(ckpt_dir))
+        assert "checkpoint_read_bytes_total" in snap or \
+            "checkpoint_read_bytes_total" in \
+            engine.telemetry.registry.snapshot()
+        names = {e["name"] for e in engine.telemetry.tracer.events()}
+        assert "checkpoint/save" in names
+        assert "checkpoint/load" in names
+
+    def test_retrace_warning_through_engine_eval(self, tmp_path, caplog):
+        import logging
+        engine = _run_engine(tmp_path, steps=1)
+        records = []
+
+        class _Capture(logging.Handler):
+            def emit(self, record):
+                records.append(record.getMessage())
+
+        ds_logger = logging.getLogger("DeepSpeedTPU")
+        handler = _Capture()
+        ds_logger.addHandler(handler)
+        try:
+            engine.eval_batch(sample_batch(8, 32))
+            engine.eval_batch(sample_batch(16, 32))  # new shape: retrace
+        finally:
+            ds_logger.removeHandler(handler)
+        warnings = [m for m in records if "[compile-watch]" in m]
+        assert len(warnings) == 1
+        assert "eval_step" in warnings[0]
+
+    def test_lower_train_step_still_reachable(self, tmp_path):
+        # compile-watch wrapping must not hide the AOT .lower surface
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=SimpleModel(hidden_dim=32, nlayers=2),
+            config=_engine_config(tmp_path),
+            sample_batch=sample_batch(2, 32), seed=42,
+            abstract_init=True)
+        # lowering wants the GLOBAL micro-batch (16 rows over data=8)
+        lowered = engine.lower_train_step(sample_batch(16, 32))
+        assert lowered is not None
+
+
+class TestTimerSatellites:
+    def test_avg_samples_per_sec_zero_before_warmup(self):
+        from deepspeed_tpu.utils.timer import ThroughputTimer
+        t = ThroughputTimer(batch_size=8, start_step=2)
+        assert t.avg_samples_per_sec() == 0.0
+        t.start()
+        t.stop(global_step=True)   # step 1: still inside warmup
+        assert t.avg_samples_per_sec() == 0.0
+
+    def test_steps_per_output_log_survives_zero_elapsed(self, monkeypatch):
+        from deepspeed_tpu.utils import timer as timer_mod
+        logged = []
+        t = timer_mod.ThroughputTimer(batch_size=8, start_step=0,
+                                      steps_per_output=1,
+                                      logging_fn=logged.append)
+        # freeze the clock: the timed step measures exactly 0.0 s
+        monkeypatch.setattr(timer_mod.time, "time", lambda: 123.0)
+        t.start()
+        t.stop(global_step=True)
+        assert logged, "report line must still be emitted"
+        assert "CurrSamplesPerSec=0.0" in logged[0]
+
+    def test_timer_stop_record_observes_histogram(self):
+        from deepspeed_tpu.telemetry import metrics as m
+        from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer
+        reg = m.MetricsRegistry()
+        old = m.set_registry(reg)
+        try:
+            timers = SynchronizedWallClockTimer()
+            timers("phase").start()
+            timers("phase").stop(record=True)
+        finally:
+            m.set_registry(old)
+        snap = reg.snapshot()
+        assert snap["timer_phase_ms"][0]["count"] == 1
