@@ -277,7 +277,11 @@ def main():
         "telemetry": {"enabled": telemetry_on,
                       "output_path": telemetry_dir,
                       "job_name": f"bench_{name}",
-                      "jsonl": False, "prometheus": False},
+                      "jsonl": False, "prometheus": False,
+                      # own the compiled step artifact (AOT dispatch) so
+                      # the post-bench census/MFU cross-check reads the
+                      # program that actually ran — zero extra compiles
+                      "cost_explorer": {"enabled": True}},
     }
     if layered:
         # beyond-HBM training: params streamed from host RAM layer by
@@ -472,6 +476,43 @@ def main():
     n_chips = jax.device_count()
     tflops_per_chip = tflops / n_chips
 
+    # ---- XLA cross-check (telemetry/cost_explorer.py): the analytic
+    # flops formula above has per-model adjustments (MoE, sparse, masked
+    # MLM) that can silently go stale as models evolve. The compiler's
+    # own count of the program that JUST RAN is the ground truth; emit
+    # the ratio and warn loudly when they disagree by > 10%.
+    mfu_xla = flops_ratio = None
+    explain = None
+    # telemetry_on gate: without it the engine owns no compiled artifact
+    # and explain_step would pay a full duplicate compile of the
+    # bench-scale program just for the cross-check
+    if not layered and telemetry_on and hasattr(engine, "explain_step"):
+        try:
+            explain = engine.explain_step(step_time_s=med_step_ms / 1e3)
+            xla_flops_per_chip = explain["flops_per_step_per_device"]
+            analytic_per_chip = (flops_per_token * batch_size * seq_len
+                                 / n_chips)
+            if xla_flops_per_chip and analytic_per_chip:
+                flops_ratio = xla_flops_per_chip / analytic_per_chip
+                if abs(flops_ratio - 1.0) > 0.10:
+                    print(f"# WARNING: analytic flops formula disagrees "
+                          f"with XLA by {(flops_ratio - 1) * 100:+.1f}% "
+                          f"(xla/analytic = {flops_ratio:.3f}) — the "
+                          f"per-model adjustments in bench.py may be "
+                          f"stale for {name!r}", flush=True)
+            if explain.get("mfu") is None and explain.get(
+                    "flops_per_step_per_device"):
+                # CPU/unknown chip: no peak in the table — derive MFU
+                # from the XLA count against BENCH_PEAK_TFLOPS anyway.
+                # Significant figures, not fixed decimals: CPU-scale MFU
+                # (~1e-5) would round(x, 4) to a flat 0.0
+                mfu_xla = float(f"{xla_flops_per_chip / (med_step_ms / 1e3) / 1e12 / peak_tflops:.4g}")
+            else:
+                mfu_xla = explain.get("mfu")
+        except Exception as e:  # the cross-check must never sink a bench
+            print(f"# cost-explorer cross-check unavailable: {e}",
+                  flush=True)
+
     print(json.dumps({
         "metric": f"{name} train TFLOPS/chip "
                   f"(bs={batch_size} seq={seq_len} bf16 "
@@ -482,6 +523,12 @@ def main():
         "unit": "TFLOPS/chip",
         "vs_baseline": round(tflops_per_chip / REFERENCE_TFLOPS_PER_GPU, 3),
         "mfu": round(tflops_per_chip / peak_tflops, 4),
+        # XLA-census cross-checks (None when the explorer was unavailable):
+        # mfu_xla uses the compiler's flop count of the program that ran;
+        # flops_xla_vs_analytic near 1.0 validates the analytic formula
+        "mfu_xla": mfu_xla,
+        "flops_xla_vs_analytic": (round(flops_ratio, 4)
+                                  if flops_ratio else None),
         "step_time_ms": round(med_step_ms, 1),
         "tokens_per_s": round(tokens_per_s, 1),
         # evidence that the number is steady state, not a lucky (or poisoned)
@@ -511,6 +558,9 @@ def main():
             "sinks": {type(m).__name__: getattr(m, "path", None)
                       for m in engine.monitor.monitors},
             "metrics": tel.registry.snapshot(),
+            # full cost-explorer report (roofline, bound-ness verdict,
+            # per-axis collective bytes, HBM watermark) for this run
+            "explain": explain,
         }
         with open(os.path.join(bench_dir, "TELEMETRY_BENCH.json"), "w") as f:
             json.dump(summary, f, indent=2, default=repr)

@@ -26,13 +26,16 @@ def _count_params(params) -> int:
 
 
 def analyze_fn(fn: Callable, *args, static_argnums=()) -> dict:
-    """Compile fn(*args) and return XLA's cost analysis (flops, bytes)."""
-    lowered = jax.jit(fn, static_argnums=static_argnums).lower(*args)
-    compiled = lowered.compile()
-    costs = compiled.cost_analysis()
-    if isinstance(costs, (list, tuple)):  # older jax returns [dict]
-        costs = costs[0] if costs else {}
-    return dict(costs or {})
+    """Compile fn(*args) and return XLA's cost analysis (flops, bytes).
+
+    Compile-from-scratch fallback for model-only profiling
+    (``get_model_profile``): when an engine is attached, ``start_profile``
+    reads the engine's ALREADY-compiled artifact through
+    ``engine.get_cost_census()`` instead — zero duplicate compiles."""
+    from deepspeed_tpu.telemetry.hlo_census import census_fn
+    census = census_fn(fn, *args, static_argnums=static_argnums)
+    return {"flops": census.flops, "bytes accessed": census.bytes_accessed,
+            "transcendentals": census.transcendentals}
 
 
 class FlopsProfiler:
@@ -65,11 +68,12 @@ class FlopsProfiler:
             self._params = _count_params(state.params)
             batch = getattr(self.ds_engine, "_last_batch", None)
             if batch is not None:
-                costs = analyze_fn(
-                    self.ds_engine._jit_micro, state, batch,
-                    jax.random.PRNGKey(0), jnp.float32(1.0))
-                self._flops = costs.get("flops", 0.0)
-                self._bytes = costs.get("bytes accessed", 0.0)
+                # the engine's own compiled step artifact (zero-compile
+                # when telemetry.cost_explorer owns it; one memoized AOT
+                # compile otherwise — NOT the old always-recompile)
+                census = self.ds_engine.get_cost_census(batch=batch)
+                self._flops = census.flops
+                self._bytes = census.bytes_accessed
                 # per-module attribution from the SAME traced step
                 from deepspeed_tpu.profiling.flops_profiler.module_profile \
                     import (profile_durations_by_scope,

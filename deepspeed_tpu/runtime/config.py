@@ -114,12 +114,36 @@ class DeepSpeedTelemetryConfig(DeepSpeedConfigObject):
                                     C.TELEMETRY_MEMORY_METRICS_DEFAULT)
         self.max_trace_events = t.get(C.TELEMETRY_MAX_TRACE_EVENTS,
                                       C.TELEMETRY_MAX_TRACE_EVENTS_DEFAULT)
+        # cost_explorer sub-block (telemetry/cost_explorer.py): compiled-
+        # program census + roofline/MFU + HBM pre-flight. Flattened onto
+        # cost_explorer_* attributes; 0 peaks mean "detect from the chip".
+        ce = t.get(C.COST_EXPLORER, {}) or {}
+        self.cost_explorer_enabled = ce.get(C.COST_EXPLORER_ENABLED,
+                                            C.COST_EXPLORER_ENABLED_DEFAULT)
+        self.cost_explorer_peak_tflops = ce.get(
+            C.COST_EXPLORER_PEAK_TFLOPS, C.COST_EXPLORER_PEAK_TFLOPS_DEFAULT)
+        self.cost_explorer_peak_hbm_gbps = ce.get(
+            C.COST_EXPLORER_PEAK_HBM_GBPS,
+            C.COST_EXPLORER_PEAK_HBM_GBPS_DEFAULT)
+        self.cost_explorer_ici_gbps = ce.get(
+            C.COST_EXPLORER_ICI_GBPS, C.COST_EXPLORER_ICI_GBPS_DEFAULT)
+        self.cost_explorer_hbm_gb = ce.get(C.COST_EXPLORER_HBM_GB,
+                                           C.COST_EXPLORER_HBM_GB_DEFAULT)
+        self.cost_explorer_preflight = ce.get(
+            C.COST_EXPLORER_PREFLIGHT, C.COST_EXPLORER_PREFLIGHT_DEFAULT)
+        self.cost_explorer_preflight_threshold = ce.get(
+            C.COST_EXPLORER_PREFLIGHT_THRESHOLD,
+            C.COST_EXPLORER_PREFLIGHT_THRESHOLD_DEFAULT)
         env = os.environ.get("DS_TELEMETRY")
         if env is not None:
             self.enabled = env.lower() in ("1", "true", "yes", "on")
         env_dir = os.environ.get("DS_TELEMETRY_DIR")
         if env_dir:
             self.output_path = env_dir
+        env_ce = os.environ.get("DS_COST_EXPLORER")
+        if env_ce is not None:
+            self.cost_explorer_enabled = env_ce.lower() in (
+                "1", "true", "yes", "on")
 
 
 class DeepSpeedFlopsProfilerConfig(DeepSpeedConfigObject):

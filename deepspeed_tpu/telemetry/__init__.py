@@ -7,7 +7,13 @@ Four pieces (see the per-module docstrings):
 * ``compile_watch`` — XLA compile counting + retrace culprit reports;
 * ``metrics`` — counters / gauges / histograms + device-memory stats;
 * ``sinks`` — JSONL event writer and Prometheus text-format exporter
-  (both also usable as ``MonitorMaster`` backends).
+  (both also usable as ``MonitorMaster`` backends);
+* ``hlo_census`` — structured census of a compiled XLA program: cost /
+  memory analysis + a real HLO parser for per-collective byte volumes
+  and mesh-axis attribution;
+* ``cost_explorer`` — joins the census with runtime timings: roofline /
+  MFU attribution, bound-ness verdicts, HBM watermark pre-flight
+  (``python -m deepspeed_tpu.telemetry.explain`` is the CLI).
 
 ``TelemetryManager`` (manager.py) wires them per engine run, behind the
 ``telemetry`` config block (see CONFIG.md). Everything is importable and
@@ -26,6 +32,11 @@ from deepspeed_tpu.telemetry.sinks import (JSONLMonitor, JSONLSink,
                                            PrometheusMonitor,
                                            PrometheusSink,
                                            render_prometheus)
+from deepspeed_tpu.telemetry.hlo_census import (CollectiveOp, HloCensus,
+                                                census_compiled, census_fn,
+                                                parse_hlo_collectives,
+                                                parse_replica_groups)
+from deepspeed_tpu.telemetry.cost_explorer import CostExplorer, detect_chip
 from deepspeed_tpu.telemetry.manager import TelemetryManager
 
 __all__ = [
@@ -34,4 +45,7 @@ __all__ = [
     "device_memory_stats", "get_registry", "set_registry",
     "CompileWatch", "JSONLMonitor", "JSONLSink", "PrometheusMonitor",
     "PrometheusSink", "render_prometheus", "TelemetryManager",
+    "CollectiveOp", "HloCensus", "census_compiled", "census_fn",
+    "parse_hlo_collectives", "parse_replica_groups",
+    "CostExplorer", "detect_chip",
 ]

@@ -1,0 +1,257 @@
+"""CostExplorer — join the static HLO census with runtime step timing.
+
+Answers the three questions the runtime telemetry (PR 1) alone cannot:
+
+* **how fast is fast?** achieved TFLOPS vs the chip's roofline and MFU
+  against a configurable peak;
+* **what is the step bound by?** compute / memory / comm verdict from
+  the census's flops, bytes-accessed and per-axis collective wire bytes
+  against the chip's peak flops, HBM bandwidth and ICI bandwidth;
+* **will it fit?** HBM watermark pre-flight (census argument + output -
+  alias + temp bytes vs device HBM) BEFORE the first step executes.
+
+The explorer is pure host-side arithmetic over an ``HloCensus`` — it
+never touches the device, never compiles, and publishes its numbers as
+gauges in the PR-1 metrics registry so the JSONL/Prometheus sinks carry
+``model_flops_per_step``, ``hbm_watermark_bytes`` and
+``collective_bytes{axes=...}`` with zero extra wiring.
+
+Chip peaks: looked up from ``jax.devices()[0].device_kind`` for known
+TPUs, overridable via the ``telemetry.cost_explorer`` config block
+(``peak_tflops`` / ``peak_hbm_gbps`` / ``ici_gbps`` / ``hbm_gb``) — on
+CPU (tests, virtual meshes) there is no meaningful peak, so rate-based
+fields are reported as null unless overridden.
+"""
+
+from typing import Any, Dict, Optional
+
+from deepspeed_tpu.telemetry.hlo_census import HloCensus
+from deepspeed_tpu.utils.logging import logger
+
+# device_kind substring -> (bf16 TFLOPS, HBM GB/s, ICI GB/s per link,
+# HBM GiB). Public chip specs; ICI is the per-direction per-chip figure.
+KNOWN_CHIPS = {
+    # real hardware reports device_kind "TPU v5 lite" / "TPU v6 lite",
+    # which normalizes to "v5lite"/"v6lite" — both spellings must match
+    "v6lite": (918.0, 1640.0, 448.0, 32.0),
+    "v6e": (918.0, 1640.0, 448.0, 32.0),
+    "v5p": (459.0, 2765.0, 600.0, 95.0),
+    "v5lite": (197.0, 819.0, 400.0, 16.0),
+    "v5e": (197.0, 819.0, 400.0, 16.0),
+    "v4": (275.0, 1228.0, 300.0, 32.0),
+    "v3": (123.0, 900.0, 140.0, 32.0),
+    "v2": (45.0, 700.0, 100.0, 16.0),
+}
+
+
+def detect_chip(device=None) -> Optional[Dict[str, float]]:
+    """Peak spec dict for the local accelerator, or None (CPU/unknown)."""
+    try:
+        import jax
+        d = device if device is not None else jax.local_devices()[0]
+        kind = (getattr(d, "device_kind", "") or "").lower()
+    except Exception:
+        return None
+    for key, (tf, hbm, ici, gib) in KNOWN_CHIPS.items():
+        if key in kind.replace(" ", "").replace("tpu", ""):
+            return {"device_kind": kind, "peak_tflops": tf,
+                    "peak_hbm_gbps": hbm, "ici_gbps": ici,
+                    "hbm_bytes": gib * 1024 ** 3}
+    return None
+
+
+def device_hbm_bytes(device=None) -> Optional[int]:
+    """Device memory capacity: the allocator's own ``bytes_limit`` when
+    the backend reports one, else the chip table, else None (CPU)."""
+    try:
+        import jax
+        d = device if device is not None else jax.local_devices()[0]
+        stats = d.memory_stats() or {}
+        if stats.get("bytes_limit"):
+            return int(stats["bytes_limit"])
+    except Exception:
+        pass
+    chip = detect_chip(device)
+    return int(chip["hbm_bytes"]) if chip else None
+
+
+class CostExplorer:
+    """Explains one compiled step program. Constructed from the parsed
+    ``telemetry.cost_explorer`` config block (or bare, with overrides)."""
+
+    def __init__(self, peak_tflops=None, peak_hbm_gbps=None, ici_gbps=None,
+                 hbm_bytes=None, preflight_threshold=0.95, registry=None):
+        chip = detect_chip() or {}
+        self._preflight_warned = set()       # program names warned once
+        self.device_kind = chip.get("device_kind", "unknown")
+        self.peak_tflops = (float(peak_tflops) if peak_tflops
+                            else chip.get("peak_tflops"))
+        self.peak_hbm_gbps = (float(peak_hbm_gbps) if peak_hbm_gbps
+                              else chip.get("peak_hbm_gbps"))
+        self.ici_gbps = float(ici_gbps) if ici_gbps else chip.get("ici_gbps")
+        self.hbm_bytes = (int(hbm_bytes) if hbm_bytes
+                          else device_hbm_bytes())
+        self.preflight_threshold = float(preflight_threshold)
+        self.registry = registry
+
+    @classmethod
+    def from_config(cls, ce_config, registry=None):
+        """Build from a ``DeepSpeedTelemetryConfig``'s cost-explorer
+        fields (``None``/0 entries fall back to chip detection)."""
+        return cls(
+            peak_tflops=getattr(ce_config, "cost_explorer_peak_tflops", None),
+            peak_hbm_gbps=getattr(ce_config, "cost_explorer_peak_hbm_gbps",
+                                  None),
+            ici_gbps=getattr(ce_config, "cost_explorer_ici_gbps", None),
+            hbm_bytes=(int(ce_config.cost_explorer_hbm_gb * 1024 ** 3)
+                       if getattr(ce_config, "cost_explorer_hbm_gb", 0)
+                       else None),
+            preflight_threshold=getattr(
+                ce_config, "cost_explorer_preflight_threshold", 0.95),
+            registry=registry)
+
+    # ------------------------------------------------------------ pre-flight
+    def preflight(self, census: HloCensus, name="step"):
+        """HBM watermark check BEFORE the first execution. Returns the
+        report dict; logs one warning line when the watermark crosses
+        ``preflight_threshold`` x HBM (it will run — XLA already
+        allocated it a budget — but with no headroom for the allocator,
+        fragmentation, or a second program)."""
+        wm = census.hbm_watermark_bytes
+        report = {
+            "hbm_watermark_bytes": wm,
+            "hbm_watermark_gb": round(wm / 1024 ** 3, 3),
+            "hbm_bytes": self.hbm_bytes,
+            "hbm_utilization": (round(wm / self.hbm_bytes, 4)
+                                if self.hbm_bytes else None),
+            "fits": (wm <= self.hbm_bytes * self.preflight_threshold
+                     if self.hbm_bytes else None),
+        }
+        if self.hbm_bytes and wm > self.hbm_bytes * self.preflight_threshold \
+                and name not in self._preflight_warned:
+            # once per program: explain() re-runs preflight for the report
+            # numbers, and repeating the multi-line warning every call
+            # would drown a per-epoch explain loop
+            self._preflight_warned.add(name)
+            logger.warning(
+                "[cost-explorer] HBM pre-flight: %r needs %.2f GiB of "
+                "%.2f GiB HBM (%.0f%% > %.0f%% threshold) — args+outputs-"
+                "alias %.2f GiB, temps %.2f GiB. Expect allocator "
+                "pressure or OOM; consider remat, a smaller micro-batch, "
+                "or a higher ZeRO stage.", name, wm / 1024 ** 3,
+                self.hbm_bytes / 1024 ** 3, 100.0 * wm / self.hbm_bytes,
+                100.0 * self.preflight_threshold,
+                (census.argument_bytes + census.output_bytes
+                 - census.alias_bytes) / 1024 ** 3,
+                census.temp_bytes / 1024 ** 3)
+        return report
+
+    # --------------------------------------------------------------- explain
+    def explain(self, census: HloCensus, step_time_s=None,
+                name="step", invocations=1) -> Dict[str, Any]:
+        """The "explain this step" report: roofline attribution of the
+        census against this chip's peaks, plus achieved-vs-peak when a
+        measured ``step_time_s`` is supplied.
+
+        ``invocations``: how many times the censused program runs per
+        measured step — under gradient accumulation the census covers ONE
+        micro step but ``step_time_s`` covers ``gas`` of them, so rates
+        computed without the multiplier would be ~gas x too low. Scales
+        the rate math only; the HBM watermark is per-program."""
+        flops = census.flops * invocations
+        total_bytes = census.bytes_accessed * invocations
+        total_wire = census.total_wire_bytes * invocations
+        peak_flops = (self.peak_tflops or 0.0) * 1e12
+        hbm_bw = (self.peak_hbm_gbps or 0.0) * 1e9
+        ici_bw = (self.ici_gbps or 0.0) * 1e9
+
+        # per-phase floors: what the program CANNOT run faster than
+        t_compute = flops / peak_flops if peak_flops else None
+        t_memory = total_bytes / hbm_bw if hbm_bw else None
+        t_comm = total_wire / ici_bw if ici_bw else None
+        bounds = {"compute": t_compute, "memory": t_memory, "comm": t_comm}
+        known = {k: v for k, v in bounds.items() if v}
+        verdict = max(known, key=known.get) if known else "unknown"
+
+        intensity = flops / total_bytes if total_bytes else None
+        ridge = (peak_flops / hbm_bw if peak_flops and hbm_bw else None)
+
+        achieved_tflops = mfu = None
+        if step_time_s and step_time_s > 0 and flops:
+            # 6 significant digits: CPU-scale numbers (1e-5 TFLOPS) must
+            # survive; fixed decimal rounding would zero them
+            achieved_tflops = float(f"{flops / step_time_s / 1e12:.6g}")
+            if self.peak_tflops:
+                mfu = float(f"{achieved_tflops / self.peak_tflops:.4g}")
+
+        report = {
+            "program": name,
+            "program_invocations_per_step": invocations,
+            "device_kind": self.device_kind,
+            "n_devices": census.n_devices,
+            "flops_per_step_per_device": flops,
+            "bytes_accessed_per_step": total_bytes,
+            "arithmetic_intensity_flops_per_byte": (
+                round(intensity, 3) if intensity else None),
+            "roofline_ridge_flops_per_byte": (
+                round(ridge, 3) if ridge else None),
+            "peak_tflops": self.peak_tflops,
+            "peak_hbm_gbps": self.peak_hbm_gbps,
+            "ici_gbps": self.ici_gbps,
+            "step_time_s": step_time_s,
+            "achieved_tflops": achieved_tflops,
+            "mfu": mfu,
+            "bound_floors_s": {k: (round(v, 6) if v else None)
+                               for k, v in bounds.items()},
+            "verdict": verdict,
+            "collectives": {
+                "counts": census.collective_counts,
+                "wire_bytes": {k: v * invocations for k, v in
+                               census.collective_wire_bytes.items()},
+                "bytes_by_axis": {k: v * invocations for k, v in
+                                  census.collective_bytes_by_axis.items()},
+                "total_wire_bytes": total_wire,
+            },
+            "preflight": self.preflight(census, name=name),
+        }
+        if step_time_s and known:
+            # how much of the measured step each floor explains
+            report["floor_fractions_of_step"] = {
+                k: round(v / step_time_s, 4)
+                for k, v in known.items()}
+        return report
+
+    # --------------------------------------------------------------- publish
+    def publish(self, census: HloCensus, report=None):
+        """Gauge the census (and report, when given) into the metrics
+        registry so the existing JSONL/Prometheus sinks export it."""
+        reg = self.registry
+        if reg is None:
+            return
+        reg.gauge("model_flops_per_step",
+                  "XLA-counted flops of the compiled step program "
+                  "(per device)").set(census.flops)
+        reg.gauge("model_bytes_accessed_per_step",
+                  "XLA-counted bytes accessed by the step program").set(
+                      census.bytes_accessed)
+        reg.gauge("hbm_watermark_bytes",
+                  "static HBM watermark of the step program "
+                  "(args + outputs - alias + temps)").set(
+                      census.hbm_watermark_bytes)
+        for axes, nbytes in census.collective_bytes_by_axis.items():
+            reg.gauge("collective_bytes",
+                      "per-participant collective wire bytes per step, "
+                      "by mesh axis", labels={"axes": axes}).set(nbytes)
+        for kind, count in census.collective_counts.items():
+            reg.gauge("collective_ops",
+                      "collective instructions in the step program",
+                      labels={"kind": kind}).set(count)
+        if report:
+            if report.get("mfu") is not None:
+                reg.gauge("model_mfu",
+                          "achieved / peak flops of the step program").set(
+                              report["mfu"])
+            if report.get("achieved_tflops") is not None:
+                reg.gauge("achieved_tflops",
+                          "measured model TFLOPS per device").set(
+                              report["achieved_tflops"])

@@ -1,0 +1,129 @@
+"""``python -m deepspeed_tpu.telemetry.explain`` — EXPLAIN.json emitter.
+
+Builds a GPT-2 engine at the requested geometry with the cost explorer
+enabled, primes the step program through the AOT-owning dispatch path
+(one compile — the same compile training would pay), optionally times a
+few steps, and writes the full "explain this step" report:
+
+* XLA-counted flops / bytes-accessed of the compiled per-chip program;
+* roofline + MFU attribution against the chip peak (configurable);
+* compute / memory / comm bound-ness verdict;
+* per-mesh-axis collective wire bytes;
+* HBM watermark pre-flight (args + outputs - alias + temps vs HBM).
+
+Examples::
+
+    python -m deepspeed_tpu.telemetry.explain                 # tiny smoke
+    python -m deepspeed_tpu.telemetry.explain --model gpt2 \
+        --batch-size 8 --seq 512 --zero 1 --devices 8 --steps 3
+    python -m deepspeed_tpu.telemetry.explain --peak-tflops 197 \
+        --hbm-gb 16 --out EXPLAIN.json
+
+On CPU (tests, laptops) there is no meaningful chip peak, so rate fields
+are null unless ``--peak-tflops``/``--peak-hbm-gbps`` are given; the
+census, collectives and watermark are exact regardless.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def _parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        prog="python -m deepspeed_tpu.telemetry.explain",
+        description="Cost-explorer report for a compiled train step")
+    p.add_argument("--model", default="tiny",
+                   help="tiny | gpt2 | gpt2-medium | gpt2-xl (default tiny)")
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--seq", type=int, default=128)
+    p.add_argument("--zero", type=int, default=0, help="ZeRO stage (0-3)")
+    p.add_argument("--devices", type=int, default=0,
+                   help="force N virtual CPU devices (0 = whatever exists)")
+    p.add_argument("--steps", type=int, default=2,
+                   help="timed steps after priming (0 = static-only)")
+    p.add_argument("--peak-tflops", type=float, default=0)
+    p.add_argument("--peak-hbm-gbps", type=float, default=0)
+    p.add_argument("--ici-gbps", type=float, default=0)
+    p.add_argument("--hbm-gb", type=float, default=0)
+    p.add_argument("--out", default="EXPLAIN.json")
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    args = _parse_args(argv)
+    if args.devices:
+        # must land before any jax backend initialises
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.devices}")
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    import jax.numpy as jnp  # noqa: F401  (jax init before deepspeed)
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import (GPT2Config, GPT2LMHeadModel,
+                                           PRESETS, synthetic_batch)
+    from deepspeed_tpu.utils import groups
+
+    if args.model == "tiny":
+        cfg = GPT2Config(vocab_size=2048, n_positions=max(256, args.seq),
+                         n_embd=128, n_layer=2, n_head=4)
+    else:
+        import dataclasses as _dc
+        cfg = PRESETS[args.model]
+        if args.seq > cfg.n_positions:
+            cfg = _dc.replace(cfg, n_positions=args.seq)
+
+    groups.destroy()
+    groups.initialize()
+    ce_block = {"enabled": True, "preflight": True}
+    for key, val in (("peak_tflops", args.peak_tflops),
+                     ("peak_hbm_gbps", args.peak_hbm_gbps),
+                     ("ici_gbps", args.ici_gbps), ("hbm_gb", args.hbm_gb)):
+        if val:
+            ce_block[key] = val
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=GPT2LMHeadModel(cfg),
+        config={
+            "train_batch_size": args.batch_size,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+            "zero_optimization": {"stage": args.zero},
+            "bf16": {"enabled": True},
+            "steps_per_print": 10 ** 9,
+            "telemetry": {"enabled": True, "trace": False,
+                          "jsonl": False, "prometheus": False,
+                          "cost_explorer": ce_block},
+        },
+        sample_batch=synthetic_batch(args.batch_size, args.seq,
+                                     cfg.vocab_size))
+
+    batch = synthetic_batch(args.batch_size, args.seq, cfg.vocab_size,
+                            seed=1)
+    step_time_s = None
+    if args.steps > 0:
+        engine.train_batch(batch=batch)          # prime (the one compile)
+        jax.device_get(engine.state.step)
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            engine.train_batch(batch=batch)
+        jax.device_get(engine.state.step)
+        step_time_s = (time.perf_counter() - t0) / args.steps
+    report = engine.explain_step(batch=batch, step_time_s=step_time_s)
+    report["config"] = {
+        "model": args.model, "batch_size": args.batch_size,
+        "seq": args.seq, "zero_stage": args.zero,
+        "n_devices": jax.device_count(),
+        "n_params": int(sum(x.size for x in
+                            jax.tree.leaves(engine.state.params))),
+    }
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=1)
+    print(json.dumps(report, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

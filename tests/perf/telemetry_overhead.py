@@ -1,8 +1,16 @@
-"""Tracer-overhead microbenchmark (telemetry/tracer.py).
+"""Telemetry-overhead microbenchmarks (telemetry/).
 
-Asserts the DISABLED ``trace_span`` path — the one every engine step pays
-whether or not telemetry is configured — costs < 2 µs/span, and reports
-the enabled-path cost for reference.
+Asserts:
+
+* the DISABLED ``trace_span`` path — the one every engine step pays
+  whether or not telemetry is configured — costs < 2 µs/span (the
+  enabled-path cost is reported for reference);
+* ``engine.explain_step()`` performs ZERO new XLA compilations (via the
+  compile-watch backend-compile counter) when the cost explorer owns the
+  step artifact, and the AOT-owning dispatch itself adds no compiles
+  across repeated steps;
+* with ``cost_explorer`` disabled, the engine carries no census state
+  and no explorer gauges — the per-step path is byte-identical to PR-1.
 
 Run manually:  python tests/perf/telemetry_overhead.py [iters] — not
 collected by pytest (no test_ prefix), like the other perf scripts here.
@@ -13,6 +21,10 @@ import sys
 import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+# engine checks need a mesh: force virtual devices BEFORE jax backend init
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 DISABLED_BUDGET_US = 2.0
 
@@ -24,6 +36,77 @@ def _per_span_us(tracer, iters):
         with span("bench"):
             pass
     return (time.perf_counter() - t0) / iters * 1e6
+
+
+def _tiny_engine(ce_enabled):
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import (GPT2Config, GPT2LMHeadModel,
+                                           synthetic_batch)
+    from deepspeed_tpu.utils import groups
+    groups.destroy()
+    groups.initialize()
+    cfg = GPT2Config(vocab_size=512, n_positions=128, n_embd=64,
+                     n_layer=2, n_head=4)
+    batch = synthetic_batch(8, 64, cfg.vocab_size)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=GPT2LMHeadModel(cfg),
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+                "steps_per_print": 10 ** 9,
+                "telemetry": {"enabled": True, "trace": False,
+                              "jsonl": False, "prometheus": False,
+                              "cost_explorer": {"enabled": ce_enabled}}},
+        sample_batch=batch)
+    return engine, batch
+
+
+def _backend_compiles(engine):
+    reg = engine.telemetry.registry
+    return sum(m.value for ms in reg.collect().values() for m in ms
+               if m.name == "xla_backend_compiles_total")
+
+
+def check_explain_step_zero_compiles(steps=4):
+    """The compile-watch counter guard: priming + steps + explain_step
+    must compile exactly once per program — explain_step itself adds 0."""
+    engine, batch = _tiny_engine(ce_enabled=True)
+    engine.train_batch(batch=batch)       # primes the owned AOT artifact
+    after_prime = _backend_compiles(engine)
+    for _ in range(steps):
+        engine.train_batch(batch=batch)
+    after_steps = _backend_compiles(engine)
+    assert after_steps == after_prime, (
+        f"AOT-owning dispatch recompiled during steady-state steps: "
+        f"{after_prime} -> {after_steps}")
+    engine.explain_step()
+    engine.explain_step()
+    after_explain = _backend_compiles(engine)
+    assert after_explain == after_steps, (
+        f"explain_step triggered {after_explain - after_steps} XLA "
+        f"compilations; it must read the owned artifact only")
+    print(f"explain_step XLA compiles: 0 (counter steady at "
+          f"{int(after_explain)})")
+
+
+def check_disabled_path_inert(steps=3):
+    """cost_explorer off => no census state, no explorer gauges, no AOT
+    wrapper on the step entry points (the PR-1 dispatch, unchanged)."""
+    from deepspeed_tpu.runtime.engine import _AOTStep
+    engine, batch = _tiny_engine(ce_enabled=False)
+    for _ in range(steps):
+        engine.train_batch(batch=batch)
+    assert engine._cost_census is None
+    target = getattr(engine._jit_train, "_compile_watch_target",
+                     engine._jit_train)
+    assert not isinstance(target, _AOTStep), (
+        "disabled cost explorer must not wrap the step entry points")
+    snap = engine.telemetry.registry.snapshot()
+    for name in ("model_flops_per_step", "hbm_watermark_bytes",
+                 "collective_bytes"):
+        assert name not in snap, f"unexpected gauge {name} while disabled"
+    print("disabled cost-explorer path: no wrapper, no census, no gauges")
 
 
 def main(iters=200_000):
@@ -44,6 +127,9 @@ def main(iters=200_000):
     assert disabled_us < DISABLED_BUDGET_US, (
         f"disabled tracer overhead {disabled_us:.3f} us/span exceeds the "
         f"{DISABLED_BUDGET_US} us budget — the no-op path regressed")
+
+    check_explain_step_zero_compiles()
+    check_disabled_path_inert()
     print("OK")
 
 
