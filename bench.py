@@ -17,6 +17,9 @@ Config via env:
   BENCH_MODEL  gpt2 (default) | gpt2-medium | gpt2-xl
   BENCH_ZERO   ZeRO stage (default 0 for gpt2, 3 for gpt2-xl)
   BENCH_PEAK_TFLOPS  chip bf16 peak for MFU (default 197, TPU v5e)
+  BENCH_HEALTH  1 (default) rides the telemetry.health stats inside the
+                timed step and writes HEALTH_BENCH.json; 0 removes the
+                stats epilogue from the compiled program entirely
 """
 
 import json
@@ -260,6 +263,13 @@ def main():
     # summary JSON (TELEMETRY_BENCH.json) is written next to BENCH_*.json.
     telemetry_on = os.environ.get("BENCH_TELEMETRY", "1").lower() in (
         "1", "true", "yes")
+    # Health stats ride inside the compiled step (norm reductions over the
+    # grad/param trees — a few extra HBM sweeps against a matmul-dominated
+    # step). Cadence stays 0 -> steps_per_print (pinned to 1e9 here), so
+    # the timed loop NEVER pays a stats fetch; health_report() does one
+    # on-demand fetch after the rounds for the HEALTH_BENCH.json artifact.
+    health_on = telemetry_on and os.environ.get(
+        "BENCH_HEALTH", "1").lower() in ("1", "true", "yes")
     bench_dir = os.path.dirname(os.path.abspath(__file__))
     telemetry_dir = os.path.join(bench_dir, "telemetry")
     ds_config = {
@@ -281,7 +291,8 @@ def main():
                       # own the compiled step artifact (AOT dispatch) so
                       # the post-bench census/MFU cross-check reads the
                       # program that actually ran — zero extra compiles
-                      "cost_explorer": {"enabled": True}},
+                      "cost_explorer": {"enabled": True},
+                      "health": {"enabled": health_on}},
     }
     if layered:
         # beyond-HBM training: params streamed from host RAM layer by
@@ -550,6 +561,23 @@ def main():
     # counts/seconds, retraces, memory) for the perf PRs that follow
     tel = getattr(engine, "telemetry", None)
     if tel is not None and tel.enabled:
+        # health forensics artifact BEFORE close (close() finalises the
+        # monitor): verdict + last stats sample + overflow counters for
+        # the run that produced the headline number above
+        if health_on and hasattr(engine, "health_report"):
+            try:
+                from deepspeed_tpu.telemetry.health import json_safe
+                hb = engine.health_report()
+                if hb.get("enabled", True) is not False:
+                    with open(os.path.join(bench_dir, "HEALTH_BENCH.json"),
+                              "w") as f:
+                        json.dump(json_safe({
+                            "bench": name,
+                            "step_time_ms": round(med_step_ms, 1),
+                            "health": hb}), f, indent=1, default=repr,
+                            allow_nan=False)
+            except Exception as e:   # forensics must never sink a bench
+                print(f"# health artifact unavailable: {e}", flush=True)
         tel.close()   # forces the final complete trace export
         engine.monitor.close()
         summary = {

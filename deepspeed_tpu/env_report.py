@@ -79,6 +79,8 @@ def telemetry_report():
     row("jsonl sink", True)
     row("prometheus text exporter", True)
     row("compile watch (signatures)", True)
+    row("health observatory (numerics)", True,
+        "(telemetry.health block; HEALTH.json forensics)")
     try:
         from jax import monitoring
         row("jax.monitoring listener",

@@ -93,7 +93,8 @@ class DeepSpeedTelemetryConfig(DeepSpeedConfigObject):
 
     Env overrides (sweep ergonomics, applied after JSON): ``DS_TELEMETRY``
     = 1/0 force-toggles ``enabled``; ``DS_TELEMETRY_DIR`` overrides
-    ``output_path``."""
+    ``output_path``; ``DS_COST_EXPLORER`` / ``DS_TELEMETRY_HEALTH`` = 1/0
+    force-toggle the cost-explorer / health sub-blocks."""
 
     def __init__(self, param_dict):
         t = param_dict.get(C.TELEMETRY, {}) or {}
@@ -134,6 +135,36 @@ class DeepSpeedTelemetryConfig(DeepSpeedConfigObject):
         self.cost_explorer_preflight_threshold = ce.get(
             C.COST_EXPLORER_PREFLIGHT_THRESHOLD,
             C.COST_EXPLORER_PREFLIGHT_THRESHOLD_DEFAULT)
+        # health sub-block (telemetry/health.py): in-step numerics stats +
+        # host-side anomaly rules + HEALTH.json forensics. Flattened onto
+        # health_* attributes like the cost explorer.
+        h = t.get(C.TELEMETRY_HEALTH, {}) or {}
+        self.health_enabled = h.get(C.HEALTH_ENABLED,
+                                    C.HEALTH_ENABLED_DEFAULT)
+        self.health_bucket_depth = h.get(C.HEALTH_BUCKET_DEPTH,
+                                         C.HEALTH_BUCKET_DEPTH_DEFAULT)
+        self.health_cadence = h.get(C.HEALTH_CADENCE,
+                                    C.HEALTH_CADENCE_DEFAULT)
+        self.health_ewma_alpha = h.get(C.HEALTH_EWMA_ALPHA,
+                                       C.HEALTH_EWMA_ALPHA_DEFAULT)
+        self.health_loss_spike_zscore = h.get(
+            C.HEALTH_LOSS_SPIKE_ZSCORE, C.HEALTH_LOSS_SPIKE_ZSCORE_DEFAULT)
+        self.health_grad_spike_zscore = h.get(
+            C.HEALTH_GRAD_SPIKE_ZSCORE, C.HEALTH_GRAD_SPIKE_ZSCORE_DEFAULT)
+        self.health_warmup_samples = h.get(C.HEALTH_WARMUP_SAMPLES,
+                                           C.HEALTH_WARMUP_SAMPLES_DEFAULT)
+        self.health_overflow_streak = h.get(
+            C.HEALTH_OVERFLOW_STREAK, C.HEALTH_OVERFLOW_STREAK_DEFAULT)
+        self.health_stall_window = h.get(C.HEALTH_STALL_WINDOW,
+                                         C.HEALTH_STALL_WINDOW_DEFAULT)
+        self.health_stall_rel_delta = h.get(
+            C.HEALTH_STALL_REL_DELTA, C.HEALTH_STALL_REL_DELTA_DEFAULT)
+        self.health_ring_size = h.get(C.HEALTH_RING_SIZE,
+                                      C.HEALTH_RING_SIZE_DEFAULT)
+        self.health_snapshot_file = h.get(C.HEALTH_SNAPSHOT_FILE,
+                                          C.HEALTH_SNAPSHOT_FILE_DEFAULT)
+        self.health_trace_on_anomaly = h.get(
+            C.HEALTH_TRACE_ON_ANOMALY, C.HEALTH_TRACE_ON_ANOMALY_DEFAULT)
         env = os.environ.get("DS_TELEMETRY")
         if env is not None:
             self.enabled = env.lower() in ("1", "true", "yes", "on")
@@ -144,6 +175,9 @@ class DeepSpeedTelemetryConfig(DeepSpeedConfigObject):
         if env_ce is not None:
             self.cost_explorer_enabled = env_ce.lower() in (
                 "1", "true", "yes", "on")
+        env_h = os.environ.get("DS_TELEMETRY_HEALTH")
+        if env_h is not None:
+            self.health_enabled = env_h.lower() in ("1", "true", "yes", "on")
 
 
 class DeepSpeedFlopsProfilerConfig(DeepSpeedConfigObject):

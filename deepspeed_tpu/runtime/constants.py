@@ -136,6 +136,40 @@ COST_EXPLORER_PREFLIGHT_DEFAULT = True
 COST_EXPLORER_PREFLIGHT_THRESHOLD = "preflight_threshold"
 COST_EXPLORER_PREFLIGHT_THRESHOLD_DEFAULT = 0.95
 
+# telemetry.health: training-health observatory (telemetry/health.py).
+# When enabled the compiled step additionally emits a small static-shaped
+# numerics-stats pytree (grad/param/update norms, per-module grad-norm
+# buckets, loss-scale scalars, non-finite provenance bitmask); the host
+# fetches it only at `cadence` and runs EWMA/z-score anomaly rules that
+# escalate warn -> HEALTH.json snapshot -> forced trace export.
+TELEMETRY_HEALTH = "health"
+HEALTH_ENABLED = "enabled"
+HEALTH_ENABLED_DEFAULT = False
+HEALTH_BUCKET_DEPTH = "bucket_depth"       # max module buckets (<= 32)
+HEALTH_BUCKET_DEPTH_DEFAULT = 8
+HEALTH_CADENCE = "cadence"                 # 0 -> steps_per_print
+HEALTH_CADENCE_DEFAULT = 0
+HEALTH_EWMA_ALPHA = "ewma_alpha"
+HEALTH_EWMA_ALPHA_DEFAULT = 0.1
+HEALTH_LOSS_SPIKE_ZSCORE = "loss_spike_zscore"
+HEALTH_LOSS_SPIKE_ZSCORE_DEFAULT = 6.0
+HEALTH_GRAD_SPIKE_ZSCORE = "grad_spike_zscore"
+HEALTH_GRAD_SPIKE_ZSCORE_DEFAULT = 6.0
+HEALTH_WARMUP_SAMPLES = "warmup_samples"   # samples before z-rules arm
+HEALTH_WARMUP_SAMPLES_DEFAULT = 8
+HEALTH_OVERFLOW_STREAK = "overflow_streak"  # consecutive skips -> critical
+HEALTH_OVERFLOW_STREAK_DEFAULT = 4
+HEALTH_STALL_WINDOW = "stall_window"       # health samples; <2 disables
+HEALTH_STALL_WINDOW_DEFAULT = 50
+HEALTH_STALL_REL_DELTA = "stall_rel_delta"
+HEALTH_STALL_REL_DELTA_DEFAULT = 1e-3
+HEALTH_RING_SIZE = "ring_size"             # forensics ring buffer samples
+HEALTH_RING_SIZE_DEFAULT = 256
+HEALTH_SNAPSHOT_FILE = "snapshot_file"     # "" -> <output_path>/HEALTH.json
+HEALTH_SNAPSHOT_FILE_DEFAULT = ""
+HEALTH_TRACE_ON_ANOMALY = "trace_on_anomaly"
+HEALTH_TRACE_ON_ANOMALY_DEFAULT = True
+
 # Checkpoint
 CHECKPOINT = "checkpoint"
 CHECKPOINT_TAG_VALIDATION = "tag_validation"

@@ -45,7 +45,7 @@ from deepspeed_tpu.runtime.config import (
     LAMB_OPTIMIZER, ONEBIT_ADAM_OPTIMIZER, ONEBIT_LAMB_OPTIMIZER, SGD_OPTIMIZER)
 from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader, RepeatingLoader
 from deepspeed_tpu.runtime.fp16.loss_scaler import (
-    LossScaleState, make_scale_state, update_scale)
+    LossScaleState, make_scale_state, scale_state_stats, update_scale)
 from deepspeed_tpu.runtime.lr_schedules import get_lr_schedule
 from deepspeed_tpu.runtime.zero.partition import (
     ModelParallelRules, build_opt_shardings, build_param_shardings,
@@ -446,6 +446,18 @@ class DeepSpeedEngine:
         self._cost_census_program = None
         self._first_step_time_ms = None
 
+        # ---- training-health observatory (telemetry/health.py) ------------
+        # Like the cost explorer, gated on the CONFIG (not the rank-0-only
+        # manager): the stats variant changes the compiled step program, so
+        # every rank must build the same one. The host-side HealthMonitor
+        # (anomaly rules, HEALTH.json) lives on rank 0 only, inside the
+        # manager. abstract_init engines never execute a step.
+        self._health_on = (bool(getattr(tcfg, "enabled", False))
+                           and bool(getattr(tcfg, "health_enabled", False))
+                           and not self._abstract_init)
+        self._health_cadence = int(getattr(tcfg, "health_cadence", 0) or 0)
+        self._health_spec = None
+
         # ---- parameters / state init --------------------------------------
         with self.telemetry.span("engine/init_state"):
             self._init_state(model_parameters, sample_batch)
@@ -547,8 +559,14 @@ class DeepSpeedEngine:
         return [float(self._lr_fn(max(0, applied_steps)))]
 
     def get_global_grad_norm(self):
-        """Global grad norm of the last applied step; None when the step
-        had no reason to compute it (bf16/fp32 with clipping disabled)."""
+        """Global grad norm as a host FLOAT (the reference's contract —
+        engine.py:477 returns ``self._global_grad_norm``), cached at
+        ``steps_per_print`` cadence where the log line already pays the
+        device sync. ``None`` until the first cadence fetch, and always
+        ``None`` when the step has no reason to compute the norm
+        (bf16/fp32 with clipping disabled and ``telemetry.health`` off) —
+        returning the live device array here used to hand callers a
+        hidden per-call host<->device sync."""
         return self._last_grad_norm
 
     # --------------------------------------------------------------- optimizer
@@ -836,8 +854,12 @@ class DeepSpeedEngine:
 
         self._build_step_fns()
         self._pending_loss = None
-        self._last_grad_norm = None
+        self._last_grad_norm = None      # host FLOAT, cached at print cadence
+        self._pending_grad_norm = None   # device scalar of the last step
         self._last_batch = None
+        self._pending_health_stats = None  # device stats pytree (no sync)
+        self._health_last_loss = None      # device scalar loss (no sync)
+        self._health_last_obs_step = -1
 
     def lower_train_step(self, batch):
         """AOT-lower the fused global train step (gas=1) at the engine's
@@ -975,6 +997,27 @@ class DeepSpeedEngine:
         gas = self.gradient_accumulation_steps()
         cfg = self.config
 
+        # health stats variant: selected HERE, before the first lower, so
+        # the _AOTStep artifact and the compile watch always see one fixed
+        # step signature (never mutated mid-run). The offloaded optimizer
+        # applies its update host-side, so the on-device epilogue cannot
+        # see the update norm — degrade gracefully (log once, no stats).
+        if self._health_on and self._offload:
+            logger.warning(
+                "[health] in-step stats are not supported with the "
+                "offloaded optimizer step (the update runs host-side); "
+                "disabling telemetry.health stats for this engine")
+            self._health_on = False
+        health = self._health_on
+        if health:
+            from deepspeed_tpu.telemetry.health import (build_bucket_spec,
+                                                        bucket_grad_stats)
+            self._health_spec = build_bucket_spec(
+                self.state.params,
+                depth=int(getattr(cfg.telemetry, "health_bucket_depth", 8)))
+            self._wire_health_monitor()
+            hspec = self._health_spec
+
         if self._sparse_grads:
             value_and_grad = self._make_sparse_vg()
         else:
@@ -999,14 +1042,19 @@ class DeepSpeedEngine:
         # grad_norm is only needed on-device for clipping and for the fp16
         # overflow bookkeeping; in the bf16/fp32 no-clip case computing it
         # costs a full extra read of the grad tree per step, so it is
-        # skipped and get_global_grad_norm() returns None.
-        need_norm = bool(cfg.fp16_enabled or cfg.gradient_clipping > 0)
+        # skipped and get_global_grad_norm() returns None. The health
+        # observatory needs it as a stat, so health forces it on.
+        need_norm = bool(cfg.fp16_enabled or cfg.gradient_clipping > 0
+                         or health)
         self._need_norm = need_norm
 
         def grad_epilogue(state, grads):
             """Shared end-of-accumulation math on an UNSCALED-pending grad
             tree: unscale, overflow check, norm + clip, scale-state update.
-            Returns (state-with-new-scale, grads, grad_norm, finite)."""
+            Returns (state-with-new-scale, grads, grad_norm, finite, aux);
+            ``aux`` holds the health bucket stats (empty dict when off) —
+            computed on the unscaled PRE-clip grads, so a clip cannot mask
+            an explosion and the provenance bitmask sees the raw values."""
             inv_scale = 1.0 / state.scale.loss_scale
             grads = jax.tree.map(lambda g: g * inv_scale, grads)
             finite = jnp.array(True)
@@ -1015,6 +1063,10 @@ class DeepSpeedEngine:
                     [jnp.isfinite(g).all() for g in jax.tree.leaves(grads)]))
             grad_norm = (optim_lib.global_norm(grads) if need_norm
                          else jnp.float32(0.0))
+            aux = {}
+            if health:
+                norms, mask = bucket_grad_stats(hspec, grads)
+                aux = {"bucket_norms": norms, "nonfinite_mask": mask}
             if cfg.gradient_clipping > 0:
                 grads, _ = optim_lib.clip_by_global_norm(
                     grads, cfg.gradient_clipping)
@@ -1024,18 +1076,19 @@ class DeepSpeedEngine:
                 scale_window=cfg.fp16.loss_scale_window,
                 min_scale=cfg.fp16.min_loss_scale,
                 delayed_shift=cfg.fp16.hysteresis)
-            return state._replace(scale=new_scale), grads, grad_norm, finite
+            return (state._replace(scale=new_scale), grads, grad_norm,
+                    finite, aux)
 
         def grad_prologue(state):
             """grad_epilogue over the accumulation buffer, which it resets."""
             acc = jax.tree.map(lambda a: a.astype(jnp.float32),
                                state.acc_grads)
             zeros = jax.tree.map(jnp.zeros_like, state.acc_grads)
-            state, grads, grad_norm, finite = grad_epilogue(
-                state._replace(acc_grads=zeros), acc)
-            return state, grads, grad_norm, finite
+            return grad_epilogue(state._replace(acc_grads=zeros), acc)
 
         def optimizer_update(state, grads, finite):
+            """Returns (state, update_norm); the norm is a constant 0 when
+            health is off (dead output, DCE'd by XLA)."""
             lr = self._lr_fn_traced(state.step)
 
             def do_update(operand):
@@ -1043,19 +1096,43 @@ class DeepSpeedEngine:
                 updates, new_opt = self.optimizer.update(
                     g, st.opt_state, st.params, lr)
                 new_params = jax.tree.map(jnp.add, st.params, updates)
+                un = (optim_lib.global_norm(updates) if health
+                      else jnp.float32(0.0))
                 return st._replace(step=st.step + 1, params=new_params,
-                                   opt_state=new_opt)
+                                   opt_state=new_opt), un
 
             def skip_update(operand):
                 st, _ = operand
-                return st
+                return st, jnp.float32(0.0)
 
             return jax.lax.cond(finite, do_update, skip_update,
                                 (state, grads))
 
+        def pack_stats(state, grad_norm, finite, upd_norm, aux):
+            """The static-shaped in-step stats pytree (health only). The
+            update ratio uses the APPLIED update (optimizer output, lr
+            already inside) against the post-update params; a skipped step
+            reports 0. loss_scale/good_steps/hysteresis come from the
+            POST-update scale state, so the host sees the machine as the
+            NEXT step will."""
+            pnorm = optim_lib.global_norm(state.params)
+            return {
+                "grad_norm": grad_norm,
+                "param_norm": pnorm,
+                "update_ratio": jnp.where(pnorm > 0, upd_norm / pnorm,
+                                          jnp.float32(0.0)),
+                "bucket_grad_norms": aux["bucket_norms"],
+                "nonfinite_buckets": aux["nonfinite_mask"],
+                "overflow": ~finite,
+                **scale_state_stats(state.scale),
+            }
+
         def apply_step(state):
-            state, grads, grad_norm, finite = grad_prologue(state)
-            state = optimizer_update(state, grads, finite)
+            state, grads, grad_norm, finite, aux = grad_prologue(state)
+            state, upd_norm = optimizer_update(state, grads, finite)
+            if health:
+                return (state, grad_norm, ~finite,
+                        pack_stats(state, grad_norm, finite, upd_norm, aux))
             return state, grad_norm, ~finite
 
         def fused_train_step(state, batch, rng, pld_theta):
@@ -1070,18 +1147,27 @@ class DeepSpeedEngine:
                 state.scale.loss_scale)
             grads = self._grad_constraint(grads)
             loss = sloss / state.scale.loss_scale
-            state, grads, grad_norm, finite = grad_epilogue(state, grads)
-            state = optimizer_update(state, grads, finite)
+            state, grads, grad_norm, finite, aux = grad_epilogue(state, grads)
+            state, upd_norm = optimizer_update(state, grads, finite)
+            if health:
+                return (state, loss, grad_norm, ~finite,
+                        pack_stats(state, grad_norm, finite, upd_norm, aux))
             return state, loss, grad_norm, ~finite
 
         def offload_pre_step(state):
             """Device half of the offloaded step: the shared prologue —
             grads go to the host CPU-Adam; params unchanged."""
-            state, grads, grad_norm, finite = grad_prologue(state)
+            state, grads, grad_norm, finite, _ = grad_prologue(state)
             return state, grads, grad_norm, ~finite
 
         sh = self.state_shardings
         scalar = NamedSharding(self.mesh, P())
+        # the stats pytree is all replicated scalars (+ one [B] bucket
+        # vector); keys must match pack_stats exactly
+        stats_sh = {k: scalar for k in (
+            "grad_norm", "param_norm", "update_ratio", "bucket_grad_norms",
+            "nonfinite_buckets", "overflow", "loss_scale", "good_steps",
+            "hysteresis")}
         self._jit_micro = jax.jit(
             micro_step, donate_argnums=0,
             in_shardings=(sh, None, None, None),
@@ -1093,7 +1179,9 @@ class DeepSpeedEngine:
             self._jit_train = jax.jit(
                 fused_train_step, donate_argnums=0,
                 in_shardings=(sh, None, None, None),
-                out_shardings=(sh, scalar, scalar, scalar))
+                out_shardings=((sh, scalar, scalar, scalar, stats_sh)
+                               if health else
+                               (sh, scalar, scalar, scalar)))
         self._jit_offload_pre = jax.jit(
             offload_pre_step, donate_argnums=0,
             in_shardings=(sh,),
@@ -1101,8 +1189,8 @@ class DeepSpeedEngine:
         self._jit_apply = jax.jit(
             apply_step, donate_argnums=0,
             in_shardings=(sh,),
-            out_shardings=(sh, NamedSharding(self.mesh, P()),
-                           NamedSharding(self.mesh, P())))
+            out_shardings=((sh, scalar, scalar, stats_sh) if health else
+                           (sh, scalar, scalar)))
         self._jit_eval = jax.jit(
             lambda params, batch: self._compute_loss(params, batch, None))
         self._install_aot_steps()
@@ -1136,6 +1224,14 @@ class DeepSpeedEngine:
         cfg = self.config
         axis = groups.DATA_AXIS
         import functools
+
+        if self._health_on:
+            logger.warning(
+                "[health] in-step stats are not supported with the "
+                "compressed 1-bit optimizers (rank-local shard_map grads, "
+                "no global epilogue); disabling telemetry.health stats "
+                "for this engine")
+            self._health_on = False
 
         from deepspeed_tpu.utils.jax_compat import get_shard_map
         shard_map, smap_kw = get_shard_map()
@@ -1357,6 +1453,106 @@ class DeepSpeedEngine:
             explorer.publish(census, report)
         return report
 
+    # ------------------------------------------------- health observatory
+    def _wire_health_monitor(self):
+        """Fill the rank-0 HealthMonitor's mesh/config-dependent fields
+        once the bucket spec exists (manager built it before the step fns
+        were constructed, so it could not know them)."""
+        mon = self.telemetry.health
+        if mon is None:
+            return
+        mon.bucket_names = list(self._health_spec.names)
+        if self.config.fp16_enabled:
+            mon.min_scale = float(self.config.fp16.min_loss_scale)
+        mon.census_fn = self._census_header
+
+    def _census_header(self):
+        """Compact cost-census header for HEALTH.json (None when the cost
+        explorer never censused a program)."""
+        c = self._cost_census
+        if c is None:
+            return None
+        return {"program": self._cost_census_program,
+                "flops_per_device": c.flops,
+                "bytes_accessed": c.bytes_accessed,
+                "hbm_watermark_bytes": c.hbm_watermark_bytes,
+                "n_devices": c.n_devices}
+
+    def _health_tick(self, force=False):
+        """Fetch + observe the pending in-step stats at the health cadence
+        (default ``steps_per_print``) — the ONLY host<->device sync in the
+        health path; between ticks the host holds device references only.
+        Rank 0 only (the monitor gates it); other ranks never fetch."""
+        mon = self.telemetry.health
+        if (mon is None or not self._health_on
+                or self._pending_health_stats is None):
+            return None
+        cadence = self._health_cadence or self.steps_per_print()
+        if not force and self.global_steps % cadence != 0:
+            return None
+        if self._health_last_obs_step == self.global_steps:
+            return mon.last_sample
+        self._health_last_obs_step = self.global_steps
+        # ONE transfer for the whole tick (stats pytree + loss scalar) —
+        # every device_get is a blocking sync, and avoidable round-trips
+        # are this engine's cardinal sin. The loss is the last dispatched
+        # micro/fused loss (the fused path's loss IS the global loss;
+        # under gas>1 it is the last micro's).
+        stats, loss_arr = jax.device_get(
+            (self._pending_health_stats, self._health_last_loss))
+        loss = (float(np.asarray(loss_arr))
+                if loss_arr is not None else None)
+        sample = {
+            "step": self.global_steps,
+            "loss": loss,
+            "lr": self.get_lr()[0],
+            "skipped_steps": self.skipped_steps,
+            "grad_norm": float(stats["grad_norm"]),
+            "param_norm": float(stats["param_norm"]),
+            "update_ratio": float(stats["update_ratio"]),
+            "bucket_grad_norms": [
+                float(x) for x in np.asarray(
+                    stats["bucket_grad_norms"]).ravel()],
+            "nonfinite_buckets": int(stats["nonfinite_buckets"]),
+            "loss_scale": float(stats["loss_scale"]),
+            "good_steps": int(stats["good_steps"]),
+            "hysteresis": int(stats["hysteresis"]),
+            "overflow": bool(stats["overflow"]),
+        }
+        mon.observe(sample)
+        reg = self.telemetry.registry
+        if reg is not None:
+            reg.gauge("train_param_norm",
+                      "global param L2 norm (health stats)").set(
+                          sample["param_norm"])
+            reg.gauge("train_update_ratio",
+                      "||applied update|| / ||params|| (health stats)").set(
+                          sample["update_ratio"])
+            reg.gauge("health_nonfinite_buckets",
+                      "non-finite grad provenance bitmask").set(
+                          sample["nonfinite_buckets"])
+            for name, v in zip(self._health_spec.names,
+                               sample["bucket_grad_norms"]):
+                reg.gauge("train_grad_norm_bucket",
+                          "per-module-bucket grad L2 norm",
+                          labels={"bucket": name}).set(v)
+        return sample
+
+    def health_report(self, write=False):
+        """The training-health forensics report (what HEALTH.json holds):
+        verdict, anomaly history, EWMA state, the recent-stats ring and
+        the cost-census header. Forces one stats fetch so the report is
+        current even between cadences. ``write=True`` also writes the
+        snapshot file. ``{"enabled": False}`` when ``telemetry.health``
+        is off or this is not rank 0."""
+        mon = self.telemetry.health
+        if mon is None or not self._health_on:
+            return {"enabled": False}
+        self._health_tick(force=True)
+        if write:
+            mon.write_snapshot(force=True)
+        return mon.report()
+
     def _lr_fn_traced(self, step):
         """LR schedule on a traced step: the four built-in schedules are
         written in jnp so they compile straight into the apply step."""
@@ -1401,6 +1597,8 @@ class DeepSpeedEngine:
             self.timers(FORWARD_GLOBAL_TIMER).stop(record=True)
         self._pending_loss = loss
         self._last_batch = batch
+        if self._health_on:
+            self._health_last_loss = loss   # device ref, no sync
         return loss
 
     def _globalize_batch(self, batch, for_train=True):
@@ -1598,6 +1796,10 @@ class DeepSpeedEngine:
         with self.telemetry.span("step", global_step=self.global_steps):
             if self._offload:
                 grad_norm, overflow = self._offload_step()
+            elif self._health_on:
+                self.state, grad_norm, overflow, stats = self._jit_apply(
+                    self.state)
+                self._pending_health_stats = stats   # device refs only
             else:
                 self.state, grad_norm, overflow = self._jit_apply(self.state)
         if breakdown:
@@ -1607,8 +1809,10 @@ class DeepSpeedEngine:
 
     def _post_apply(self, grad_norm, overflow, lr_kwargs=None):
         """Host bookkeeping after an applied (or skipped) optimizer step."""
-        # None (not a misleading 0.0) when the step skipped computing it
-        self._last_grad_norm = grad_norm if self._need_norm else None
+        # device scalar only — the host float is cached at print cadence
+        # (get_global_grad_norm's float contract); None (not a misleading
+        # 0.0) when the step skipped computing it
+        self._pending_grad_norm = grad_norm if self._need_norm else None
         self.global_steps += 1
         self.global_samples += self.train_batch_size()
         # only fp16 can overflow; skipping the device_get elsewhere keeps
@@ -1645,6 +1849,20 @@ class DeepSpeedEngine:
                 f"{self.loss_scale}", ranks=[0])
         elif self.lr_scheduler is not None:
             self.lr_scheduler.step(**(lr_kwargs or {}))
+        mon = self.telemetry.health
+        if mon is not None and self._health_on:
+            # host-only per-step facts (overflow streaks are exact, not
+            # sampled); the stats fetch below is cadence-gated
+            mon.note_step(self.global_steps, overflowed)
+        sample = self._health_tick()
+        if self.global_steps % self.steps_per_print() == 0 \
+                and self._pending_grad_norm is not None:
+            # the print path pays the device sync anyway; cache the float.
+            # A health sample fetched this step already carries the same
+            # scalar — reuse it rather than a second blocking device_get.
+            self._last_grad_norm = (
+                sample["grad_norm"] if sample is not None
+                else float(jax.device_get(self._pending_grad_norm)))
 
     def _fused_train_batch(self, data_iter, batch):
         """gas=1 fast path: one fused compiled program per global step."""
@@ -1659,8 +1877,15 @@ class DeepSpeedEngine:
         with self.telemetry.span("fused_step", global_step=self.global_steps):
             with self.mesh:
                 gbatch = self._globalize_batch(micro)
-                self.state, loss, grad_norm, overflow = self._jit_train(
-                    self.state, gbatch, self._next_rng(), theta)
+                if self._health_on:
+                    (self.state, loss, grad_norm, overflow,
+                     stats) = self._jit_train(
+                         self.state, gbatch, self._next_rng(), theta)
+                    self._pending_health_stats = stats   # device refs only
+                    self._health_last_loss = loss
+                else:
+                    self.state, loss, grad_norm, overflow = self._jit_train(
+                        self.state, gbatch, self._next_rng(), theta)
         self._pending_loss = None
         self._last_batch = gbatch   # flops profiler reads this
         self.micro_steps += 1
@@ -1719,9 +1944,10 @@ class DeepSpeedEngine:
             reg.gauge("train_loss_scale", "dynamic loss scale").set(
                 self.loss_scale)
         if self._last_grad_norm is not None:
+            # already a host float — _post_apply cached it at this cadence
             reg.gauge("train_grad_norm",
                       "global grad norm of the last applied step").set(
-                          float(jax.device_get(self._last_grad_norm)))
+                          self._last_grad_norm)
         reg.gauge("train_skipped_steps",
                   "overflow-skipped optimizer steps").set(self.skipped_steps)
         sps = self.tput_timer.avg_samples_per_sec()
@@ -1802,6 +2028,10 @@ class DeepSpeedEngine:
                  self.global_samples),
                 ("Train/Samples/lr", self.get_lr()[0], self.global_samples),
                 ("Train/Samples/loss_scale", self.loss_scale,
+                 self.global_samples),
+                # host-side counter that was computed but never exported
+                # (reference writes it via its monitor at the same point)
+                ("Train/Samples/skipped_steps", float(self.skipped_steps),
                  self.global_samples),
             ])
         return mean_loss

@@ -73,6 +73,18 @@ def update_scale(state: LossScaleState, overflow, *, dynamic=True,
     return lax.cond(overflow, on_overflow, on_good, state)
 
 
+def scale_state_stats(state: LossScaleState):
+    """The dynamic-scaler scalars as a flat dict — the health observatory's
+    in-step view of the fp16 state machine. ``hysteresis`` is the REMAINING
+    tolerated overflows: with the default delayed_shift=2, a value of 1
+    means one overflow has already been absorbed silently (no scale change,
+    no log line) and the next one will halve the scale — exactly the state
+    a sampled host metric cannot otherwise see."""
+    return {"loss_scale": state.loss_scale,
+            "good_steps": state.good_steps,
+            "hysteresis": state.hysteresis}
+
+
 # ---------------------------------------------------------------------------
 # Class API parity (reference LossScalerBase/LossScaler/DynamicLossScaler)
 # ---------------------------------------------------------------------------

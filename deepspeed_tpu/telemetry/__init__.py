@@ -13,7 +13,11 @@ Four pieces (see the per-module docstrings):
   and mesh-axis attribution;
 * ``cost_explorer`` — joins the census with runtime timings: roofline /
   MFU attribution, bound-ness verdicts, HBM watermark pre-flight
-  (``python -m deepspeed_tpu.telemetry.explain`` is the CLI).
+  (``python -m deepspeed_tpu.telemetry.explain`` is the CLI);
+* ``health`` — training-health observatory: in-step numerics stats
+  (grad/param/update norms, per-module buckets, loss-scale state,
+  non-finite provenance), EWMA/z-score anomaly rules, HEALTH.json
+  forensics (``python -m deepspeed_tpu.telemetry.health`` is the CLI).
 
 ``TelemetryManager`` (manager.py) wires them per engine run, behind the
 ``telemetry`` config block (see CONFIG.md). Everything is importable and
@@ -37,6 +41,10 @@ from deepspeed_tpu.telemetry.hlo_census import (CollectiveOp, HloCensus,
                                                 parse_hlo_collectives,
                                                 parse_replica_groups)
 from deepspeed_tpu.telemetry.cost_explorer import CostExplorer, detect_chip
+from deepspeed_tpu.telemetry.health import (BucketSpec, HealthMonitor,
+                                            bucket_grad_stats,
+                                            build_bucket_spec,
+                                            decode_nonfinite_mask)
 from deepspeed_tpu.telemetry.manager import TelemetryManager
 
 __all__ = [
@@ -48,4 +56,6 @@ __all__ = [
     "CollectiveOp", "HloCensus", "census_compiled", "census_fn",
     "parse_hlo_collectives", "parse_replica_groups",
     "CostExplorer", "detect_chip",
+    "BucketSpec", "HealthMonitor", "bucket_grad_stats",
+    "build_bucket_spec", "decode_nonfinite_mask",
 ]

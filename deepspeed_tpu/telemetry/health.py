@@ -1,0 +1,633 @@
+"""Training-health observatory — in-step numerics telemetry + forensics.
+
+The run-time telemetry (PR 1) watches the *system* and the cost explorer
+(PR 2) watches the *compiled program*; this module watches the *numerics*.
+Three pieces:
+
+* **In-step stats** (``build_bucket_spec`` / ``bucket_grad_stats``): the
+  engine's grad epilogue — already compiled into the train step — emits a
+  small static-shaped stats pytree on-device: global grad/param norms,
+  update ratio, per-top-level-module grad-norm *buckets* (grouped, never
+  per-leaf, so the payload is bounded by ``bucket_depth``), the dynamic
+  loss-scale scalars, and a non-finite **provenance bitmask** saying which
+  module bucket went inf/nan. Zero extra host syncs: the host holds only
+  device references and fetches at ``cadence`` (default
+  ``steps_per_print``), where the print path already pays the sync.
+* **Anomaly detection** (:class:`HealthMonitor`): host-side EWMA/z-score
+  rules — loss spike, grad-norm explosion, sustained overflow-skip
+  streak, loss-scale collapse to ``min_scale``, stalled loss — that
+  escalate warn → structured ``HEALTH.json`` snapshot (ring buffer of
+  recent samples + verdict + the cost-census header) → optional forced
+  trace export, so a diverging run explains itself from its artifacts
+  instead of from a rerun. The reference ships the same scalars through
+  its monitor (loss scale / grad norm / skipped steps); here they also
+  carry provenance.
+* **CLI**: ``python -m deepspeed_tpu.telemetry.health --render HEALTH.json``
+  pretty-prints a snapshot; ``--demo`` builds a tiny fp16 engine, injects
+  a non-finite gradient into ONE module bucket and writes the resulting
+  forensics file (the committed repo-root ``HEALTH.json`` example).
+
+Everything here is pure stdlib + jnp; when ``telemetry.health`` is off the
+engine's step programs are byte-identical to before.
+"""
+
+import json
+import math
+import os
+import time
+from collections import deque
+from typing import NamedTuple, Tuple
+
+from deepspeed_tpu.utils.logging import logger
+
+# the provenance bitmask is a uint32: at most 32 buckets, ever
+MAX_BUCKETS = 32
+OVERFLOW_BUCKET = "(other)"
+
+HEALTH_SCHEMA = "deepspeed_tpu.health/1"
+
+# rule name -> severity tier (worst tier seen decides the verdict)
+RULE_SEVERITY = {
+    "nonfinite_grads": "critical",
+    "overflow_streak": "critical",
+    "loss_scale_collapse": "critical",
+    "loss_spike": "warning",
+    "grad_norm_spike": "warning",
+    "loss_stall": "watch",
+}
+_SEVERITY_ORDER = ("critical", "warning", "watch")
+
+
+class BucketSpec(NamedTuple):
+    """Static grouping of param-tree leaves into named module buckets.
+
+    ``names[i]`` labels bucket ``i``; ``leaf_buckets[j]`` is the bucket of
+    the j-th leaf in ``jax.tree.leaves`` order. Built ONCE at engine init
+    from the param tree's structure, so the traced stats computation is a
+    fixed unrolled reduction — no dynamic shapes, no retraces."""
+    names: Tuple[str, ...]
+    leaf_buckets: Tuple[int, ...]
+
+
+def _path_component(entry):
+    for attr in ("key", "idx", "name"):
+        if hasattr(entry, attr):
+            return str(getattr(entry, attr))
+    return str(entry)
+
+
+def build_bucket_spec(params, depth=8) -> BucketSpec:
+    """Group param leaves by their top-level module path component.
+
+    A tree whose top level is a single container (e.g. everything under
+    ``"transformer"``) descends one extra level so the buckets carry
+    information. More than ``depth`` distinct modules: the first
+    ``depth - 1`` keep their names and the rest fold into ``(other)`` —
+    the payload must stay bounded for 48-layer models too."""
+    import jax
+    depth = max(1, min(int(depth), MAX_BUCKETS))
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    if not flat:
+        return BucketSpec(("<empty>",), ())
+    tops = {(_path_component(p[0]) if p else "<root>") for p, _ in flat}
+    descend = len(tops) < 2 and any(len(p) >= 2 for p, _ in flat)
+
+    def name_for(path):
+        if not path:
+            return "<root>"
+        if descend and len(path) >= 2:
+            return f"{_path_component(path[0])}/{_path_component(path[1])}"
+        return _path_component(path[0])
+
+    raw = [name_for(p) for p, _ in flat]
+    order = list(dict.fromkeys(raw))
+    if len(order) > depth:
+        names = order[:depth - 1] + [OVERFLOW_BUCKET]
+        index = {n: i for i, n in enumerate(order[:depth - 1])}
+        mapping = {n: index.get(n, depth - 1) for n in order}
+    else:
+        names = order
+        mapping = {n: i for i, n in enumerate(order)}
+    return BucketSpec(tuple(names), tuple(mapping[n] for n in raw))
+
+
+def bucket_grad_stats(spec: BucketSpec, grads):
+    """Traced: per-bucket grad L2 norms (f32[B]) + non-finite provenance
+    bitmask (uint32, bit i set = bucket i holds an inf/nan leaf).
+
+    Runs INSIDE the already-compiled step on the unscaled, pre-clip
+    gradient tree; one full read of the grad tree, fused by XLA with the
+    epilogue's existing finite-check / global-norm reductions."""
+    import jax
+    import jax.numpy as jnp
+    leaves = jax.tree_util.tree_leaves(grads)
+    assert len(leaves) == len(spec.leaf_buckets), (
+        f"bucket spec built for {len(spec.leaf_buckets)} leaves but the "
+        f"grad tree has {len(leaves)} — spec and tree diverged")
+    n = len(spec.names)
+    sq = [jnp.float32(0.0)] * n
+    bad = [jnp.bool_(False)] * n
+    for leaf, b in zip(leaves, spec.leaf_buckets):
+        g = leaf.astype(jnp.float32)
+        sq[b] = sq[b] + jnp.sum(g * g)
+        bad[b] = bad[b] | ~jnp.all(jnp.isfinite(leaf))
+    norms = jnp.sqrt(jnp.stack(sq))
+    mask = jnp.uint32(0)
+    for i, flag in enumerate(bad):
+        mask = mask | jnp.where(flag, jnp.uint32(1 << i), jnp.uint32(0))
+    return norms, mask
+
+
+def decode_nonfinite_mask(mask, names):
+    """Bucket names whose provenance bit is set."""
+    mask = int(mask)
+    return [n for i, n in enumerate(names) if mask & (1 << i)]
+
+
+def json_safe(obj):
+    """Recursively replace non-finite floats with their string names.
+
+    ``json.dump`` would otherwise emit bare ``Infinity``/``NaN`` tokens —
+    Python-only extensions that jq / JSON.parse / Go reject — and a
+    forensics file about inf/nan gradients is EXACTLY where those values
+    appear. Strings keep them readable and the file valid JSON."""
+    if isinstance(obj, float):
+        if math.isnan(obj):
+            return "NaN"
+        if math.isinf(obj):
+            return "Infinity" if obj > 0 else "-Infinity"
+        return obj
+    if isinstance(obj, dict):
+        return {k: json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [json_safe(v) for v in obj]
+    return obj
+
+
+class Ewma:
+    """Exponentially-weighted mean + variance (West's EW recurrence)."""
+
+    def __init__(self, alpha=0.1):
+        self.alpha = float(alpha)
+        self.mean = None
+        self.var = 0.0
+        self.n = 0
+
+    def zscore(self, x, rel_floor=0.0):
+        """z of ``x`` against the CURRENT state (call before update).
+        None while there is no history; +inf for a non-finite sample.
+        ``rel_floor`` floors the sd at that fraction of ``|mean|`` — the
+        EW variance starts near zero, and without a floor the first few
+        samples of ordinary noise read as double-digit sigmas."""
+        if self.mean is None or self.n < 2:
+            return None
+        if not math.isfinite(x):
+            return float("inf")
+        sd = math.sqrt(max(self.var, 0.0))
+        sd = max(sd, rel_floor * abs(self.mean))
+        if sd <= 0:
+            return 0.0 if x == self.mean else float("inf")
+        return (x - self.mean) / sd
+
+    def update(self, x):
+        if not math.isfinite(x):
+            return   # an inf/nan sample must not poison the baseline
+        if self.mean is None:
+            self.mean = float(x)
+        else:
+            d = float(x) - self.mean
+            self.mean += self.alpha * d
+            self.var = (1.0 - self.alpha) * (self.var + self.alpha * d * d)
+        self.n += 1
+
+    def state(self):
+        return {"mean": self.mean, "var": self.var, "n": self.n}
+
+
+class HealthMonitor:
+    """Host-side anomaly detection + forensics over the in-step stats.
+
+    Two inputs, two cadences:
+
+    * :meth:`note_step` — every global step, host-only facts (did the step
+      overflow-skip?). Free: no device sync. Drives the overflow-streak
+      rule exactly, not sampled.
+    * :meth:`observe` — one fetched stats sample at the engine's health
+      cadence. Drives the EWMA/z-score rules and fills the ring buffer.
+
+    Escalation on a firing rule: one warning log per rule (later firings
+    only counted), a throttled ``HEALTH.json`` snapshot write, the
+    ``on_escalate`` hook (the engine wires the tracer's forced export),
+    and a ``health_anomalies_total{rule=...}`` counter in the registry.
+    """
+
+    SNAPSHOT_MIN_INTERVAL_S = 5.0
+    MAX_ANOMALY_HISTORY = 100
+    # sd floor as a fraction of |EWMA mean|: at z=6 a spike must ALSO sit
+    # >= 30% above the baseline — real explosions are orders of magnitude
+    Z_SD_FLOOR_REL = 0.05
+
+    def __init__(self, job_name="", snapshot_path="HEALTH.json",
+                 bucket_names=(), ewma_alpha=0.1, loss_spike_zscore=6.0,
+                 grad_spike_zscore=6.0, warmup_samples=8, overflow_streak=4,
+                 min_scale=1.0, stall_window=50, stall_rel_delta=1e-3,
+                 ring_size=256, registry=None, on_escalate=None,
+                 census_fn=None, log_fn=None):
+        self.job_name = job_name
+        self.snapshot_path = snapshot_path
+        self.bucket_names = list(bucket_names)
+        self.loss_spike_zscore = float(loss_spike_zscore)
+        self.grad_spike_zscore = float(grad_spike_zscore)
+        self.warmup_samples = int(warmup_samples)
+        self.overflow_streak_threshold = int(overflow_streak)
+        self.min_scale = float(min_scale)
+        self.stall_window = int(stall_window)
+        self.stall_rel_delta = float(stall_rel_delta)
+        self.registry = registry
+        self.on_escalate = on_escalate
+        self.census_fn = census_fn
+        self._log = log_fn or logger.warning
+
+        self.ewma_loss = Ewma(ewma_alpha)
+        self.ewma_grad = Ewma(ewma_alpha)
+        self.ring = deque(maxlen=int(ring_size))
+        self.anomalies = []          # bounded history, most recent last
+        self.rule_counts = {}        # rule -> total firings
+        self.steps_seen = 0
+        self.samples_seen = 0
+        self.skipped_seen = 0
+        self.overflow_streak = 0
+        self.max_overflow_streak = 0
+        self.last_sample = None
+        self.last_step = -1
+        self._stall_ring = deque(maxlen=max(2, self.stall_window))
+        self._stall_active = False
+        self._snapshots_written = 0
+        self._last_snapshot_t = float("-inf")
+
+    @classmethod
+    def from_config(cls, tconfig, output_path="telemetry/", job_name="",
+                    registry=None, on_escalate=None):
+        """Build from a parsed ``DeepSpeedTelemetryConfig``'s ``health_*``
+        fields (the engine fills mesh-dependent attributes — bucket
+        names, fp16 ``min_scale``, the census header — after its step
+        functions exist)."""
+        snap = getattr(tconfig, "health_snapshot_file", "") or "HEALTH.json"
+        if not os.path.isabs(snap):
+            snap = os.path.join(output_path or ".", snap)
+        return cls(
+            job_name=job_name,
+            snapshot_path=snap,
+            ewma_alpha=getattr(tconfig, "health_ewma_alpha", 0.1),
+            loss_spike_zscore=getattr(tconfig, "health_loss_spike_zscore",
+                                      6.0),
+            grad_spike_zscore=getattr(tconfig, "health_grad_spike_zscore",
+                                      6.0),
+            warmup_samples=getattr(tconfig, "health_warmup_samples", 8),
+            overflow_streak=getattr(tconfig, "health_overflow_streak", 4),
+            stall_window=getattr(tconfig, "health_stall_window", 50),
+            stall_rel_delta=getattr(tconfig, "health_stall_rel_delta", 1e-3),
+            ring_size=getattr(tconfig, "health_ring_size", 256),
+            registry=registry, on_escalate=on_escalate)
+
+    # ------------------------------------------------------------ per step
+    def note_step(self, step, overflowed):
+        """Host-only per-step bookkeeping (no device sync). The overflow
+        streak is tracked HERE, per step, so a sustained skip run fires at
+        exactly ``overflow_streak`` steps even between cadence fetches —
+        the hysteresis=2 failure mode (first overflow: no scale change, no
+        signal) is invisible at any sampled cadence."""
+        self.steps_seen += 1
+        if overflowed:
+            self.skipped_seen += 1
+            self.overflow_streak += 1
+            self.max_overflow_streak = max(self.max_overflow_streak,
+                                           self.overflow_streak)
+            if self.overflow_streak == self.overflow_streak_threshold:
+                self._escalate([{
+                    "rule": "overflow_streak", "step": int(step),
+                    "severity": RULE_SEVERITY["overflow_streak"],
+                    "detail": f"{self.overflow_streak} consecutive "
+                              f"overflow-skipped optimizer steps",
+                }])
+        else:
+            self.overflow_streak = 0
+
+    # ------------------------------------------------------------ cadence
+    def observe(self, sample):
+        """Evaluate the anomaly rules on one fetched stats sample (a plain
+        dict of host floats — see the engine's ``_health_tick``). Returns
+        the list of anomalies that fired on THIS sample."""
+        step = int(sample.get("step", -1))
+        anoms = []
+
+        loss = sample.get("loss")
+        if loss is not None:
+            z = self.ewma_loss.zscore(loss, rel_floor=self.Z_SD_FLOOR_REL)
+            if (z is not None and self.samples_seen >= self.warmup_samples
+                    and z > self.loss_spike_zscore):
+                anoms.append({
+                    "rule": "loss_spike", "step": step,
+                    "severity": RULE_SEVERITY["loss_spike"],
+                    "detail": f"loss {loss:.6g} is {z:.1f} sigma above the "
+                              f"EWMA {self.ewma_loss.mean:.6g}",
+                    "zscore": None if math.isinf(z) else round(z, 2)})
+            self.ewma_loss.update(loss)
+            # stalled loss: the EWMA moved < stall_rel_delta (relative)
+            # across the whole stall window of observations
+            if self.ewma_loss.mean is not None and self.stall_window > 1:
+                self._stall_ring.append(self.ewma_loss.mean)
+                if len(self._stall_ring) == self._stall_ring.maxlen:
+                    first, last = self._stall_ring[0], self._stall_ring[-1]
+                    rel = abs(last - first) / max(abs(first), 1e-12)
+                    if rel < self.stall_rel_delta and not self._stall_active:
+                        self._stall_active = True
+                        anoms.append({
+                            "rule": "loss_stall", "step": step,
+                            "severity": RULE_SEVERITY["loss_stall"],
+                            "detail": f"loss EWMA moved {rel:.2e} (rel) over "
+                                      f"the last {self.stall_window} health "
+                                      f"samples"})
+                    elif rel >= self.stall_rel_delta:
+                        self._stall_active = False
+
+        gn = sample.get("grad_norm")
+        if gn is not None:
+            z = self.ewma_grad.zscore(gn, rel_floor=self.Z_SD_FLOOR_REL)
+            if (z is not None and self.samples_seen >= self.warmup_samples
+                    and z > self.grad_spike_zscore):
+                anoms.append({
+                    "rule": "grad_norm_spike", "step": step,
+                    "severity": RULE_SEVERITY["grad_norm_spike"],
+                    "detail": f"grad norm {gn:.6g} is {z:.1f} sigma above "
+                              f"the EWMA {self.ewma_grad.mean:.6g}",
+                    "zscore": None if math.isinf(z) else round(z, 2)})
+            self.ewma_grad.update(gn)
+
+        mask = int(sample.get("nonfinite_buckets") or 0)
+        if mask:
+            buckets = decode_nonfinite_mask(mask, self.bucket_names) or \
+                [f"bit{i}" for i in range(MAX_BUCKETS) if mask & (1 << i)]
+            anoms.append({
+                "rule": "nonfinite_grads", "step": step,
+                "severity": RULE_SEVERITY["nonfinite_grads"],
+                "detail": "non-finite gradients first seen in module "
+                          f"bucket(s): {', '.join(buckets)}",
+                "buckets": buckets})
+
+        scale = sample.get("loss_scale")
+        if (sample.get("overflow") and scale is not None
+                and scale <= self.min_scale):
+            anoms.append({
+                "rule": "loss_scale_collapse", "step": step,
+                "severity": RULE_SEVERITY["loss_scale_collapse"],
+                "detail": f"dynamic loss scale collapsed to min_scale "
+                          f"({scale:g}) and the step still overflows — "
+                          f"the run cannot make progress in fp16"})
+
+        self.samples_seen += 1
+        self.last_sample = sample
+        self.last_step = step
+        self.ring.append(sample)
+        if anoms:
+            self._escalate(anoms)
+        return anoms
+
+    # ---------------------------------------------------------- escalation
+    def _escalate(self, anoms):
+        any_first = False
+        for a in anoms:
+            rule = a["rule"]
+            first = rule not in self.rule_counts
+            any_first = any_first or first
+            self.rule_counts[rule] = self.rule_counts.get(rule, 0) + 1
+            self.anomalies.append(a)
+            if first:
+                self._log("[health] %s (%s) at step %s: %s — snapshot -> %s",
+                          rule, a["severity"], a.get("step"), a["detail"],
+                          self.snapshot_path)
+            if self.registry is not None:
+                self.registry.counter(
+                    "health_anomalies_total",
+                    "training-health anomaly rule firings",
+                    labels={"rule": rule}).inc()
+        del self.anomalies[:-self.MAX_ANOMALY_HISTORY]
+        # a first-time rule always snapshots (the forensics file must name
+        # it); repeat firings ride the throttle
+        self.write_snapshot(force=any_first)
+        if self.on_escalate is not None:
+            try:
+                self.on_escalate()
+            except Exception as e:   # forensics must never kill a step
+                logger.warning("[health] on_escalate hook failed: %s", e)
+
+    # ------------------------------------------------------------- outputs
+    def verdict(self):
+        if not self.samples_seen and not self.steps_seen:
+            return "unknown"
+        seen = {RULE_SEVERITY.get(r, "warning") for r in self.rule_counts}
+        for tier in _SEVERITY_ORDER:
+            if tier in seen:
+                return tier
+        return "healthy"
+
+    def report(self):
+        """The full forensics dict (what ``HEALTH.json`` holds)."""
+        census = None
+        if self.census_fn is not None:
+            try:
+                census = self.census_fn()
+            except Exception:
+                census = None
+        return {
+            "schema": HEALTH_SCHEMA,
+            "enabled": True,
+            "job_name": self.job_name,
+            "verdict": self.verdict(),
+            "rules": {
+                "loss_spike_zscore": self.loss_spike_zscore,
+                "grad_spike_zscore": self.grad_spike_zscore,
+                "warmup_samples": self.warmup_samples,
+                "overflow_streak": self.overflow_streak_threshold,
+                "min_scale": self.min_scale,
+                "stall_window": self.stall_window,
+                "stall_rel_delta": self.stall_rel_delta,
+                "ewma_alpha": self.ewma_loss.alpha,
+            },
+            "bucket_names": list(self.bucket_names),
+            "counters": {
+                "steps_seen": self.steps_seen,
+                "samples_seen": self.samples_seen,
+                "skipped_steps": self.skipped_seen,
+                "overflow_streak": self.overflow_streak,
+                "max_overflow_streak": self.max_overflow_streak,
+                "anomaly_counts": dict(self.rule_counts),
+            },
+            "ewma": {"loss": self.ewma_loss.state(),
+                     "grad_norm": self.ewma_grad.state()},
+            "anomalies": list(self.anomalies),
+            "last_sample": self.last_sample,
+            "ring": list(self.ring),
+            "cost_census": census,
+        }
+
+    def write_snapshot(self, path=None, force=False):
+        """Write ``HEALTH.json``. Periodic (escalation-driven) writes are
+        throttled like the trace export — re-serialising the ring every
+        anomaly during a death spiral would stall the train thread."""
+        if not force and (time.monotonic() - self._last_snapshot_t
+                          < self.SNAPSHOT_MIN_INTERVAL_S):
+            return None
+        self._last_snapshot_t = time.monotonic()
+        path = path or self.snapshot_path
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(json_safe(self.report()), f, indent=1, default=repr,
+                      allow_nan=False)
+        self._snapshots_written += 1
+        return path
+
+    def close(self):
+        """Final snapshot — only when there is something to explain."""
+        if self.anomalies:
+            self.write_snapshot(force=True)
+
+
+# --------------------------------------------------------------------- CLI
+
+def render(report):
+    """Human-readable rendering of a HEALTH.json report dict."""
+    lines = []
+    c = report.get("counters", {})
+    lines.append(f"health verdict: {report.get('verdict', '?').upper()}"
+                 f"  (job {report.get('job_name') or '-'})")
+    lines.append(f"  steps seen {c.get('steps_seen', 0)}, samples "
+                 f"{c.get('samples_seen', 0)}, skipped "
+                 f"{c.get('skipped_steps', 0)}, max overflow streak "
+                 f"{c.get('max_overflow_streak', 0)}")
+    ew = report.get("ewma", {})
+    for k in ("loss", "grad_norm"):
+        s = ew.get(k) or {}
+        if s.get("mean") is not None:
+            lines.append(f"  ewma {k}: {s['mean']:.6g} "
+                         f"(var {s.get('var', 0):.3g}, n {s.get('n', 0)})")
+    for a in report.get("anomalies", []):
+        extra = f" buckets={a['buckets']}" if a.get("buckets") else ""
+        lines.append(f"  [{a.get('severity', '?'):8s}] step "
+                     f"{a.get('step')}: {a.get('rule')} — "
+                     f"{a.get('detail')}{extra}")
+    if not report.get("anomalies"):
+        lines.append("  no anomalies recorded")
+    s = report.get("last_sample") or {}
+    if s:
+        lines.append(
+            f"  last sample @ step {s.get('step')}: loss={s.get('loss')}, "
+            f"grad_norm={s.get('grad_norm')}, "
+            f"update_ratio={s.get('update_ratio')}, "
+            f"loss_scale={s.get('loss_scale')}")
+    cen = report.get("cost_census")
+    if cen:
+        lines.append(f"  program {cen.get('program')}: "
+                     f"{cen.get('flops_per_device', 0):.3g} flops/device, "
+                     f"HBM watermark {cen.get('hbm_watermark_bytes', 0)} B, "
+                     f"{cen.get('n_devices')} devices")
+    return "\n".join(lines)
+
+
+def _demo(args):
+    """Build a tiny fp16 engine, inject an inf into ONE module bucket's
+    accumulated gradient, and write the resulting forensics snapshot —
+    the committed repo-root HEALTH.json example comes from here."""
+    if args.devices:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.devices}")
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.simple import SimpleModel, sample_batch
+    from deepspeed_tpu.utils import groups
+
+    groups.destroy()
+    groups.initialize()
+    hidden = 32
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=hidden, nlayers=2),
+        config={
+            "train_batch_size": 16,
+            "train_micro_batch_size_per_gpu": 1,
+            "gradient_accumulation_steps": 2,
+            "steps_per_print": 1,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+            "fp16": {"enabled": True, "loss_scale": 0,
+                     "initial_scale_power": 8},
+            "telemetry": {"enabled": True, "trace": False,
+                          "jsonl": False, "prometheus": False,
+                          "cost_explorer": {"enabled": True},
+                          "health": {"enabled": True, "cadence": 1,
+                                     "warmup_samples": 2,
+                                     "snapshot_file": os.path.abspath(
+                                         args.out)}},
+        },
+        sample_batch=sample_batch(8, hidden))
+    rng = np.random.default_rng(0)
+
+    def micro(seed):
+        x = rng.standard_normal((8, hidden)).astype(np.float32)
+        y = rng.standard_normal((8, hidden)).astype(np.float32)
+        return (x, y)
+
+    for step in range(args.steps):
+        inject = step == args.steps - 1
+        for _ in range(2):
+            loss = engine.forward(micro(step))
+            engine.backward(loss)
+        if inject:
+            # poison exactly ONE module bucket: Dense_1's accumulated grads
+            acc = jax.tree_util.tree_map_with_path(
+                lambda p, x: jax.device_put(
+                    jnp.full_like(x, jnp.inf), x.sharding)
+                if "Dense_1" in jax.tree_util.keystr(p) else x,
+                engine.state.acc_grads)
+            engine.state = engine.state._replace(acc_grads=acc)
+        engine.step()
+    report = engine.health_report(write=True)
+    print(render(report))
+    print(f"\nwrote {args.out}")
+    return 0
+
+
+def main(argv=None):
+    import argparse
+    p = argparse.ArgumentParser(
+        prog="python -m deepspeed_tpu.telemetry.health",
+        description="Render a HEALTH.json snapshot, or run the forensics "
+                    "demo (tiny fp16 engine + injected non-finite grad)")
+    p.add_argument("--render", metavar="HEALTH.json",
+                   help="pretty-print an existing snapshot and exit")
+    p.add_argument("--demo", action="store_true",
+                   help="build a tiny engine, inject an inf into one "
+                        "module bucket, write the snapshot")
+    p.add_argument("--steps", type=int, default=12)
+    p.add_argument("--devices", type=int, default=8,
+                   help="virtual CPU devices for the demo (0 = existing)")
+    p.add_argument("--out", default="HEALTH.json")
+    args = p.parse_args(argv)
+    if args.render:
+        with open(args.render) as f:
+            print(render(json.load(f)))
+        return 0
+    if args.demo:
+        return _demo(args)
+    p.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -39,6 +39,7 @@ class TelemetryManager:
             self.registry = None
             self.compile_watch = None
             self.trace_path = None
+            self.health = None
             return
 
         out = config.output_path or "telemetry/"
@@ -59,6 +60,20 @@ class TelemetryManager:
                               if config.compile_watch else None)
         if config.compile_watch:
             _cw.install_global_listener(self.registry)
+        # training-health observatory (telemetry/health.py): the monitor is
+        # rank-0/host-side like everything here; the engine fills in the
+        # mesh-dependent attributes (bucket names, fp16 min_scale, census
+        # header) once its step functions exist, and feeds note_step /
+        # observe from its train loop.
+        self.health = None
+        if getattr(config, "health_enabled", False):
+            from deepspeed_tpu.telemetry.health import HealthMonitor
+            on_escalate = (self._force_trace_export
+                           if getattr(config, "health_trace_on_anomaly",
+                                      True) and config.trace else None)
+            self.health = HealthMonitor.from_config(
+                config, output_path=out, job_name=job,
+                registry=self.registry, on_escalate=on_escalate)
         self._closed = False
         self._last_export_t = float("-inf")
         self._last_export_n = -1
@@ -113,10 +128,17 @@ class TelemetryManager:
         self._last_export_t = time.monotonic()
         self.tracer.export(self.trace_path)
 
+    def _force_trace_export(self):
+        """Anomaly-escalation hook: flush the trace NOW (still subject to
+        the flush throttle's 5 s floor between repeated anomalies)."""
+        self.flush()
+
     def close(self):
         if not self.enabled or self._closed:
             return
         self._closed = True
+        if self.health is not None:
+            self.health.close()
         self.flush(force=True)
         _cw.uninstall_global_listener()
         atexit.unregister(self.close)

@@ -10,7 +10,12 @@ Asserts:
   step artifact, and the AOT-owning dispatch itself adds no compiles
   across repeated steps;
 * with ``cost_explorer`` disabled, the engine carries no census state
-  and no explorer gauges — the per-step path is byte-identical to PR-1.
+  and no explorer gauges — the per-step path is byte-identical to PR-1;
+* the ``telemetry.health`` path: enabled, a 20-step run still compiles
+  the train step exactly ONCE (the stats variant is selected before the
+  first lower, never by signature mutation) and fetches stats only at
+  the print cadence; disabled, the step programs and the <2 µs/span
+  budget are unchanged (no stats outputs, no monitor, no gauges).
 
 Run manually:  python tests/perf/telemetry_overhead.py [iters] — not
 collected by pytest (no test_ prefix), like the other perf scripts here.
@@ -38,7 +43,7 @@ def _per_span_us(tracer, iters):
     return (time.perf_counter() - t0) / iters * 1e6
 
 
-def _tiny_engine(ce_enabled):
+def _tiny_engine(ce_enabled, health_enabled=False, steps_per_print=10 ** 9):
     import jax
     jax.config.update("jax_platforms", "cpu")
     import deepspeed_tpu
@@ -54,10 +59,11 @@ def _tiny_engine(ce_enabled):
         model=GPT2LMHeadModel(cfg),
         config={"train_batch_size": 8,
                 "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
-                "steps_per_print": 10 ** 9,
+                "steps_per_print": steps_per_print,
                 "telemetry": {"enabled": True, "trace": False,
                               "jsonl": False, "prometheus": False,
-                              "cost_explorer": {"enabled": ce_enabled}}},
+                              "cost_explorer": {"enabled": ce_enabled},
+                              "health": {"enabled": health_enabled}}},
         sample_batch=batch)
     return engine, batch
 
@@ -109,6 +115,53 @@ def check_disabled_path_inert(steps=3):
     print("disabled cost-explorer path: no wrapper, no census, no gauges")
 
 
+def check_health_zero_extra_compiles(steps=20, cadence=5):
+    """Acceptance guard: health + cost explorer on, a 20-step run compiles
+    the train step exactly once (the stats variant is part of the ONE
+    program, selected before first lower) and the host observes stats
+    only at the print cadence."""
+    engine, batch = _tiny_engine(ce_enabled=True, health_enabled=True,
+                                 steps_per_print=cadence)
+    assert engine._health_on, "health must be armed on this config"
+    engine.train_batch(batch=batch)       # the one compile
+    after_prime = _backend_compiles(engine)
+    for _ in range(steps - 1):
+        engine.train_batch(batch=batch)
+    after_steps = _backend_compiles(engine)
+    assert after_steps == after_prime, (
+        f"health stats variant recompiled mid-run: "
+        f"{after_prime} -> {after_steps}")
+    mon = engine.telemetry.health
+    assert mon.steps_seen == steps
+    expected = steps // cadence
+    assert mon.samples_seen == expected, (
+        f"stats fetched {mon.samples_seen}x over {steps} steps; the "
+        f"cadence-{cadence} path must fetch exactly {expected}x — a "
+        f"per-step host-device sync crept in")
+    snap = engine.telemetry.registry.snapshot()
+    assert "train_param_norm" in snap and "train_update_ratio" in snap
+    print(f"health path: 1 compile over {steps} steps, "
+          f"{mon.samples_seen} cadence fetches, verdict "
+          f"{mon.verdict()!r}")
+
+
+def check_health_disabled_inert(steps=3):
+    """health off => no stats outputs, no monitor, no health gauges; the
+    step programs are the pre-health ones."""
+    engine, batch = _tiny_engine(ce_enabled=False, health_enabled=False)
+    assert engine._health_on is False
+    assert engine.telemetry.health is None
+    for _ in range(steps):
+        engine.train_batch(batch=batch)
+    assert engine._pending_health_stats is None
+    snap = engine.telemetry.registry.snapshot()
+    for name in ("train_param_norm", "train_update_ratio",
+                 "train_grad_norm_bucket", "health_nonfinite_buckets",
+                 "health_anomalies_total"):
+        assert name not in snap, f"unexpected gauge {name} while disabled"
+    print("disabled health path: no stats, no monitor, no gauges")
+
+
 def main(iters=200_000):
     from deepspeed_tpu.telemetry import Tracer
 
@@ -130,6 +183,8 @@ def main(iters=200_000):
 
     check_explain_step_zero_compiles()
     check_disabled_path_inert()
+    check_health_zero_extra_compiles()
+    check_health_disabled_inert()
     print("OK")
 
 

@@ -160,6 +160,38 @@ class TestFP16:
         engine.train_batch(batch=(good, tgt))
         assert engine.global_steps == 2  # both batches count a step() call
 
+    def test_hysteresis_first_overflow_keeps_scale(self):
+        """The hysteresis=2 (DEFAULT) gotcha, pinned: the FIRST overflow
+        skips the step but does NOT halve the loss scale — only the second
+        consecutive one does (loss_scaler.update_scale consumes hysteresis
+        before shifting). This bit a previous session; a run that skips a
+        step with no scale change and no signal is exactly what the health
+        observatory's overflow-streak rule exists for."""
+        engine = make_engine(base_config(
+            fp16={"enabled": True, "loss_scale": 0,
+                  "initial_scale_power": 4}))   # hysteresis defaults to 2
+        scale0 = engine.loss_scale
+        bad = np.full((16, 32), 1e38, dtype=np.float32)
+        tgt = np.zeros((16, 32), dtype=np.float32)
+
+        engine.train_batch(batch=(bad, tgt))
+        assert engine.skipped_steps == 1
+        assert engine.loss_scale == scale0      # absorbed, NOT halved
+
+        engine.train_batch(batch=(bad, tgt))
+        assert engine.skipped_steps == 2
+        assert engine.loss_scale == scale0 / 2  # hysteresis exhausted
+
+        # the shift itself restored the hysteresis budget (on_overflow
+        # resets it to delayed_shift when it halves), so after a good step
+        # the next single overflow is absorbed again
+        good = np.random.default_rng(0).standard_normal(
+            (16, 32)).astype(np.float32)
+        engine.train_batch(batch=(good, tgt))
+        assert engine.global_steps == 3
+        engine.train_batch(batch=(bad, tgt))
+        assert engine.loss_scale == scale0 / 2  # absorbed again
+
     def test_static_loss_scale(self):
         engine = make_engine(base_config(
             fp16={"enabled": True, "loss_scale": 128.0}))
@@ -171,7 +203,10 @@ class TestFP16:
 class TestGradClipping:
     def test_clip_applied(self):
         # SGD makes the clip observable directly: |Δp| <= lr * max_norm.
+        # steps_per_print=1: get_global_grad_norm caches its host float at
+        # print cadence (None before the first fetch)
         engine = make_engine(base_config(
+            steps_per_print=1,
             gradient_clipping=1e-4,
             optimizer={"type": "SGD", "params": {"lr": 1.0}}))
         data = np.random.default_rng(0).standard_normal((16, 32)).astype(np.float32)
@@ -182,8 +217,10 @@ class TestGradClipping:
         deltas = [np.abs(a - b).max() for a, b in
                   zip(jax.tree.leaves(p_before), jax.tree.leaves(p_after))]
         assert max(deltas) <= 1e-4 + 1e-7
-        # and the reported (pre-clip) grad norm is large
-        assert float(engine.get_global_grad_norm()) > 1.0
+        # and the reported (pre-clip) grad norm is large — a host float
+        # now (the reference's contract), not a live device array
+        gn = engine.get_global_grad_norm()
+        assert isinstance(gn, float) and gn > 1.0
 
 
 class TestCheckpoint:
