@@ -20,6 +20,9 @@ Config via env:
   BENCH_HEALTH  1 (default) rides the telemetry.health stats inside the
                 timed step and writes HEALTH_BENCH.json; 0 removes the
                 stats epilogue from the compiled program entirely
+  BENCH_GOODPUT 1 (default) arms the wall-clock goodput ledger (host-side
+                only, no ticks inside the timed loop) and writes
+                GOODPUT_BENCH.json; 0 disables it
 """
 
 import json
@@ -270,6 +273,15 @@ def main():
     # on-demand fetch after the rounds for the HEALTH_BENCH.json artifact.
     health_on = telemetry_on and os.environ.get(
         "BENCH_HEALTH", "1").lower() in ("1", "true", "yes")
+    # Goodput ledger: pure host-side wall-clock bookkeeping (a few dict
+    # adds per step, no device syncs). Cadence 0 -> steps_per_print
+    # (pinned to 1e9), so the timed loop never pays a window tick; the
+    # report is forced once after the rounds for GOODPUT_BENCH.json —
+    # the true end-to-end denominator (compile + stalls + warmup)
+    # behind the steady-state headline number. Profiler capture stays
+    # off: an escalation mid-round must not perturb the timed loop.
+    goodput_on = telemetry_on and os.environ.get(
+        "BENCH_GOODPUT", "1").lower() in ("1", "true", "yes")
     bench_dir = os.path.dirname(os.path.abspath(__file__))
     telemetry_dir = os.path.join(bench_dir, "telemetry")
     ds_config = {
@@ -292,7 +304,9 @@ def main():
                       # the post-bench census/MFU cross-check reads the
                       # program that actually ran — zero extra compiles
                       "cost_explorer": {"enabled": True},
-                      "health": {"enabled": health_on}},
+                      "health": {"enabled": health_on},
+                      "goodput": {"enabled": goodput_on,
+                                  "profiler_capture": False}},
     }
     if layered:
         # beyond-HBM training: params streamed from host RAM layer by
@@ -578,6 +592,22 @@ def main():
                             allow_nan=False)
             except Exception as e:   # forensics must never sink a bench
                 print(f"# health artifact unavailable: {e}", flush=True)
+        # goodput ledger artifact: where the run's wall-clock actually
+        # went (compile vs input vs compute), the end-to-end complement
+        # of the steady-state step_time_ms headline
+        if goodput_on and hasattr(engine, "goodput_report"):
+            try:
+                gb = engine.goodput_report()
+                if gb.get("enabled", True) is not False:
+                    with open(os.path.join(bench_dir, "GOODPUT_BENCH.json"),
+                              "w") as f:
+                        json.dump({
+                            "bench": name,
+                            "step_time_ms": round(med_step_ms, 1),
+                            "goodput": gb}, f, indent=1, default=repr,
+                            allow_nan=False)
+            except Exception as e:   # forensics must never sink a bench
+                print(f"# goodput artifact unavailable: {e}", flush=True)
         tel.close()   # forces the final complete trace export
         engine.monitor.close()
         summary = {

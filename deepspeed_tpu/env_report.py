@@ -81,6 +81,14 @@ def telemetry_report():
     row("compile watch (signatures)", True)
     row("health observatory (numerics)", True,
         "(telemetry.health block; HEALTH.json forensics)")
+    row("goodput ledger (wall-clock)", True,
+        "(telemetry.goodput block; GOODPUT.json forensics)")
+    try:
+        from deepspeed_tpu.telemetry.ledger import profiler_available
+        row("jax.profiler programmatic capture", profiler_available(),
+            "(goodput on-anomaly start_trace/stop_trace)")
+    except Exception:
+        row("jax.profiler programmatic capture", False)
     try:
         from jax import monitoring
         row("jax.monitoring listener",

@@ -19,6 +19,7 @@ import jax
 import numpy as np
 
 from deepspeed_tpu.telemetry import trace_span
+from deepspeed_tpu.telemetry.ledger import get_ledger
 from deepspeed_tpu.telemetry.metrics import get_registry
 
 
@@ -26,9 +27,12 @@ def dump_file(obj, path: str, kind: str = "checkpoint") -> int:
     """``pickle.dump`` wrapped in an I/O trace span, with the written
     bytes counted into ``checkpoint_write_bytes_total{kind=...}``. All
     checkpoint writers (engine + this module) route through here so the
-    telemetry byte accounting covers every file of a save."""
-    with trace_span(f"checkpoint/write/{kind}",
-                    path=os.path.basename(path)):
+    telemetry byte accounting covers every file of a save. The goodput
+    ledger books the same interval as ``checkpoint_save`` wall time
+    (nesting-safe under the engine's own checkpoint attribution)."""
+    with get_ledger().attribute("checkpoint_save"), \
+            trace_span(f"checkpoint/write/{kind}",
+                       path=os.path.basename(path)):
         with open(path, "wb") as f:
             pickle.dump(obj, f)
         nbytes = os.path.getsize(path)
@@ -40,8 +44,9 @@ def dump_file(obj, path: str, kind: str = "checkpoint") -> int:
 
 def load_file(path: str, kind: str = "checkpoint"):
     """``pickle.load`` counterpart of ``dump_file`` (read span + bytes)."""
-    with trace_span(f"checkpoint/read/{kind}",
-                    path=os.path.basename(path)):
+    with get_ledger().attribute("checkpoint_load"), \
+            trace_span(f"checkpoint/read/{kind}",
+                       path=os.path.basename(path)):
         with open(path, "rb") as f:
             obj = pickle.load(f)
     get_registry().counter("checkpoint_read_bytes_total",

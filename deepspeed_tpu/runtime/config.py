@@ -93,8 +93,9 @@ class DeepSpeedTelemetryConfig(DeepSpeedConfigObject):
 
     Env overrides (sweep ergonomics, applied after JSON): ``DS_TELEMETRY``
     = 1/0 force-toggles ``enabled``; ``DS_TELEMETRY_DIR`` overrides
-    ``output_path``; ``DS_COST_EXPLORER`` / ``DS_TELEMETRY_HEALTH`` = 1/0
-    force-toggle the cost-explorer / health sub-blocks."""
+    ``output_path``; ``DS_COST_EXPLORER`` / ``DS_TELEMETRY_HEALTH`` /
+    ``DS_TELEMETRY_GOODPUT`` = 1/0 force-toggle the cost-explorer /
+    health / goodput sub-blocks."""
 
     def __init__(self, param_dict):
         t = param_dict.get(C.TELEMETRY, {}) or {}
@@ -165,6 +166,35 @@ class DeepSpeedTelemetryConfig(DeepSpeedConfigObject):
                                           C.HEALTH_SNAPSHOT_FILE_DEFAULT)
         self.health_trace_on_anomaly = h.get(
             C.HEALTH_TRACE_ON_ANOMALY, C.HEALTH_TRACE_ON_ANOMALY_DEFAULT)
+        # goodput sub-block (telemetry/ledger.py): wall-clock goodput/
+        # badput attribution + GOODPUT.json forensics + on-anomaly
+        # profiler capture. Flattened onto goodput_* attributes.
+        g = t.get(C.TELEMETRY_GOODPUT, {}) or {}
+        self.goodput_enabled = g.get(C.GOODPUT_ENABLED,
+                                     C.GOODPUT_ENABLED_DEFAULT)
+        self.goodput_cadence = g.get(C.GOODPUT_CADENCE,
+                                     C.GOODPUT_CADENCE_DEFAULT)
+        self.goodput_input_wait_frac = g.get(
+            C.GOODPUT_INPUT_WAIT_FRAC, C.GOODPUT_INPUT_WAIT_FRAC_DEFAULT)
+        self.goodput_unattributed_frac = g.get(
+            C.GOODPUT_UNATTRIBUTED_FRAC,
+            C.GOODPUT_UNATTRIBUTED_FRAC_DEFAULT)
+        self.goodput_warmup_windows = g.get(
+            C.GOODPUT_WARMUP_WINDOWS, C.GOODPUT_WARMUP_WINDOWS_DEFAULT)
+        self.goodput_window_ring = g.get(C.GOODPUT_WINDOW_RING,
+                                         C.GOODPUT_WINDOW_RING_DEFAULT)
+        self.goodput_snapshot_file = g.get(C.GOODPUT_SNAPSHOT_FILE,
+                                           C.GOODPUT_SNAPSHOT_FILE_DEFAULT)
+        self.goodput_profiler_capture = g.get(
+            C.GOODPUT_PROFILER_CAPTURE, C.GOODPUT_PROFILER_CAPTURE_DEFAULT)
+        self.goodput_profiler_capture_steps = g.get(
+            C.GOODPUT_PROFILER_CAPTURE_STEPS,
+            C.GOODPUT_PROFILER_CAPTURE_STEPS_DEFAULT)
+        self.goodput_profiler_max_captures = g.get(
+            C.GOODPUT_PROFILER_MAX_CAPTURES,
+            C.GOODPUT_PROFILER_MAX_CAPTURES_DEFAULT)
+        self.goodput_profiler_dir = g.get(C.GOODPUT_PROFILER_DIR,
+                                          C.GOODPUT_PROFILER_DIR_DEFAULT)
         env = os.environ.get("DS_TELEMETRY")
         if env is not None:
             self.enabled = env.lower() in ("1", "true", "yes", "on")
@@ -178,6 +208,10 @@ class DeepSpeedTelemetryConfig(DeepSpeedConfigObject):
         env_h = os.environ.get("DS_TELEMETRY_HEALTH")
         if env_h is not None:
             self.health_enabled = env_h.lower() in ("1", "true", "yes", "on")
+        env_g = os.environ.get("DS_TELEMETRY_GOODPUT")
+        if env_g is not None:
+            self.goodput_enabled = env_g.lower() in ("1", "true", "yes",
+                                                     "on")
 
 
 class DeepSpeedFlopsProfilerConfig(DeepSpeedConfigObject):

@@ -170,6 +170,37 @@ HEALTH_SNAPSHOT_FILE_DEFAULT = ""
 HEALTH_TRACE_ON_ANOMALY = "trace_on_anomaly"
 HEALTH_TRACE_ON_ANOMALY_DEFAULT = True
 
+# telemetry.goodput: wall-clock goodput/badput ledger (telemetry/ledger.py).
+# When enabled the host decomposes every second of the run into named
+# categories (device_compute, compile, input_wait, host_dispatch,
+# checkpoint_save/load, eval, overflow_skipped, unattributed residual)
+# that sum to elapsed wall time; window rules escalate warn -> GOODPUT.json
+# snapshot -> optional bounded programmatic jax.profiler capture. Pure
+# host-side arithmetic: zero added host<->device syncs.
+TELEMETRY_GOODPUT = "goodput"
+GOODPUT_ENABLED = "enabled"
+GOODPUT_ENABLED_DEFAULT = False
+GOODPUT_CADENCE = "cadence"                 # window ticks; 0 -> steps_per_print
+GOODPUT_CADENCE_DEFAULT = 0
+GOODPUT_INPUT_WAIT_FRAC = "input_wait_frac"  # window fraction -> input_stall
+GOODPUT_INPUT_WAIT_FRAC_DEFAULT = 0.25
+GOODPUT_UNATTRIBUTED_FRAC = "unattributed_frac"
+GOODPUT_UNATTRIBUTED_FRAC_DEFAULT = 0.5
+GOODPUT_WARMUP_WINDOWS = "warmup_windows"   # windows before rules arm
+GOODPUT_WARMUP_WINDOWS_DEFAULT = 1
+GOODPUT_WINDOW_RING = "window_ring"         # per-window ring buffer size
+GOODPUT_WINDOW_RING_DEFAULT = 128
+GOODPUT_SNAPSHOT_FILE = "snapshot_file"     # "" -> <output_path>/GOODPUT.json
+GOODPUT_SNAPSHOT_FILE_DEFAULT = ""
+GOODPUT_PROFILER_CAPTURE = "profiler_capture"
+GOODPUT_PROFILER_CAPTURE_DEFAULT = True
+GOODPUT_PROFILER_CAPTURE_STEPS = "profiler_capture_steps"
+GOODPUT_PROFILER_CAPTURE_STEPS_DEFAULT = 5
+GOODPUT_PROFILER_MAX_CAPTURES = "profiler_max_captures"  # per run
+GOODPUT_PROFILER_MAX_CAPTURES_DEFAULT = 1
+GOODPUT_PROFILER_DIR = "profiler_dir"       # "" -> <output_path>/goodput_profile
+GOODPUT_PROFILER_DIR_DEFAULT = ""
+
 # Checkpoint
 CHECKPOINT = "checkpoint"
 CHECKPOINT_TAG_VALIDATION = "tag_validation"

@@ -9,17 +9,34 @@ batch — the engine shards it over the mesh's data axis with
 multi-host setup each process loads only its host's slice
 (``process_index``-strided sampling), matching DistributedSampler
 semantics.
+
+Both loaders are instrumented for the goodput ledger
+(``telemetry/ledger.py``): time a consumer spends blocked in ``next()``
+is attributed to the ``input_wait`` wall-clock category. Without an
+installed ledger the instrumentation is a shared no-op context manager.
 """
 
 import numpy as np
 
+from deepspeed_tpu.telemetry.ledger import GoodputIterator, get_ledger
+
 
 class RepeatingLoader:
-    """Wrap an iterator to restart on StopIteration (reference :10)."""
+    """Wrap an iterator to restart on StopIteration (reference :10).
+
+    On wrap-around the underlying loader's epoch is ADVANCED first (via
+    ``set_epoch`` when it has one) — re-iterating a shuffling
+    ``DeepSpeedDataLoader`` without it would replay the identical
+    permutation every epoch (the reference relies on the training script
+    calling ``DistributedSampler.set_epoch``; a repeating wrapper is
+    exactly the place no script can do it)."""
 
     def __init__(self, loader):
         self.loader = loader
         self.data_iter = iter(self.loader)
+        # continue from the wrapped loader's own epoch counter when it
+        # has one (a resumed loader must not restart the shuffle stream)
+        self.epoch = int(getattr(loader, "epoch", 0))
 
     def __iter__(self):
         return self
@@ -28,11 +45,16 @@ class RepeatingLoader:
         return len(self.loader)
 
     def __next__(self):
-        try:
-            batch = next(self.data_iter)
-        except StopIteration:
-            self.data_iter = iter(self.loader)
-            batch = next(self.data_iter)
+        with get_ledger().attribute("input_wait"):
+            try:
+                batch = next(self.data_iter)
+            except StopIteration:
+                self.epoch += 1
+                set_epoch = getattr(self.loader, "set_epoch", None)
+                if set_epoch is not None:
+                    set_epoch(self.epoch)
+                self.data_iter = iter(self.loader)
+                batch = next(self.data_iter)
         return batch
 
 
@@ -68,6 +90,12 @@ class DeepSpeedDataLoader:
         return self.len
 
     def __iter__(self):
+        # GoodputIterator times only the consumer's next() calls; timing
+        # inside the generator would also count the consumer's own work
+        # between batches (the generator is suspended across it)
+        return GoodputIterator(self._iter_batches())
+
+    def _iter_batches(self):
         n = len(self.dataset)
         if self.data_sampler is not None:
             # a user sampler already yields THIS process's indices

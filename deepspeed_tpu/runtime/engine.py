@@ -29,6 +29,7 @@ Checkpoint layout keeps the reference's file naming
 the zero_to_fp32 converter work unchanged.
 """
 
+import contextlib
 import os
 import pickle
 import time
@@ -65,6 +66,10 @@ STEP_GLOBAL_TIMER = "step"
 MODEL_FILE_SUFFIX = "_model_states.pt"
 OPTIM_FILE_SUFFIX = "_optim_states.pt"
 LATEST_FILE = "latest"
+
+# shared no-op for the goodput-disabled ledger paths (nullcontext holds no
+# state, so one instance can nest/re-enter freely)
+_NULL_CTX = contextlib.nullcontext()
 
 
 class TrainState(NamedTuple):
@@ -431,6 +436,15 @@ class DeepSpeedEngine:
         self.telemetry = TelemetryManager(self.config.telemetry,
                                           rank=dist.get_rank())
 
+        # ---- goodput ledger (telemetry/ledger.py) -------------------------
+        # Host-side wall-clock attribution only — it never changes the
+        # compiled programs and never syncs the device, so (unlike the
+        # health stats variant) rank-0-only gating through the manager is
+        # safe. None when disabled; every call site is None-checked.
+        self._goodput = getattr(self.telemetry, "goodput", None)
+        self._goodput_cadence = int(
+            getattr(self.config.telemetry, "goodput_cadence", 0) or 0)
+
         # ---- cost explorer (telemetry/cost_explorer.py) -------------------
         # gated on the CONFIG (not the rank-0-only manager) so every rank
         # dispatches through the same _AOTStep code path; census gauges and
@@ -503,6 +517,12 @@ class DeepSpeedEngine:
             batch_size=self.train_micro_batch_size_per_gpu() * self.dp_world_size,
             steps_per_output=self.steps_per_print())
         self._breakdown_steps = 0  # global steps since the last breakdown log
+        if self._goodput is not None:
+            # the goodput report's wall_clock_breakdown section reads the
+            # SAME recorded timer intervals the breakdown log prints, so
+            # the two reports cannot disagree (satellite: one step loop,
+            # one timing system)
+            self._goodput.breakdown_fn = self._breakdown_summary
         if self.wall_clock_breakdown():
             log_dist(
                 "wall_clock_breakdown: XLA fuses forward+backward into one "
@@ -1498,8 +1518,9 @@ class DeepSpeedEngine:
         # are this engine's cardinal sin. The loss is the last dispatched
         # micro/fused loss (the fused path's loss IS the global loss;
         # under gas>1 it is the last micro's).
-        stats, loss_arr = jax.device_get(
-            (self._pending_health_stats, self._health_last_loss))
+        with self._led_attr("device_compute"):
+            stats, loss_arr = jax.device_get(
+                (self._pending_health_stats, self._health_last_loss))
         loss = (float(np.asarray(loss_arr))
                 if loss_arr is not None else None)
         sample = {
@@ -1553,6 +1574,58 @@ class DeepSpeedEngine:
             mon.write_snapshot(force=True)
         return mon.report()
 
+    # --------------------------------------------------- goodput ledger
+    def _led_attr(self, category):
+        """Goodput wall-clock attribution context for *category*; the
+        shared no-op when the ledger is off (sub-µs, like trace_span)."""
+        led = self._goodput
+        if led is None:
+            return _NULL_CTX
+        return led.attribute(category)
+
+    def _breakdown_summary(self):
+        """The goodput report's ``wall_clock_breakdown`` section, read
+        from the SAME recorded timer intervals the breakdown log prints
+        (``timer_<phase>_ms`` histograms) — one step loop, one timing
+        system, two views that cannot disagree."""
+        if not self.wall_clock_breakdown():
+            return None
+        reg = self.telemetry.registry
+        if reg is None:
+            return None
+        phases = {}
+        families = reg.collect()
+        for name in (FORWARD_GLOBAL_TIMER, BACKWARD_GLOBAL_TIMER,
+                     STEP_GLOBAL_TIMER):
+            fam = families.get(f"timer_{name}_ms")
+            if not fam:
+                continue
+            h = fam[0]
+            phases[name] = {"total_ms": round(h.sum, 3), "count": h.count}
+        return {
+            "note": "recorded by the wall_clock_breakdown timers; the "
+                    "synced phase intervals are attributed to the "
+                    "ledger's device_compute category",
+            "phases": phases,
+        }
+
+    def goodput_report(self, write=False):
+        """The wall-clock goodput ledger report (what ``GOODPUT.json``
+        holds): per-category seconds summing to elapsed wall time,
+        goodput fraction, per-window ring, badput anomalies and the
+        profiler-capture state. Closes the current partial window first
+        so the report is current. ``write=True`` also writes the
+        snapshot file. ``{"enabled": False}`` when ``telemetry.goodput``
+        is off or this is not rank 0."""
+        led = self._goodput
+        if led is None or not led.enabled:
+            return {"enabled": False}
+        led.tick(self.global_steps, force=True)
+        report = led.report()
+        if write:
+            led.write_snapshot(force=True, report=report)
+        return report
+
     def _lr_fn_traced(self, step):
         """LR schedule on a traced step: the four built-in schedules are
         written in jnp so they compile straight into the apply step."""
@@ -1585,16 +1658,20 @@ class DeepSpeedEngine:
             self.progressive_layer_drop.get_theta()
             if self.progressive_layer_drop is not None else 1.0)
         breakdown = self.wall_clock_breakdown()
-        if breakdown:
-            self.timers(FORWARD_GLOBAL_TIMER).start()
-        with self.telemetry.span("forward", micro_step=self.micro_steps):
-            with self.mesh:
-                batch = self._globalize_batch(batch)
-                self.state, loss = self._jit_micro(
-                    self.state, batch, self._next_rng(), theta)
-        if breakdown:
-            jax.block_until_ready(loss)
-            self.timers(FORWARD_GLOBAL_TIMER).stop(record=True)
+        # goodput: with the breakdown syncs on, this region is device-bound
+        # wall time (the block_until_ready wait); async, it is dispatch
+        with self._led_attr("device_compute" if breakdown
+                            else "host_dispatch"):
+            if breakdown:
+                self.timers(FORWARD_GLOBAL_TIMER).start()
+            with self.telemetry.span("forward", micro_step=self.micro_steps):
+                with self.mesh:
+                    batch = self._globalize_batch(batch)
+                    self.state, loss = self._jit_micro(
+                        self.state, batch, self._next_rng(), theta)
+            if breakdown:
+                jax.block_until_ready(loss)
+                self.timers(FORWARD_GLOBAL_TIMER).stop(record=True)
         self._pending_loss = loss
         self._last_batch = batch
         if self._health_on:
@@ -1791,21 +1868,24 @@ class DeepSpeedEngine:
         if not self.is_gradient_accumulation_boundary():
             return
         breakdown = self.wall_clock_breakdown()
-        if breakdown:
-            self.timers(STEP_GLOBAL_TIMER).start()
-        with self.telemetry.span("step", global_step=self.global_steps):
-            if self._offload:
-                grad_norm, overflow = self._offload_step()
-            elif self._health_on:
-                self.state, grad_norm, overflow, stats = self._jit_apply(
-                    self.state)
-                self._pending_health_stats = stats   # device refs only
-            else:
-                self.state, grad_norm, overflow = self._jit_apply(self.state)
-        if breakdown:
-            jax.block_until_ready(self.state.step)
-            self.timers(STEP_GLOBAL_TIMER).stop(record=True)
-        self._post_apply(grad_norm, overflow, lr_kwargs)
+        with self._led_attr("device_compute" if breakdown
+                            else "host_dispatch"):
+            if breakdown:
+                self.timers(STEP_GLOBAL_TIMER).start()
+            with self.telemetry.span("step", global_step=self.global_steps):
+                if self._offload:
+                    grad_norm, overflow = self._offload_step()
+                elif self._health_on:
+                    self.state, grad_norm, overflow, stats = self._jit_apply(
+                        self.state)
+                    self._pending_health_stats = stats   # device refs only
+                else:
+                    self.state, grad_norm, overflow = self._jit_apply(
+                        self.state)
+            if breakdown:
+                jax.block_until_ready(self.state.step)
+                self.timers(STEP_GLOBAL_TIMER).stop(record=True)
+            self._post_apply(grad_norm, overflow, lr_kwargs)
 
     def _post_apply(self, grad_norm, overflow, lr_kwargs=None):
         """Host bookkeeping after an applied (or skipped) optimizer step."""
@@ -1817,8 +1897,11 @@ class DeepSpeedEngine:
         self.global_samples += self.train_batch_size()
         # only fp16 can overflow; skipping the device_get elsewhere keeps
         # the train loop free of a per-step host sync
-        overflowed = (bool(jax.device_get(overflow))
-                      if self.config.fp16_enabled else False)
+        if self.config.fp16_enabled:
+            with self._led_attr("device_compute"):
+                overflowed = bool(jax.device_get(overflow))
+        else:
+            overflowed = False
         if self.quantizer is not None:
             # MoQ: progressive fake-quantization of the trained params
             # (reference _take_model_step hook, engine.py:1816-1827 —
@@ -1849,6 +1932,19 @@ class DeepSpeedEngine:
                 f"{self.loss_scale}", ranks=[0])
         elif self.lr_scheduler is not None:
             self.lr_scheduler.step(**(lr_kwargs or {}))
+        led = self._goodput
+        if led is not None:
+            if overflowed:
+                # the step just burned by the fp16 skip: re-label its
+                # still-open wall-clock interval (the train_batch / step
+                # wrapper) from good time to overflow_skipped badput
+                led.reclassify_open("overflow_skipped")
+            led.note_step(self.global_steps, overflowed)
+            cad = self._goodput_cadence or self.steps_per_print()
+            if self.global_steps % cad == 0:
+                # pure host arithmetic — closes a ledger window, runs the
+                # badput rules; never touches the device
+                led.tick(self.global_steps)
         mon = self.telemetry.health
         if mon is not None and self._health_on:
             # host-only per-step facts (overflow streaks are exact, not
@@ -1866,7 +1962,11 @@ class DeepSpeedEngine:
 
     def _fused_train_batch(self, data_iter, batch):
         """gas=1 fast path: one fused compiled program per global step."""
-        micro = batch if batch is not None else next(data_iter)
+        if batch is not None:
+            micro = batch
+        else:
+            with self._led_attr("input_wait"):
+                micro = next(data_iter)
         if self.curriculum_scheduler is not None:
             micro = self._apply_curriculum(micro)
         if self.progressive_layer_drop is not None:
@@ -1898,10 +1998,20 @@ class DeepSpeedEngine:
         if not tel.enabled:
             return self._train_batch(data_iter, batch)
         t0 = time.perf_counter()
-        with tel.span("train_batch", global_step=self.global_steps):
-            mean_loss = self._train_batch(data_iter, batch)
-        self._publish_step_telemetry(mean_loss,
-                                     time.perf_counter() - t0)
+        # goodput: the whole step interval is host_dispatch SELF time —
+        # nested attributions (input_wait in next(), compile via the
+        # backend listener, the print-cadence device fetches) subtract
+        # themselves out; an fp16 overflow re-labels it in _post_apply.
+        # Step boundary FIRST: the previous step's trailing intervals
+        # (its wrapper, the publish fetch) booked after its note_step,
+        # and must not be sweepable by THIS step's overflow.
+        if self._goodput is not None:
+            self._goodput.mark_step_begin()
+        with self._led_attr("host_dispatch"):
+            with tel.span("train_batch", global_step=self.global_steps):
+                mean_loss = self._train_batch(data_iter, batch)
+            self._publish_step_telemetry(mean_loss,
+                                         time.perf_counter() - t0)
         return mean_loss
 
     def _tokens_per_sample(self):
@@ -1936,13 +2046,15 @@ class DeepSpeedEngine:
             self._first_step_time_ms = step_s * 1000.0
         if self.global_steps % self.steps_per_print() != 0:
             return
-        reg.gauge("train_loss", "loss at the last print step").set(
-            float(jax.device_get(mean_loss)))
+        with self._led_attr("device_compute"):
+            # the one blocking loss fetch of the print cadence
+            reg.gauge("train_loss", "loss at the last print step").set(
+                float(jax.device_get(mean_loss)))
+            if self.config.fp16_enabled:
+                reg.gauge("train_loss_scale", "dynamic loss scale").set(
+                    self.loss_scale)
         reg.gauge("train_lr", "lr of the next applied step").set(
             self.get_lr()[0])
-        if self.config.fp16_enabled:
-            reg.gauge("train_loss_scale", "dynamic loss scale").set(
-                self.loss_scale)
         if self._last_grad_norm is not None:
             # already a host float — _post_apply cached it at this cadence
             reg.gauge("train_grad_norm",
@@ -1977,7 +2089,8 @@ class DeepSpeedEngine:
                     micro = batch
                 else:
                     assert data_iter is not None
-                    micro = next(data_iter)
+                    with self._led_attr("input_wait"):
+                        micro = next(data_iter)
                 loss = self.forward(micro)
                 self.backward(loss)
                 losses.append(loss)
@@ -1985,8 +2098,12 @@ class DeepSpeedEngine:
             self.tput_timer.stop(global_step=True)
             mean_loss = jnp.mean(jnp.stack(losses))
         if self.global_steps % self.steps_per_print() == 0:
-            log_dist(f"step={self.global_steps} loss={float(mean_loss):.6f} "
-                     f"lr={self.get_lr()[0]:.3e}", ranks=[0])
+            # float(mean_loss) is a blocking device fetch: wall time spent
+            # here is the device catching up — good time, device_compute
+            with self._led_attr("device_compute"):
+                log_dist(
+                    f"step={self.global_steps} loss={float(mean_loss):.6f} "
+                    f"lr={self.get_lr()[0]:.3e}", ranks=[0])
         if profiling:
             # one-shot at profile_step (reference engine.py:1722-1952):
             # attribute the just-traced step's flops per module and print
@@ -2022,22 +2139,27 @@ class DeepSpeedEngine:
             # print cadence: the reference writes per step, but
             # float(mean_loss)/loss_scale force a host<->device sync and
             # per-step syncs are this engine's cardinal sin (see the
-            # round-3/4 advisories) — the print step already pays it
-            self.monitor.write_events([
-                ("Train/Samples/train_loss", float(mean_loss),
-                 self.global_samples),
-                ("Train/Samples/lr", self.get_lr()[0], self.global_samples),
-                ("Train/Samples/loss_scale", self.loss_scale,
-                 self.global_samples),
-                # host-side counter that was computed but never exported
-                # (reference writes it via its monitor at the same point)
-                ("Train/Samples/skipped_steps", float(self.skipped_steps),
-                 self.global_samples),
-            ])
+            # round-3/4 advisories) — the print step already pays it.
+            # float(mean_loss)/loss_scale block on the device: goodput
+            # books the wait as device_compute
+            with self._led_attr("device_compute"):
+                self.monitor.write_events([
+                    ("Train/Samples/train_loss", float(mean_loss),
+                     self.global_samples),
+                    ("Train/Samples/lr", self.get_lr()[0],
+                     self.global_samples),
+                    ("Train/Samples/loss_scale", self.loss_scale,
+                     self.global_samples),
+                    # host-side counter that was computed but never
+                    # exported (reference writes it via its monitor at
+                    # the same point)
+                    ("Train/Samples/skipped_steps",
+                     float(self.skipped_steps), self.global_samples),
+                ])
         return mean_loss
 
     def eval_batch(self, batch):
-        with self.telemetry.span("eval_batch"):
+        with self._led_attr("eval"), self.telemetry.span("eval_batch"):
             with self.mesh:
                 batch = self._globalize_batch(batch, for_train=False)
                 return self._jit_eval(self.state.params, batch)
@@ -2087,7 +2209,8 @@ class DeepSpeedEngine:
         import deepspeed_tpu.comm as dist
         if tag is None:
             tag = f"global_step{self.global_steps}"
-        with self.telemetry.span("checkpoint/save", tag=str(tag)):
+        with self._led_attr("checkpoint_save"), \
+                self.telemetry.span("checkpoint/save", tag=str(tag)):
             return self._save_checkpoint(save_dir, tag, client_state,
                                          save_latest)
 
@@ -2198,7 +2321,8 @@ class DeepSpeedEngine:
         from deepspeed_tpu.runtime import checkpoint_io
         import glob as _glob
         path = self._get_ckpt_name(load_dir, tag)
-        with self.telemetry.span("checkpoint/load", tag=str(tag)):
+        with self._led_attr("checkpoint_load"), \
+                self.telemetry.span("checkpoint/load", tag=str(tag)):
             sd = checkpoint_io.load_file(path, kind="model_states")
             zero_paths = sorted(_glob.glob(os.path.join(
                 load_dir, str(tag), "zero_pp_rank_*" + OPTIM_FILE_SUFFIX)))
@@ -2334,7 +2458,8 @@ class DeepSpeedEngine:
         from deepspeed_tpu.runtime import checkpoint_io
         os.makedirs(save_dir, exist_ok=True)
         if dist.get_rank() == 0:
-            with self.telemetry.span("checkpoint/save_16bit_model"):
+            with self._led_attr("checkpoint_save"), \
+                    self.telemetry.span("checkpoint/save_16bit_model"):
                 checkpoint_io.dump_file(
                     self._consolidated_16bit_state_dict(),
                     os.path.join(save_dir, save_filename), kind="bit16")

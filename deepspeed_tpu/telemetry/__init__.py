@@ -17,7 +17,11 @@ Four pieces (see the per-module docstrings):
 * ``health`` — training-health observatory: in-step numerics stats
   (grad/param/update norms, per-module buckets, loss-scale state,
   non-finite provenance), EWMA/z-score anomaly rules, HEALTH.json
-  forensics (``python -m deepspeed_tpu.telemetry.health`` is the CLI).
+  forensics (``python -m deepspeed_tpu.telemetry.health`` is the CLI);
+* ``ledger`` — goodput ledger: wall-clock attribution into named
+  categories that sum to elapsed time, input-stall / unattributed-
+  residual rules, GOODPUT.json forensics and on-anomaly programmatic
+  profiler capture (``python -m deepspeed_tpu.telemetry.ledger``).
 
 ``TelemetryManager`` (manager.py) wires them per engine run, behind the
 ``telemetry`` config block (see CONFIG.md). Everything is importable and
@@ -45,6 +49,8 @@ from deepspeed_tpu.telemetry.health import (BucketSpec, HealthMonitor,
                                             bucket_grad_stats,
                                             build_bucket_spec,
                                             decode_nonfinite_mask)
+from deepspeed_tpu.telemetry.ledger import (GoodputIterator, GoodputLedger,
+                                            get_ledger, set_ledger)
 from deepspeed_tpu.telemetry.manager import TelemetryManager
 
 __all__ = [
@@ -58,4 +64,5 @@ __all__ = [
     "CostExplorer", "detect_chip",
     "BucketSpec", "HealthMonitor", "bucket_grad_stats",
     "build_bucket_spec", "decode_nonfinite_mask",
+    "GoodputIterator", "GoodputLedger", "get_ledger", "set_ledger",
 ]

@@ -26,6 +26,7 @@ still move the ``xla_compiles_total`` counter.
 
 import functools
 
+from deepspeed_tpu.telemetry import ledger as _ledger
 from deepspeed_tpu.telemetry import metrics as _metrics
 from deepspeed_tpu.utils.logging import logger
 
@@ -177,6 +178,17 @@ def install_global_listener(registry):
                             ).inc()
                 reg.counter("xla_backend_compile_seconds_total",
                             "time spent in XLA compilation").inc(duration)
+                # goodput ledger: the same measured seconds move from the
+                # enclosing interval (the dispatching step) into the
+                # 'compile' wall-clock category — a no-op unless a
+                # TelemetryManager installed an enabled ledger. BACKEND
+                # compiles only: the jaxpr-trace / mlir-lowering phase
+                # events NEST (a sub-jaxpr's trace fires inside the
+                # outer one), so summing every 'compile' event would
+                # double-book wall time and drive the ledger's residual
+                # negative.
+                if "backend_compile" in event:
+                    _ledger.get_ledger().observe_compile(duration)
             except Exception:
                 pass
 

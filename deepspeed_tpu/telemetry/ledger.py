@@ -1,0 +1,825 @@
+"""Goodput ledger — wall-clock attribution + input-stall forensics.
+
+The cost explorer (PR 2) explains what a step *costs* and the health
+observatory (PR 3) whether training is *numerically healthy*; this module
+explains **where the wall-clock goes**. Every second of host wall time
+since the ledger armed is decomposed into named categories:
+
+==================  =======================================================
+``device_compute``  host blocked waiting on device results (the print-
+                    cadence loss fetch, health-stats fetch, the
+                    wall_clock_breakdown phase syncs) — the device was
+                    the bottleneck, which is GOOD time
+``host_dispatch``   executing the train loop's Python: tracing, dispatch,
+                    bookkeeping (also good — steps are being made)
+``compile``         XLA backend compilation (fed by the compile watch's
+                    ``jax.monitoring`` listener; persistent-cache hits
+                    arrive as negative durations and are skipped)
+``input_wait``      blocked in ``next(data_iter)`` — an input-bound run
+``checkpoint_save`` / ``checkpoint_load`` — checkpoint I/O pauses
+``eval``            evaluation batches
+``overflow_skipped`` steps burned by an fp16 overflow skip (the step's
+                    wall time is *re-classified* here by the engine)
+``unattributed``    the residual — categories ALWAYS sum to elapsed wall
+                    time by construction (the residual is what is left)
+==================  =======================================================
+
+Attribution is a nesting-aware interval stack (:meth:`GoodputLedger.
+attribute`): a nested interval's time is excluded from its parent's
+self-time, so wrapping ``next(data_iter)`` inside the step wrapper books
+the wait to ``input_wait``, not twice. Everything is host-side wall-clock
+arithmetic — the ledger NEVER touches the device and adds zero
+host<->device syncs (guarded in ``tests/perf/telemetry_overhead.py``).
+
+Escalation mirrors the health observatory: at each window ``tick`` (the
+engine drives it at ``telemetry.goodput.cadence``, default
+``steps_per_print``) the per-window breakdown lands in a ring buffer and
+the rules run — ``input_stall`` (window ``input_wait`` fraction over
+threshold) and ``unattributed_residual``. A firing rule warns once,
+snapshots ``GOODPUT.json`` (ring + verdict naming the dominant badput
+category), and can trigger ONE bounded programmatic ``jax.profiler``
+capture (``start_trace``/``stop_trace`` around the next N steps,
+rate-limited per run) so the evidence is collected *in the failing run*.
+
+CLI: ``python -m deepspeed_tpu.telemetry.ledger --render GOODPUT.json``
+pretty-prints a snapshot; ``--demo`` builds a tiny engine, injects a
+sleep into the data iterator and writes the resulting ledger (the
+committed repo-root ``GOODPUT.json`` example).
+"""
+
+import json
+import os
+import threading
+import time
+from collections import deque
+
+from deepspeed_tpu.utils.logging import logger
+
+GOODPUT_SCHEMA = "deepspeed_tpu.goodput/1"
+
+CATEGORIES = (
+    "device_compute", "compile", "input_wait", "host_dispatch",
+    "checkpoint_save", "checkpoint_load", "eval", "overflow_skipped",
+    "unattributed",
+)
+# the goodput numerator: time spent making training progress. Everything
+# else — compile, input waits, checkpoint pauses, eval, burned steps and
+# the unexplained residual — is badput.
+GOOD_CATEGORIES = frozenset({"device_compute", "host_dispatch"})
+
+RULE_SEVERITY = {
+    "input_stall": "warning",
+    "unattributed_residual": "watch",
+}
+
+
+class _NullAttr:
+    """Shared no-op interval for the disabled ledger (the hot path)."""
+    __slots__ = ()
+    category = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_ATTR = _NullAttr()
+
+
+class _Attr:
+    """One open attribution interval. ``category`` is mutable until exit —
+    the engine re-classifies a finished-but-overflowed step's interval to
+    ``overflow_skipped`` before it closes."""
+    __slots__ = ("_ledger", "category", "_t0", "_child")
+
+    def __init__(self, ledger, category):
+        self._ledger = ledger
+        self.category = category
+        self._child = 0.0
+
+    def __enter__(self):
+        self._t0 = self._ledger._clock()
+        self._ledger._stack().append(self)
+        return self
+
+    def __exit__(self, *exc):
+        t1 = self._ledger._clock()
+        stack = self._ledger._stack()
+        if stack and stack[-1] is self:
+            stack.pop()
+        else:                      # unbalanced exit: drop up to self
+            while stack and stack[-1] is not self:
+                stack.pop()
+            if stack:
+                stack.pop()
+        dt = max(0.0, t1 - self._t0)
+        self._ledger._add(self.category, max(0.0, dt - self._child))
+        if stack:
+            stack[-1]._child += dt
+        return False
+
+
+class GoodputIterator:
+    """Wrap any iterator so time blocked in ``next()`` is attributed to
+    ``input_wait``. With no explicit ledger the process-global one is
+    resolved per call (so a later ``set_ledger`` takes effect)."""
+    __slots__ = ("_it", "_ledger")
+
+    def __init__(self, it, ledger=None):
+        self._it = iter(it)
+        self._ledger = ledger
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        led = self._ledger if self._ledger is not None else _GLOBAL
+        with led.attribute("input_wait"):
+            return next(self._it)
+
+
+def profiler_available():
+    """Can this jax do programmatic trace capture?"""
+    try:
+        from jax import profiler
+        return (hasattr(profiler, "start_trace")
+                and hasattr(profiler, "stop_trace"))
+    except Exception:
+        return False
+
+
+def _start_trace(logdir):            # split out for tests to monkeypatch
+    from jax import profiler
+    profiler.start_trace(logdir)
+
+
+def _stop_trace():
+    from jax import profiler
+    profiler.stop_trace()
+
+
+class GoodputLedger:
+    """Host-side wall-clock ledger. See the module docstring.
+
+    Invariant: ``sum(totals().values()) == elapsed()`` — ``unattributed``
+    is computed as the residual, never measured. Disabled instances are
+    inert: ``attribute`` returns one shared no-op context manager and
+    every other surface returns immediately.
+    """
+
+    SNAPSHOT_MIN_INTERVAL_S = 5.0
+    MAX_ANOMALY_HISTORY = 100
+
+    def __init__(self, enabled=True, job_name="",
+                 snapshot_path="GOODPUT.json", cadence=0,
+                 input_wait_frac=0.25, unattributed_frac=0.5,
+                 warmup_windows=1, window_ring=128,
+                 profiler_capture=True, profiler_capture_steps=5,
+                 profiler_max_captures=1, profiler_dir="goodput_profile",
+                 registry=None, on_escalate=None, log_fn=None):
+        self.enabled = bool(enabled)
+        self.job_name = job_name
+        self.snapshot_path = snapshot_path
+        self.cadence = int(cadence)
+        self.input_wait_frac = float(input_wait_frac)
+        self.unattributed_frac = float(unattributed_frac)
+        self.warmup_windows = int(warmup_windows)
+        self.profiler_capture = bool(profiler_capture)
+        self.profiler_capture_steps = int(profiler_capture_steps)
+        self.profiler_max_captures = int(profiler_max_captures)
+        self.profiler_dir = profiler_dir
+        self.registry = registry
+        self.on_escalate = on_escalate
+        self.breakdown_fn = None     # engine wires wall_clock_breakdown
+        self._log = log_fn or logger.warning
+        self._clock = time.monotonic
+        if not self.enabled:
+            return
+
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        self._t_start = self._clock()
+        self._totals = {c: 0.0 for c in CATEGORIES if c != "unattributed"}
+        # good seconds booked since the last note_step: an overflow-
+        # skipped step transfers them to overflow_skipped, so the burned
+        # micro-batch work of a gas>1 step doesn't inflate goodput
+        self._step_good = {c: 0.0 for c in GOOD_CATEGORIES}
+        self.ring = deque(maxlen=max(1, int(window_ring)))
+        self.anomalies = []
+        self.rule_counts = {}
+        self.steps_seen = 0
+        self.overflow_steps = 0
+        self.windows_closed = 0      # cadence (unforced) windows only
+        self._window_seq = 0         # every window, forced included
+        self.last_window = None
+        self._win_totals = dict(self._totals)
+        self._win_elapsed = 0.0
+        self._snapshots_written = 0
+        self._last_snapshot_t = float("-inf")
+        self._capture_active = False
+        self._captures_done = 0
+        self._capture_stop_after = -1
+        self._capture_warned = False
+
+    @classmethod
+    def from_config(cls, tconfig, output_path="telemetry/", job_name="",
+                    registry=None, on_escalate=None):
+        """Build from a parsed ``DeepSpeedTelemetryConfig``'s
+        ``goodput_*`` fields."""
+        snap = getattr(tconfig, "goodput_snapshot_file", "") \
+            or "GOODPUT.json"
+        if not os.path.isabs(snap):
+            snap = os.path.join(output_path or ".", snap)
+        pdir = getattr(tconfig, "goodput_profiler_dir", "") \
+            or os.path.join(output_path or ".", "goodput_profile")
+        return cls(
+            enabled=True,
+            job_name=job_name,
+            snapshot_path=snap,
+            cadence=getattr(tconfig, "goodput_cadence", 0),
+            input_wait_frac=getattr(tconfig, "goodput_input_wait_frac",
+                                    0.25),
+            unattributed_frac=getattr(tconfig, "goodput_unattributed_frac",
+                                      0.5),
+            warmup_windows=getattr(tconfig, "goodput_warmup_windows", 1),
+            window_ring=getattr(tconfig, "goodput_window_ring", 128),
+            profiler_capture=getattr(tconfig, "goodput_profiler_capture",
+                                     True),
+            profiler_capture_steps=getattr(
+                tconfig, "goodput_profiler_capture_steps", 5),
+            profiler_max_captures=getattr(
+                tconfig, "goodput_profiler_max_captures", 1),
+            profiler_dir=pdir,
+            registry=registry, on_escalate=on_escalate)
+
+    # ---------------------------------------------------------- attribution
+    def _stack(self):
+        s = getattr(self._tls, "stack", None)
+        if s is None:
+            s = self._tls.stack = []
+        return s
+
+    def _add(self, category, seconds):
+        if seconds <= 0.0:
+            return
+        with self._lock:
+            self._totals[category] += seconds
+            if category in GOOD_CATEGORIES:
+                self._step_good[category] += seconds
+
+    def attribute(self, category):
+        """Context manager attributing the interval's SELF time (nested
+        intervals excluded) to *category*."""
+        if not self.enabled:
+            return _NULL_ATTR
+        return _Attr(self, category)
+
+    def add_seconds(self, category, seconds):
+        """Book *seconds* (measured elsewhere, e.g. a jax.monitoring
+        compile duration) to *category*, and as child time of the
+        innermost open interval so its self-time shrinks — the seconds
+        were spent INSIDE it."""
+        if not self.enabled or seconds <= 0.0:
+            return
+        self._add(category, float(seconds))
+        stack = self._stack()
+        if stack:
+            stack[-1]._child += float(seconds)
+
+    def observe_compile(self, seconds):
+        """Compile-watch hook: one XLA backend-compile duration.
+        Negative durations are persistent-cache HITS — no wall time was
+        actually spent, so they are skipped."""
+        if seconds > 0:
+            self.add_seconds("compile", seconds)
+
+    def reclassify_open(self, to_category):
+        """Re-label the innermost open GOOD-category interval (the step
+        wrapper) — the engine calls this when the step it just ran turned
+        out to be an fp16 overflow skip. Returns True when an interval
+        was found."""
+        if not self.enabled:
+            return False
+        for attr in reversed(self._stack()):
+            if attr.category in GOOD_CATEGORIES:
+                attr.category = to_category
+                return True
+        return False
+
+    # -------------------------------------------------------------- reading
+    def elapsed(self):
+        if not self.enabled:
+            return 0.0
+        return max(0.0, self._clock() - self._t_start)
+
+    def totals(self):
+        """Per-category seconds including the ``unattributed`` residual;
+        sums to ``elapsed()`` by construction."""
+        if not self.enabled:
+            return {c: 0.0 for c in CATEGORIES}
+        elapsed = self.elapsed()
+        with self._lock:
+            out = dict(self._totals)
+        out["unattributed"] = elapsed - sum(out.values())
+        return out
+
+    @staticmethod
+    def goodput_fraction(totals, elapsed):
+        if elapsed <= 0:
+            return None
+        return sum(totals[c] for c in GOOD_CATEGORIES) / elapsed
+
+    # ------------------------------------------------------------- per step
+    def mark_step_begin(self):
+        """Reset the per-step good-seconds accumulator at a step
+        BOUNDARY. The previous step's wrapper/fetch intervals close
+        after its ``note_step`` ran, so their seconds land in the
+        accumulator afterwards — without this reset an overflow at step
+        N+1 would sweep step N's trailing good time into
+        ``overflow_skipped``."""
+        if not self.enabled:
+            return
+        with self._lock:
+            for c in self._step_good:
+                self._step_good[c] = 0.0
+
+    def note_step(self, step, overflowed=False):
+        """Host-only per-step facts (no device sync): overflow-burned
+        steps, and the stop condition of an active profiler capture.
+
+        An overflowed step transfers the good seconds booked since the
+        previous step into ``overflow_skipped``: with gas>1 the micro
+        forward/backward intervals already CLOSED before the host could
+        see the overflow, and ``reclassify_open`` only reaches the
+        still-open wrapper — without the transfer a run skipping every
+        step would still report its burned work as goodput."""
+        if not self.enabled:
+            return
+        self.steps_seen += 1
+        if overflowed:
+            self.overflow_steps += 1
+        with self._lock:
+            if overflowed:
+                moved = sum(self._step_good.values())
+                if moved > 0:
+                    for c, s in self._step_good.items():
+                        self._totals[c] -= s
+                    self._totals["overflow_skipped"] += moved
+            for c in self._step_good:
+                self._step_good[c] = 0.0
+        if self._capture_active and step >= self._capture_stop_after:
+            self._stop_capture()
+
+    # -------------------------------------------------------------- windows
+    def tick(self, step=None, force=False):
+        """Close the current window: ring-append its per-category
+        breakdown and (periodic ticks only) run the badput rules. The
+        engine drives this at the goodput cadence; ``force=True`` is the
+        report path closing a partial window without running rules."""
+        if not self.enabled:
+            return None
+        elapsed = self.elapsed()
+        totals = self.totals()
+        dur = elapsed - self._win_elapsed
+        if dur <= 0.0:
+            return None
+        cats = {c: round(totals[c] - self._win_totals.get(c, 0.0), 6)
+                for c in CATEGORIES}
+        gf = self.goodput_fraction(
+            {c: cats[c] for c in GOOD_CATEGORIES}, dur)
+        window = {
+            "index": self._window_seq,
+            "end_step": step,
+            "start_s": round(self._win_elapsed, 6),
+            "dur_s": round(dur, 6),
+            "categories_s": cats,
+            "goodput_fraction": round(gf, 6) if gf is not None else None,
+        }
+        if force:
+            # report-path partial window: marked, kept out of the
+            # cadence count so repeated reports can neither arm the
+            # rules early nor shrink the windows they judge
+            window["forced"] = True
+        self._win_totals = totals
+        self._win_elapsed = elapsed
+        self._window_seq += 1
+        self.ring.append(window)
+        self.last_window = window
+        self._publish(totals, elapsed, window)
+        if not force:
+            self.windows_closed += 1
+            if self.windows_closed > self.warmup_windows:
+                self._check_rules(window, step)
+        return window
+
+    def _check_rules(self, window, step):
+        dur = window["dur_s"]
+        anoms = []
+        iw = window["categories_s"]["input_wait"] / dur
+        if iw > self.input_wait_frac:
+            anoms.append({
+                "rule": "input_stall", "step": step,
+                "severity": RULE_SEVERITY["input_stall"],
+                "fraction": round(iw, 4),
+                "detail": f"{iw:.0%} of the last {dur:.3g}s window was "
+                          f"spent blocked in next(data_iter) "
+                          f"(threshold {self.input_wait_frac:.0%}) — the "
+                          f"input pipeline is starving the device"})
+        un = window["categories_s"]["unattributed"] / dur
+        if un > self.unattributed_frac:
+            anoms.append({
+                "rule": "unattributed_residual", "step": step,
+                "severity": RULE_SEVERITY["unattributed_residual"],
+                "fraction": round(un, 4),
+                "detail": f"{un:.0%} of the last {dur:.3g}s window is "
+                          f"unattributed host time (threshold "
+                          f"{self.unattributed_frac:.0%}) — something "
+                          f"outside the instrumented paths is eating "
+                          f"wall-clock"})
+        if anoms:
+            self._escalate(anoms, step)
+
+    def _publish(self, totals, elapsed, window):
+        """Gauges/counters into the metrics registry (visible through the
+        JSONL/Prometheus MonitorMaster sinks). Host-only."""
+        reg = self.registry
+        if reg is None:
+            return
+        gf = self.goodput_fraction(totals, elapsed)
+        if gf is not None:
+            reg.gauge("goodput_fraction",
+                      "fraction of wall time spent making training "
+                      "progress (device_compute + host_dispatch)").set(gf)
+        wgf = window.get("goodput_fraction")
+        if wgf is not None and not window.get("forced"):
+            # partial report-path windows must not pollute the gauge;
+            # the badput counters below still take their deltas (the
+            # seconds are real and must not vanish from the series)
+            reg.gauge("goodput_window_fraction",
+                      "goodput fraction of the last closed window").set(wgf)
+        for c in CATEGORIES:
+            if c in GOOD_CATEGORIES:
+                continue
+            delta = window["categories_s"][c]
+            if delta > 0:
+                reg.counter("badput_seconds_total",
+                            "wall-clock seconds NOT spent making training "
+                            "progress, by category",
+                            labels={"category": c}).inc(delta)
+
+    # ------------------------------------------------------------ escalation
+    def _escalate(self, anoms, step):
+        any_first = False
+        for a in anoms:
+            rule = a["rule"]
+            first = rule not in self.rule_counts
+            any_first = any_first or first
+            self.rule_counts[rule] = self.rule_counts.get(rule, 0) + 1
+            self.anomalies.append(a)
+            if first:
+                self._log("[goodput] %s (%s) at step %s: %s — "
+                          "snapshot -> %s", rule, a["severity"], step,
+                          a["detail"], self.snapshot_path)
+            if self.registry is not None:
+                self.registry.counter(
+                    "goodput_anomalies_total",
+                    "goodput-ledger badput rule firings",
+                    labels={"rule": rule}).inc()
+        del self.anomalies[:-self.MAX_ANOMALY_HISTORY]
+        self.write_snapshot(force=any_first)
+        if any_first:
+            self._maybe_start_capture(step)
+        if self.on_escalate is not None:
+            try:
+                self.on_escalate()
+            except Exception as e:  # forensics must never kill a step
+                logger.warning("[goodput] on_escalate hook failed: %s", e)
+
+    # ------------------------------------------------------ profiler capture
+    def _maybe_start_capture(self, step):
+        """Start ONE bounded programmatic jax.profiler capture so the
+        evidence for the badput verdict is collected in the failing run.
+        Rate-limited (``profiler_max_captures``, default 1/run)."""
+        if (not self.profiler_capture or self._capture_active
+                or self._captures_done >= self.profiler_max_captures):
+            return False
+        try:
+            os.makedirs(self.profiler_dir, exist_ok=True)
+            _start_trace(self.profiler_dir)
+        except Exception as e:
+            if not self._capture_warned:
+                self._capture_warned = True
+                self._log("[goodput] programmatic profiler capture "
+                          "unavailable (%s); continuing without it", e)
+            self.profiler_capture = False
+            return False
+        self._capture_active = True
+        self._captures_done += 1
+        self._capture_stop_after = (step or self.steps_seen) \
+            + self.profiler_capture_steps
+        self._log("[goodput] jax.profiler capture started -> %s "
+                  "(stops after step %d)", self.profiler_dir,
+                  self._capture_stop_after)
+        return True
+
+    def _stop_capture(self):
+        if not self._capture_active:
+            return
+        self._capture_active = False
+        try:
+            _stop_trace()
+        except Exception as e:
+            logger.warning("[goodput] stop_trace failed: %s", e)
+
+    # --------------------------------------------------------------- outputs
+    def verdict(self, totals=None, elapsed=None):
+        if not self.enabled:
+            return {"status": "disabled"}
+        totals = totals if totals is not None else self.totals()
+        elapsed = elapsed if elapsed is not None else self.elapsed()
+        # dominant badput from the POST-warmup windows when there are
+        # any: the verdict is about steady state, and the one-time
+        # startup compile would otherwise mask a persistent input stall.
+        # Warmup is counted in CADENCE windows — forced (report-path)
+        # partial windows ride along once warmup has passed.
+        steady, cadence_seen = [], 0
+        for w in self.ring:
+            if not w.get("forced"):
+                cadence_seen += 1
+                if cadence_seen > self.warmup_windows:
+                    steady.append(w)
+            elif cadence_seen >= self.warmup_windows:
+                steady.append(w)
+        source = totals
+        if steady:
+            source = {c: sum(w["categories_s"][c] for w in steady)
+                      for c in CATEGORIES}
+        bad = {c: source[c] for c in CATEGORIES
+               if c not in GOOD_CATEGORIES}
+        dominant = max(bad, key=bad.get) if any(
+            v > 0 for v in bad.values()) else None
+        if not self.windows_closed:
+            status = "unknown"
+        elif self.rule_counts:
+            status = "degraded"
+        else:
+            status = "healthy"
+        gf = self.goodput_fraction(totals, elapsed)
+        return {"status": status,
+                "dominant_badput": dominant,
+                "goodput_fraction": round(gf, 6) if gf is not None
+                else None}
+
+    def report(self):
+        """The full ledger dict (what ``GOODPUT.json`` holds)."""
+        if not self.enabled:
+            return {"schema": GOODPUT_SCHEMA, "enabled": False}
+        totals = self.totals()
+        elapsed = self.elapsed()
+        breakdown = None
+        if self.breakdown_fn is not None:
+            try:
+                breakdown = self.breakdown_fn()
+            except Exception:
+                breakdown = None
+        verdict = self.verdict(totals, elapsed)
+        return {
+            "schema": GOODPUT_SCHEMA,
+            "enabled": True,
+            "job_name": self.job_name,
+            "elapsed_s": round(elapsed, 6),
+            "categories_s": {c: round(totals[c], 6) for c in CATEGORIES},
+            "goodput_fraction": verdict["goodput_fraction"],
+            "verdict": verdict,
+            "thresholds": {
+                "input_wait_frac": self.input_wait_frac,
+                "unattributed_frac": self.unattributed_frac,
+                "warmup_windows": self.warmup_windows,
+            },
+            "counters": {
+                "steps_seen": self.steps_seen,
+                "overflow_steps": self.overflow_steps,
+                "windows_closed": self.windows_closed,
+                "anomaly_counts": dict(self.rule_counts),
+            },
+            "profiler": {
+                "available": profiler_available(),
+                "capture_enabled": self.profiler_capture,
+                "captures": self._captures_done,
+                "active": self._capture_active,
+                "capture_steps": self.profiler_capture_steps,
+                "max_captures": self.profiler_max_captures,
+                "dir": self.profiler_dir,
+            },
+            "anomalies": list(self.anomalies),
+            "windows": list(self.ring),
+            "wall_clock_breakdown": breakdown,
+        }
+
+    def write_snapshot(self, path=None, force=False, report=None):
+        """Write ``GOODPUT.json`` (throttled like the health snapshot —
+        re-serialising the ring every anomaly must not stall the train
+        thread). ``report`` lets a caller that already built the report
+        dict reuse it instead of paying a second O(ring) pass."""
+        if not self.enabled:
+            return None
+        if not force and (self._clock() - self._last_snapshot_t
+                          < self.SNAPSHOT_MIN_INTERVAL_S):
+            return None
+        self._last_snapshot_t = self._clock()
+        path = path or self.snapshot_path
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(report if report is not None else self.report(),
+                      f, indent=1, default=repr, allow_nan=False)
+        self._snapshots_written += 1
+        return path
+
+    def close(self):
+        """Stop any live capture, final snapshot when there is something
+        to explain (an anomaly fired), then DISABLE the ledger: engines
+        hold a direct reference besides the process-global one, and a
+        closed ledger must not keep ticking, snapshotting or starting
+        profiler captures with nothing left to stop them."""
+        if not self.enabled:
+            return
+        self._stop_capture()
+        if self.anomalies:
+            self.write_snapshot(force=True)
+        self.enabled = False
+
+
+# Process-global ledger, mirroring tracer/metrics: library code
+# (dataloader, checkpoint_io, compile watch) attributes into whichever
+# ledger is installed; the default is disabled (shared no-op intervals).
+_DISABLED = GoodputLedger(enabled=False)
+_GLOBAL = _DISABLED
+
+
+def get_ledger():
+    return _GLOBAL
+
+
+def set_ledger(ledger):
+    """Install *ledger* as the process-global default; returns the old."""
+    global _GLOBAL
+    old, _GLOBAL = _GLOBAL, ledger
+    return old
+
+
+def reset_ledger(if_current=None):
+    """Restore the disabled default (only when *if_current* is still the
+    installed one, so a newer engine's ledger is not clobbered)."""
+    global _GLOBAL
+    if if_current is None or _GLOBAL is if_current:
+        _GLOBAL = _DISABLED
+
+
+# --------------------------------------------------------------------- CLI
+
+def render(report):
+    """Human-readable rendering of a GOODPUT.json report dict."""
+    lines = []
+    v = report.get("verdict") or {}
+    gf = report.get("goodput_fraction")
+    lines.append(
+        f"goodput: {v.get('status', '?').upper()}"
+        + (f"  {gf:.1%} of wall-clock is training progress"
+           if isinstance(gf, (int, float)) else "")
+        + (f"  (job {report['job_name']})" if report.get("job_name")
+           else ""))
+    if v.get("dominant_badput"):
+        lines.append(f"  dominant badput: {v['dominant_badput']}")
+    elapsed = report.get("elapsed_s", 0) or 0
+    cats = report.get("categories_s", {})
+    for c in CATEGORIES:
+        s = cats.get(c, 0.0)
+        if s <= 0:
+            continue
+        frac = s / elapsed if elapsed else 0.0
+        bar = "#" * int(round(frac * 40))
+        lines.append(f"  {c:18s} {s:9.3f}s  {frac:6.1%}  {bar}")
+    c = report.get("counters", {})
+    lines.append(f"  steps {c.get('steps_seen', 0)}, windows "
+                 f"{c.get('windows_closed', 0)}, overflow-skipped "
+                 f"{c.get('overflow_steps', 0)}")
+    for a in report.get("anomalies", []):
+        lines.append(f"  [{a.get('severity', '?'):8s}] step "
+                     f"{a.get('step')}: {a.get('rule')} — "
+                     f"{a.get('detail')}")
+    if not report.get("anomalies"):
+        lines.append("  no badput anomalies recorded")
+    prof = report.get("profiler") or {}
+    if prof.get("captures"):
+        lines.append(f"  profiler captures: {prof['captures']} -> "
+                     f"{prof.get('dir')}")
+    bd = report.get("wall_clock_breakdown")
+    if bd:
+        for name, row in bd.get("phases", {}).items():
+            lines.append(f"  timer {name}: {row.get('total_ms', 0):.1f} ms "
+                         f"over {row.get('count', 0)} intervals")
+    return "\n".join(lines)
+
+
+class _StallingIterator:
+    """Demo helper: a repeating loader whose every ``next`` first sleeps —
+    the injected input stall the ledger must attribute to input_wait."""
+
+    def __init__(self, loader, stall_s):
+        from deepspeed_tpu.runtime.dataloader import RepeatingLoader
+        self._it = RepeatingLoader(loader)
+        self.stall_s = stall_s
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        time.sleep(self.stall_s)
+        return next(self._it)
+
+
+def _demo(args):
+    """Tiny engine + injected input stall -> the committed repo-root
+    GOODPUT.json example (input_wait must dominate the verdict)."""
+    if args.devices:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.devices}")
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import deepspeed_tpu
+    from deepspeed_tpu.models.simple import SimpleModel, random_dataset, \
+        sample_batch
+    from deepspeed_tpu.utils import groups
+
+    groups.destroy()
+    groups.initialize()
+    hidden = 32
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=hidden, nlayers=2),
+        config={
+            "train_batch_size": 8,
+            "steps_per_print": 4,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+            "telemetry": {"enabled": True, "trace": False,
+                          "jsonl": False, "prometheus": False,
+                          # capture off for the COMMITTED example: the
+                          # one-time jax.profiler start cost (~seconds of
+                          # TF profiler init) would dwarf the injected
+                          # stall and muddy the category story. Pass
+                          # --capture to see the real escalation path.
+                          "goodput": {"enabled": True, "cadence": 2,
+                                      "warmup_windows": 1,
+                                      "profiler_capture": args.capture,
+                                      "profiler_capture_steps": 2,
+                                      "snapshot_file": os.path.abspath(
+                                          args.out)}},
+        },
+        sample_batch=sample_batch(8, hidden))
+    loader = engine.deepspeed_io(random_dataset(64, hidden))
+    it = _StallingIterator(loader, args.stall_ms / 1e3)
+    for _ in range(args.steps):
+        engine.train_batch(data_iter=it)
+    report = engine.goodput_report(write=True)
+    print(render(report))
+    print(f"\nwrote {args.out}")
+    return 0
+
+
+def main(argv=None):
+    import argparse
+    p = argparse.ArgumentParser(
+        prog="python -m deepspeed_tpu.telemetry.ledger",
+        description="Render a GOODPUT.json snapshot, or run the goodput "
+                    "demo (tiny engine + injected input stall)")
+    p.add_argument("--render", metavar="GOODPUT.json",
+                   help="pretty-print an existing snapshot and exit")
+    p.add_argument("--demo", action="store_true",
+                   help="build a tiny engine, inject a sleep into the "
+                        "data iterator, write the ledger snapshot")
+    p.add_argument("--steps", type=int, default=16)
+    p.add_argument("--stall-ms", type=float, default=30.0)
+    p.add_argument("--capture", action="store_true",
+                   help="demo: also trigger the real on-anomaly "
+                        "jax.profiler capture (its one-time start cost "
+                        "lands in the enclosing step's category)")
+    p.add_argument("--devices", type=int, default=8,
+                   help="virtual CPU devices for the demo (0 = existing)")
+    p.add_argument("--out", default="GOODPUT.json")
+    args = p.parse_args(argv)
+    if args.render:
+        with open(args.render) as f:
+            print(render(json.load(f)))
+        return 0
+    if args.demo:
+        return _demo(args)
+    p.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

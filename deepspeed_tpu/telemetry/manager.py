@@ -40,6 +40,7 @@ class TelemetryManager:
             self.compile_watch = None
             self.trace_path = None
             self.health = None
+            self.goodput = None
             return
 
         out = config.output_path or "telemetry/"
@@ -74,6 +75,20 @@ class TelemetryManager:
             self.health = HealthMonitor.from_config(
                 config, output_path=out, job_name=job,
                 registry=self.registry, on_escalate=on_escalate)
+        # goodput ledger (telemetry/ledger.py): wall-clock attribution.
+        # Installed as the process-global ledger so library code
+        # (dataloader next(), checkpoint_io, the compile watch's
+        # backend-compile listener) attributes without plumbing; the
+        # engine wires the step-loop call sites and drives the ticks.
+        self.goodput = None
+        if getattr(config, "goodput_enabled", False):
+            from deepspeed_tpu.telemetry import ledger as _ledger_mod
+            self.goodput = _ledger_mod.GoodputLedger.from_config(
+                config, output_path=out, job_name=job,
+                registry=self.registry,
+                on_escalate=(self._force_trace_export
+                             if config.trace else None))
+            _ledger_mod.set_ledger(self.goodput)
         self._closed = False
         self._last_export_t = float("-inf")
         self._last_export_n = -1
@@ -139,6 +154,10 @@ class TelemetryManager:
         self._closed = True
         if self.health is not None:
             self.health.close()
+        if self.goodput is not None:
+            from deepspeed_tpu.telemetry import ledger as _ledger_mod
+            self.goodput.close()
+            _ledger_mod.reset_ledger(if_current=self.goodput)
         self.flush(force=True)
         _cw.uninstall_global_listener()
         atexit.unregister(self.close)

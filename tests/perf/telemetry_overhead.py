@@ -15,7 +15,13 @@ Asserts:
   the train step exactly ONCE (the stats variant is selected before the
   first lower, never by signature mutation) and fetches stats only at
   the print cadence; disabled, the step programs and the <2 µs/span
-  budget are unchanged (no stats outputs, no monitor, no gauges).
+  budget are unchanged (no stats outputs, no monitor, no gauges);
+* the ``telemetry.goodput`` ledger: the FULL stack (spans + cost
+  explorer + health + goodput) still compiles the train step exactly
+  once over 20 steps and fetches device state only at the print
+  cadence; the ledger ticks at its cadence only, its categories sum to
+  elapsed wall time, the disabled path is inert, and a disabled
+  ledger's ``attribute`` costs < 2 µs like the disabled trace_span.
 
 Run manually:  python tests/perf/telemetry_overhead.py [iters] — not
 collected by pytest (no test_ prefix), like the other perf scripts here.
@@ -43,7 +49,8 @@ def _per_span_us(tracer, iters):
     return (time.perf_counter() - t0) / iters * 1e6
 
 
-def _tiny_engine(ce_enabled, health_enabled=False, steps_per_print=10 ** 9):
+def _tiny_engine(ce_enabled, health_enabled=False, goodput_enabled=False,
+                 steps_per_print=10 ** 9):
     import jax
     jax.config.update("jax_platforms", "cpu")
     import deepspeed_tpu
@@ -63,7 +70,9 @@ def _tiny_engine(ce_enabled, health_enabled=False, steps_per_print=10 ** 9):
                 "telemetry": {"enabled": True, "trace": False,
                               "jsonl": False, "prometheus": False,
                               "cost_explorer": {"enabled": ce_enabled},
-                              "health": {"enabled": health_enabled}}},
+                              "health": {"enabled": health_enabled},
+                              "goodput": {"enabled": goodput_enabled,
+                                          "profiler_capture": False}}},
         sample_batch=batch)
     return engine, batch
 
@@ -162,6 +171,81 @@ def check_health_disabled_inert(steps=3):
     print("disabled health path: no stats, no monitor, no gauges")
 
 
+def check_goodput_full_stack_one_compile(steps=20, cadence=5):
+    """Acceptance guard: spans + cost explorer + health + goodput ALL
+    enabled — still exactly one train-step compile over 20 steps, device
+    fetches at the print cadence only, ledger ticks at its cadence only
+    (pure host arithmetic), and the category seconds sum to elapsed."""
+    engine, batch = _tiny_engine(ce_enabled=True, health_enabled=True,
+                                 goodput_enabled=True,
+                                 steps_per_print=cadence)
+    led = engine._goodput
+    assert led is not None, "goodput must be armed on this config"
+    engine.train_batch(batch=batch)       # the one compile
+    after_prime = _backend_compiles(engine)
+    for _ in range(steps - 1):
+        engine.train_batch(batch=batch)
+    after_steps = _backend_compiles(engine)
+    assert after_steps == after_prime, (
+        f"full telemetry stack recompiled mid-run: "
+        f"{after_prime} -> {after_steps}")
+    assert led.steps_seen == steps
+    assert led.windows_closed == steps // cadence, (
+        f"ledger ticked {led.windows_closed}x over {steps} steps; the "
+        f"cadence-{cadence} path must close exactly {steps // cadence} "
+        f"windows")
+    mon = engine.telemetry.health
+    assert mon.samples_seen == steps // cadence, (
+        "goodput must not add device fetches beyond the health cadence")
+    rep = engine.goodput_report()
+    cats = rep["categories_s"]
+    drift = abs(sum(cats.values()) - rep["elapsed_s"])
+    assert drift <= 0.01 * rep["elapsed_s"] + 1e-6, (
+        f"ledger categories sum {sum(cats.values()):.6f}s but elapsed is "
+        f"{rep['elapsed_s']:.6f}s")
+    snap = engine.telemetry.registry.snapshot()
+    assert "goodput_fraction" in snap
+    # manager teardown must also uninstall the process-global ledger
+    from deepspeed_tpu.telemetry import ledger as ledger_mod
+    engine.telemetry.close()
+    assert not ledger_mod.get_ledger().enabled, (
+        "manager close() must restore the disabled global ledger")
+    print(f"goodput full stack: 1 compile over {steps} steps, "
+          f"{led.windows_closed} cadence ticks, goodput "
+          f"{rep['goodput_fraction']:.2f}, residual drift {drift:.4f}s")
+
+
+def check_goodput_disabled_inert(steps=3):
+    """goodput off => no ledger object, no goodput metrics, the global
+    ledger stays the disabled singleton, and a disabled ledger's
+    attribute() fits the same <2 us budget as the disabled tracer."""
+    from deepspeed_tpu.telemetry import ledger as ledger_mod
+    engine, batch = _tiny_engine(ce_enabled=False)
+    assert engine._goodput is None
+    for _ in range(steps):
+        engine.train_batch(batch=batch)
+    assert engine.goodput_report() == {"enabled": False}
+    snap = engine.telemetry.registry.snapshot()
+    for name in ("goodput_fraction", "goodput_window_fraction",
+                 "badput_seconds_total", "goodput_anomalies_total"):
+        assert name not in snap, f"unexpected metric {name} while disabled"
+    assert not ledger_mod.get_ledger().enabled
+
+    disabled = ledger_mod.GoodputLedger(enabled=False)
+    attribute = disabled.attribute
+    iters = 100_000
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        with attribute("input_wait"):
+            pass
+    per_us = (time.perf_counter() - t0) / iters * 1e6
+    assert per_us < DISABLED_BUDGET_US, (
+        f"disabled ledger attribute {per_us:.3f} us exceeds the "
+        f"{DISABLED_BUDGET_US} us budget")
+    print(f"disabled goodput path: no ledger, no metrics, "
+          f"{per_us:.3f} us/attribute")
+
+
 def main(iters=200_000):
     from deepspeed_tpu.telemetry import Tracer
 
@@ -185,6 +269,8 @@ def main(iters=200_000):
     check_disabled_path_inert()
     check_health_zero_extra_compiles()
     check_health_disabled_inert()
+    check_goodput_full_stack_one_compile()
+    check_goodput_disabled_inert()
     print("OK")
 
 
