@@ -83,6 +83,8 @@ def telemetry_report():
         "(telemetry.health block; HEALTH.json forensics)")
     row("goodput ledger (wall-clock)", True,
         "(telemetry.goodput block; GOODPUT.json forensics)")
+    row("async input prefetch", True,
+        "(data_prefetch block; host workers + device double-buffering)")
     try:
         from deepspeed_tpu.telemetry.ledger import profiler_available
         row("jax.profiler programmatic capture", profiler_available(),

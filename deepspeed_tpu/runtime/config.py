@@ -214,6 +214,30 @@ class DeepSpeedTelemetryConfig(DeepSpeedConfigObject):
                                                      "on")
 
 
+class DeepSpeedDataPrefetchConfig(DeepSpeedConfigObject):
+    """``data_prefetch`` block (runtime/prefetch.py): bounded background
+    input pipeline — host-stage collate workers + (single-process) device
+    double-buffering that overlaps the H2D copy with device compute.
+
+    Env override (sweep ergonomics): ``DS_DATA_PREFETCH`` = 1/0
+    force-toggles ``enabled`` after JSON parsing."""
+
+    def __init__(self, param_dict):
+        p = param_dict.get(C.DATA_PREFETCH, {}) or {}
+        self.enabled = p.get(C.DATA_PREFETCH_ENABLED,
+                             C.DATA_PREFETCH_ENABLED_DEFAULT)
+        self.depth = int(p.get(C.DATA_PREFETCH_DEPTH,
+                               C.DATA_PREFETCH_DEPTH_DEFAULT))
+        self.to_device = p.get(C.DATA_PREFETCH_TO_DEVICE,
+                               C.DATA_PREFETCH_TO_DEVICE_DEFAULT)
+        env = os.environ.get("DS_DATA_PREFETCH")
+        if env is not None:
+            self.enabled = env.lower() in ("1", "true", "yes", "on")
+        if self.depth < 1:
+            raise DeepSpeedConfigError(
+                f"data_prefetch.depth must be >= 1, got {self.depth}")
+
+
 class DeepSpeedFlopsProfilerConfig(DeepSpeedConfigObject):
     def __init__(self, param_dict):
         fp = param_dict.get(C.FLOPS_PROFILER, {}) or {}
@@ -512,6 +536,7 @@ class DeepSpeedConfig:
         # jit a new shape is a recompile) — the reference's False default
         # is an eager-mode luxury; an EXPLICIT false is still honored.
         self.dataloader_drop_last = pd.get(C.DATALOADER_DROP_LAST, None)
+        self.data_prefetch = DeepSpeedDataPrefetchConfig(pd)
         self.gradient_accumulation_dtype = pd.get(C.GRADIENT_ACCUMULATION_FORMAT, None)
 
     # -- batch triangulation (reference config.py:926-1004) -----------------

@@ -356,6 +356,22 @@ AIO_OVERLAP_EVENTS_DEFAULT = True
 DATALOADER_DROP_LAST = "dataloader_drop_last"
 DATALOADER_DROP_LAST_DEFAULT = False
 
+# data_prefetch: asynchronous input pipeline (runtime/prefetch.py).
+# When enabled, deepspeed_io-built loaders (and iterators handed to
+# train_batch) are wrapped in a bounded background pipeline: host worker
+# thread(s) pull + collate the next `depth` batches, and — single-process
+# runs only — a device stage issues _globalize_batch/device_put for batch
+# N+1 while step N computes, so the H2D copy overlaps device execution.
+# `num_local_io_workers` (deepspeed_io argument) sets the host-stage
+# worker count. DS_DATA_PREFETCH=1/0 force-toggles `enabled`.
+DATA_PREFETCH = "data_prefetch"
+DATA_PREFETCH_ENABLED = "enabled"
+DATA_PREFETCH_ENABLED_DEFAULT = False
+DATA_PREFETCH_DEPTH = "depth"               # max batches in the pipeline
+DATA_PREFETCH_DEPTH_DEFAULT = 2
+DATA_PREFETCH_TO_DEVICE = "to_device"       # arm the device stage
+DATA_PREFETCH_TO_DEVICE_DEFAULT = True
+
 # Pipeline
 PIPE_REPLICATED = "ds_pipe_replicated"
 PIPELINE = "pipeline"

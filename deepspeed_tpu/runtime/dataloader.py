@@ -76,6 +76,10 @@ class DeepSpeedDataLoader:
         self.process_count = process_count
         self.epoch = 0
         self.data_sampler = data_sampler
+        # honored by the prefetch host stage (runtime/prefetch.py) as its
+        # worker count; without prefetch the loader is synchronous and the
+        # engine warns once that the knob has no effect
+        self.num_local_io_workers = num_local_io_workers
         n = len(dataset)
         per_proc = n // process_count if drop_last else -(-n // process_count)
         if drop_last:
@@ -95,7 +99,12 @@ class DeepSpeedDataLoader:
         # between batches (the generator is suspended across it)
         return GoodputIterator(self._iter_batches())
 
-    def _iter_batches(self):
+    def _index_plan(self):
+        """Yield this epoch's batch index slices, in order. The plan is
+        cheap pure-numpy work split from :meth:`materialize` so the
+        prefetcher's host stage can fan the (expensive) dataset fetch +
+        collate out over ``num_local_io_workers`` while one filler thread
+        preserves the batch order."""
         n = len(self.dataset)
         if self.data_sampler is not None:
             # a user sampler already yields THIS process's indices
@@ -114,7 +123,17 @@ class DeepSpeedDataLoader:
             idx = order[start:start + self.batch_size]
             if self.drop_last and len(idx) < self.batch_size:
                 break
-            yield self.collate_fn([self.dataset[int(i)] for i in idx])
+            yield idx
+
+    def materialize(self, idx):
+        """Fetch + collate one batch by index slice (thread-safe for the
+        usual indexable datasets; the prefetch workers call this off the
+        consumer thread)."""
+        return self.collate_fn([self.dataset[int(i)] for i in idx])
+
+    def _iter_batches(self):
+        for idx in self._index_plan():
+            yield self.materialize(idx)
 
 
 def _default_collate(samples):

@@ -44,7 +44,9 @@ from deepspeed_tpu.runtime import optim as optim_lib
 from deepspeed_tpu.runtime.config import (
     ADAGRAD_OPTIMIZER, ADAM_OPTIMIZER, ADAMW_OPTIMIZER, DeepSpeedConfig,
     LAMB_OPTIMIZER, ONEBIT_ADAM_OPTIMIZER, ONEBIT_LAMB_OPTIMIZER, SGD_OPTIMIZER)
+from deepspeed_tpu.runtime.constants import ROUTE_TRAIN
 from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader, RepeatingLoader
+from deepspeed_tpu.runtime.prefetch import PrefetchIterator, PrefetchLoader
 from deepspeed_tpu.runtime.fp16.loss_scaler import (
     LossScaleState, make_scale_state, scale_state_stats, update_scale)
 from deepspeed_tpu.runtime.lr_schedules import get_lr_schedule
@@ -490,6 +492,17 @@ class DeepSpeedEngine:
                 self._jit_offload_pre, "offload_pre_step")
             self._jit_eval = self.telemetry.wrap_compiled(
                 self._jit_eval, "eval_step")
+
+        # ---- async input pipeline (runtime/prefetch.py) -------------------
+        # deepspeed_io wraps its loaders; train_batch wraps user-supplied
+        # iterators (cached by identity so the pipeline is built once).
+        # close() tears every pipeline down; each also self-registers an
+        # atexit close as the leak backstop.
+        self._prefetch_cfg = self.config.data_prefetch
+        self._prefetchers = []
+        self._prefetch_wrap_cache = {}
+        self._warned_io_workers = False
+        self._warned_prefetch_host_only = False
 
         # ---- dataloader (reference deepspeed_io, :1474) -------------------
         self.training_dataloader = None
@@ -1694,7 +1707,14 @@ class DeepSpeedEngine:
         the global array is assembled from per-process shards —
         device_put would silently treat the local slice as the whole
         batch (ADVICE round 1); broadcast leaves are checksum-verified
-        identical across processes before being stamped 'replicated'."""
+        identical across processes before being stamped 'replicated'.
+
+        A batch the prefetcher's device stage already placed (runtime/
+        prefetch.py) arrives here as global jax arrays with exactly the
+        shardings this function computes — the single-process
+        ``device_put`` below then returns the SAME buffers without a
+        transfer (verified same-object in jax 0.4.37), so re-entering is
+        the cheap, validation-preserving way to "skip" placement."""
         import numpy as _np
         shardings = self._batch_sharding(batch)
         n_proc = jax.process_count()
@@ -1994,6 +2014,8 @@ class DeepSpeedEngine:
 
     def train_batch(self, data_iter=None, batch=None):
         """One full global step: gas micro-batches + optimizer step."""
+        if data_iter is not None:
+            data_iter = self._maybe_prefetch_iter(data_iter)
         tel = self.telemetry
         if not tel.enabled:
             return self._train_batch(data_iter, batch)
@@ -2174,7 +2196,7 @@ class DeepSpeedEngine:
         # Each process loads its host's slice of the global micro-batch.
         per_process = (self.train_micro_batch_size_per_gpu() *
                        self.dp_world_size) // dist.get_process_count()
-        return DeepSpeedDataLoader(
+        loader = DeepSpeedDataLoader(
             dataset,
             batch_size=batch_size or per_process,
             shuffle=data_sampler is None,
@@ -2182,8 +2204,113 @@ class DeepSpeedEngine:
                        else self.config.dataloader_drop_last),
             collate_fn=collate_fn or self.collate_fn,
             data_sampler=data_sampler,
+            num_local_io_workers=num_local_io_workers,
             process_index=dist.get_rank(),
             process_count=dist.get_process_count())
+        if not self._prefetch_cfg.enabled:
+            if num_local_io_workers and not self._warned_io_workers:
+                # the knob is accepted for reference parity but the
+                # synchronous loader collates on the consumer thread —
+                # tell the user why nothing got faster
+                self._warned_io_workers = True
+                logger.warning(
+                    f"num_local_io_workers={num_local_io_workers} has no "
+                    f"effect while data_prefetch is disabled: the loader "
+                    f"collates synchronously on the consumer thread. "
+                    f"Enable the 'data_prefetch' config block (or set "
+                    f"DS_DATA_PREFETCH=1) to run the input pipeline in "
+                    f"the background with that worker count.")
+            return loader
+        wrapped = PrefetchLoader(
+            loader, depth=self._prefetch_cfg.depth,
+            num_workers=num_local_io_workers or 1,
+            place_fn=self._prefetch_place_fn(
+                for_train=route in (None, ROUTE_TRAIN)))
+        self._prefetchers.append(wrapped)
+        return wrapped
+
+    def _prefetch_place_fn(self, for_train=True):
+        """The prefetch device stage's placement fn — ``_globalize_batch``
+        on a background thread — or None when the stage must stay off:
+
+        * multi-process: ``_globalize_batch`` performs cross-process work
+          (broadcast-leaf checksum allgather); a background-thread
+          collective racing the main thread's collectives is a deadlock,
+          so prefetch stays host-side (collate only) and the main thread
+          does placement;
+        * curriculum learning: the scheduled per-step truncation happens
+          on the HOST batch after ``next()`` — pre-placing would pin the
+          full-length batch and defeat the plateau compile.
+
+        ``for_train`` follows the loader's route: an eval-route loader
+        must place with eval semantics (replicated dim0==1 leaves, no
+        train-only broadcast rejection) or the background placement
+        would diverge from what ``eval_batch`` does on the main thread.
+
+        Never a silent behavior change: each disable path warns once."""
+        pf = self._prefetch_cfg
+        if not pf.to_device:
+            return None
+        if jax.process_count() > 1:
+            if not self._warned_prefetch_host_only:
+                self._warned_prefetch_host_only = True
+                logger.warning(
+                    "data_prefetch: device stage disabled on this "
+                    "multi-process run (batch placement verifies "
+                    "broadcast leaves with a cross-process collective, "
+                    "which must run on the main thread); host-side "
+                    "prefetch stays on")
+            return None
+        if self.curriculum_scheduler is not None:
+            if not self._warned_prefetch_host_only:
+                self._warned_prefetch_host_only = True
+                logger.warning(
+                    "data_prefetch: device stage disabled under "
+                    "curriculum learning (the scheduled truncation "
+                    "slices the host batch per step); host-side "
+                    "prefetch stays on")
+            return None
+        if for_train:
+            return self._globalize_batch
+        return lambda b: self._globalize_batch(b, for_train=False)
+
+    def _maybe_prefetch_iter(self, data_iter):
+        """Wrap a user-supplied ``train_batch`` iterator in the prefetch
+        pipeline (cached by identity — one pipeline per iterator).
+        Already-prefetching sources pass through untouched."""
+        if data_iter is None or not self._prefetch_cfg.enabled:
+            return data_iter
+        if isinstance(data_iter, PrefetchIterator):
+            return data_iter
+        # a RepeatingLoader over a deepspeed_io-built PrefetchLoader is
+        # already prefetch-backed — don't stack a second pipeline on it
+        if isinstance(getattr(data_iter, "loader", None), PrefetchLoader):
+            return data_iter
+        cached = self._prefetch_wrap_cache.get(id(data_iter))
+        if cached is not None and cached[0] is data_iter:
+            return cached[1]
+        # drop closed pipelines so exhausted iterators don't accumulate
+        # (the strong source ref in the cache is what keeps id() valid)
+        self._prefetch_wrap_cache = {
+            k: v for k, v in self._prefetch_wrap_cache.items()
+            if not v[1]._closed}
+        wrapped = PrefetchIterator(
+            data_iter, depth=self._prefetch_cfg.depth,
+            place_fn=self._prefetch_place_fn())
+        self._prefetch_wrap_cache[id(data_iter)] = (data_iter, wrapped)
+        return wrapped
+
+    def close(self):
+        """Engine teardown: stop the prefetch pipelines (joins their
+        worker threads) and close the telemetry manager. Idempotent; the
+        pipelines also self-close on exhaustion and at interpreter
+        exit, so this is the orderly path, not the only one."""
+        for pl in self._prefetchers:
+            pl.close()
+        for _src, wrapped in list(self._prefetch_wrap_cache.values()):
+            wrapped.close()
+        self._prefetch_wrap_cache.clear()
+        self.telemetry.close()
 
     # ------------------------------------------------------------ checkpoints
     def _get_ckpt_name(self, checkpoints_path, tag):

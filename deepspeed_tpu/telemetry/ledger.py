@@ -87,6 +87,34 @@ class _NullAttr:
 
 _NULL_ATTR = _NullAttr()
 
+# Per-thread attribution mute. The ledger decomposes the MAIN thread's
+# wall clock; a background pipeline (runtime/prefetch.py workers) running
+# the same instrumented iterators would book its own concurrent seconds
+# into the shared totals, over-counting the categories and driving the
+# unattributed residual negative. Worker threads wrap their pulls in
+# ``suppress_attribution()`` — overlapped input work books NOTHING, which
+# is exactly the ledger's contract (the consumer's near-zero ``next()``
+# wait is the real input_wait).
+_SUPPRESS_TLS = threading.local()
+
+
+def _suppressed():
+    return getattr(_SUPPRESS_TLS, "on", False)
+
+
+class suppress_attribution:
+    """Context manager muting ledger attribution on the CURRENT thread
+    (re-entrant; applies to every ledger instance, global or direct)."""
+
+    def __enter__(self):
+        self._prev = getattr(_SUPPRESS_TLS, "on", False)
+        _SUPPRESS_TLS.on = True
+        return self
+
+    def __exit__(self, *exc):
+        _SUPPRESS_TLS.on = self._prev
+        return False
+
 
 class _Attr:
     """One open attribution interval. ``category`` is mutable until exit —
@@ -272,7 +300,7 @@ class GoodputLedger:
     def attribute(self, category):
         """Context manager attributing the interval's SELF time (nested
         intervals excluded) to *category*."""
-        if not self.enabled:
+        if not self.enabled or _suppressed():
             return _NULL_ATTR
         return _Attr(self, category)
 

@@ -21,7 +21,11 @@ Asserts:
   once over 20 steps and fetches device state only at the print
   cadence; the ledger ticks at its cadence only, its categories sum to
   elapsed wall time, the disabled path is inert, and a disabled
-  ledger's ``attribute`` costs < 2 µs like the disabled trace_span.
+  ledger's ``attribute`` costs < 2 µs like the disabled trace_span;
+* ``data_prefetch``: a 20-step run through a prefetched deepspeed_io
+  loader (host workers + device stage) adds exactly ZERO train-step
+  compiles — background placement produces the same avals/shardings —
+  and ``engine.close()`` stops every pipeline thread.
 
 Run manually:  python tests/perf/telemetry_overhead.py [iters] — not
 collected by pytest (no test_ prefix), like the other perf scripts here.
@@ -50,7 +54,7 @@ def _per_span_us(tracer, iters):
 
 
 def _tiny_engine(ce_enabled, health_enabled=False, goodput_enabled=False,
-                 steps_per_print=10 ** 9):
+                 prefetch_enabled=False, steps_per_print=10 ** 9):
     import jax
     jax.config.update("jax_platforms", "cpu")
     import deepspeed_tpu
@@ -67,6 +71,7 @@ def _tiny_engine(ce_enabled, health_enabled=False, goodput_enabled=False,
         config={"train_batch_size": 8,
                 "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
                 "steps_per_print": steps_per_print,
+                "data_prefetch": {"enabled": prefetch_enabled},
                 "telemetry": {"enabled": True, "trace": False,
                               "jsonl": False, "prometheus": False,
                               "cost_explorer": {"enabled": ce_enabled},
@@ -215,6 +220,59 @@ def check_goodput_full_stack_one_compile(steps=20, cadence=5):
           f"{rep['goodput_fraction']:.2f}, residual drift {drift:.4f}s")
 
 
+def check_prefetch_zero_extra_compiles(steps=20):
+    """Acceptance guard: data_prefetch on (host workers + device stage),
+    a 20-step run through a prefetched deepspeed_io loader compiles the
+    train step exactly ONCE — pre-placed batches reach the jit with the
+    same avals/shardings as main-thread placement — and engine.close()
+    (the teardown path) stops every pipeline thread."""
+    import threading
+
+    import numpy as np
+
+    from deepspeed_tpu.runtime.dataloader import RepeatingLoader
+    from deepspeed_tpu.runtime.prefetch import PrefetchLoader
+    engine, batch = _tiny_engine(ce_enabled=True, prefetch_enabled=True)
+    rng = np.random.default_rng(0)
+    dataset = [{"input_ids": rng.integers(0, 512, (64,), dtype=np.int32)}
+               for _ in range(64)]
+    loader = engine.deepspeed_io(dataset, num_local_io_workers=2)
+    assert isinstance(loader, PrefetchLoader), \
+        "data_prefetch on: deepspeed_io must hand back the wrapped loader"
+    assert loader.place_fn is not None, \
+        "single-process run must arm the device stage"
+    it = RepeatingLoader(loader)
+    engine.train_batch(data_iter=it)      # the one compile
+    after_prime = _backend_compiles(engine)
+    for _ in range(steps - 1):
+        engine.train_batch(data_iter=it)
+    after_steps = _backend_compiles(engine)
+    assert after_steps == after_prime, (
+        f"prefetched dispatch recompiled mid-run: "
+        f"{after_prime} -> {after_steps} — the device stage must place "
+        f"with the exact shardings the main thread would")
+    snap = engine.telemetry.registry.snapshot()
+    served = (snap["prefetch_hits_total"][0]["value"]
+              + snap["prefetch_misses_total"][0]["value"])
+    assert served == steps, f"pipeline served {served} of {steps} pulls"
+    alive = [t for t in threading.enumerate()
+             if t.is_alive() and t.name.startswith("ds-prefetch")]
+    assert alive, "pipeline threads should be live mid-run"
+    engine.close()                        # manager close rides along
+    deadline = time.perf_counter() + 3.0
+    while time.perf_counter() < deadline:
+        alive = [t for t in threading.enumerate()
+                 if t.is_alive() and t.name.startswith("ds-prefetch")]
+        if not alive:
+            break
+        time.sleep(0.05)
+    assert not alive, (f"engine.close() leaked prefetch threads: "
+                       f"{[t.name for t in alive]}")
+    print(f"prefetch path: 1 compile over {steps} steps, "
+          f"{int(snap['prefetch_hits_total'][0]['value'])} hits, "
+          f"teardown leak-free")
+
+
 def check_goodput_disabled_inert(steps=3):
     """goodput off => no ledger object, no goodput metrics, the global
     ledger stays the disabled singleton, and a disabled ledger's
@@ -271,6 +329,7 @@ def main(iters=200_000):
     check_health_disabled_inert()
     check_goodput_full_stack_one_compile()
     check_goodput_disabled_inert()
+    check_prefetch_zero_extra_compiles()
     print("OK")
 
 

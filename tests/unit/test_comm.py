@@ -6,10 +6,12 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
 
 import deepspeed_tpu.comm as dist
 from deepspeed_tpu.utils import groups
+from deepspeed_tpu.utils.jax_compat import get_shard_map
+
+shard_map, _smap_kw = get_shard_map()
 
 
 def _data_shard_map(mesh, fn, in_spec, out_spec):

@@ -158,7 +158,17 @@ class TestDispatchImplParity:
             params = m.init(jax.random.PRNGKey(0), x)
             out, l_aux, counts = m.apply(params, x)
             outs[impl] = (np.asarray(out), float(l_aux), np.asarray(counts))
-        np.testing.assert_array_equal(outs["scatter"][0], outs["einsum"][0])
+        if k == 1:
+            np.testing.assert_array_equal(outs["scatter"][0],
+                                          outs["einsum"][0])
+        else:
+            # k=2 combines two products per token; XLA fuses the einsum's
+            # multiply-add into an FMA while the scatter path rounds each
+            # product separately, so the last bit can differ — allow one
+            # ULP, nothing more
+            np.testing.assert_allclose(outs["scatter"][0],
+                                       outs["einsum"][0],
+                                       rtol=1e-7, atol=1e-7)
         assert outs["scatter"][1] == outs["einsum"][1]
         np.testing.assert_array_equal(outs["scatter"][2], outs["einsum"][2])
 
