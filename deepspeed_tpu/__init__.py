@@ -248,6 +248,22 @@ def init_inference(model, mp_size=1, mpu=None, checkpoint=None, dtype=None,
                            **kwargs)
 
 
+def init_serving(model=None, engine=None, params=None, checkpoint=None,
+                 dtype=None, config=None, **kwargs):
+    """Create a continuous-batching serving engine (serving/server.py).
+
+    Pass an existing ``InferenceEngine`` via ``engine``, or a model (+
+    ``params``/``checkpoint``/``dtype``) and one is built through
+    :func:`init_inference`. ``config`` is a ds-config dict whose
+    ``serving`` block sizes the paged KV cache and the slot batch."""
+    if engine is None:
+        assert model is not None, "init_serving needs a model or an engine"
+        engine = init_inference(model, params=params, checkpoint=checkpoint,
+                                dtype=dtype, **kwargs)
+    from deepspeed_tpu.serving.server import ServingEngine
+    return ServingEngine(engine, config=config)
+
+
 def add_config_arguments(parser):
     """Reference: deepspeed.add_config_arguments (deepspeed/__init__.py:204)."""
     group = parser.add_argument_group("DeepSpeed", "DeepSpeed configurations")

@@ -85,6 +85,8 @@ def telemetry_report():
         "(telemetry.goodput block; GOODPUT.json forensics)")
     row("async input prefetch", True,
         "(data_prefetch block; host workers + device double-buffering)")
+    row("serving engine (paged KV)", True,
+        "(serving block; continuous batching + chunked prefill + top-p)")
     try:
         from deepspeed_tpu.telemetry.ledger import profiler_available
         row("jax.profiler programmatic capture", profiler_available(),

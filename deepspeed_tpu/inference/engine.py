@@ -22,8 +22,16 @@ import numpy as np
 from deepspeed_tpu.runtime.state_dict_factory import load_checkpoint_file
 from deepspeed_tpu.runtime.zero.partition import (ModelParallelRules,
                                                   build_param_shardings)
+from deepspeed_tpu.telemetry.metrics import get_registry
+from deepspeed_tpu.telemetry.tracer import trace_span
 from deepspeed_tpu.utils import groups
 from deepspeed_tpu.utils.logging import log_dist
+
+
+def _tree_bytes(tree) -> int:
+    """Total leaf bytes of a params pytree (np or jax arrays)."""
+    return int(sum(getattr(leaf, "nbytes", 0)
+                   for leaf in jax.tree.leaves(tree)))
 
 
 class InferenceEngine:
@@ -94,7 +102,15 @@ class InferenceEngine:
                     "module_inject.quantize_transformer_layer")
             from deepspeed_tpu.module_inject.module_quantize import \
                 quantize_transformer_layer
-            params, self.quant_scales = quantize_transformer_layer(params)
+            before = _tree_bytes(params)
+            with trace_span("inference_int8_quantize"):
+                params, self.quant_scales = quantize_transformer_layer(
+                    params)
+            get_registry().counter(
+                "inference_int8_bytes_saved_total",
+                "param bytes shed by int8 weight storage"
+            ).inc(max(0, before - _tree_bytes(params)
+                      - _tree_bytes(self.quant_scales)))
         shardings = build_param_shardings(params, self.mesh, stage=0,
                                           mp_rules=self.mp_rules)
         with self.mesh:
@@ -115,7 +131,22 @@ class InferenceEngine:
         """Three accepted forms (reference InferenceEngine._load_checkpoint
         :244): a checkpoint-description JSON (SDLoaderFactory — Megatron
         checkpoints, auto mp merge + flax conversion), a model-states
-        pickle, or a consolidated 16bit export."""
+        pickle, or a consolidated 16bit export.
+
+        The whole load (file IO + format conversion) is traced as one
+        ``inference_checkpoint_load`` span with the loaded param bytes on
+        a registry counter — ``init_inference`` can spend minutes here on
+        big checkpoints and was previously invisible to the tracer (the
+        checkpoint_io spans cover only the raw file reads)."""
+        with trace_span("inference_checkpoint_load", path=str(path)):
+            params = self._load_checkpoint_impl(path)
+        get_registry().counter(
+            "inference_checkpoint_bytes_total",
+            "param bytes materialised by inference checkpoint loads"
+        ).inc(_tree_bytes(params))
+        return params
+
+    def _load_checkpoint_impl(self, path):
         if str(path).endswith(".json"):
             from deepspeed_tpu.runtime.state_dict_factory import (
                 SDLoaderFactory, megatron_to_gpt2_params)
@@ -184,9 +215,14 @@ class InferenceEngine:
             mlp_extra_grouping, groups = qs
         else:
             mlp_extra_grouping, groups = True, int(qs)
-        out, quantized = quantize_dequantize_sd(
-            module_sd, groups, mlp_extra_grouping=mlp_extra_grouping,
-            mp_size=self.mp_world_size)
+        with trace_span("inference_weight_quantize", groups=groups):
+            out, quantized = quantize_dequantize_sd(
+                module_sd, groups, mlp_extra_grouping=mlp_extra_grouping,
+                mp_size=self.mp_world_size)
+        get_registry().counter(
+            "inference_quantized_tensors_total",
+            "tensors passed through MoQ weight quantization"
+        ).inc(quantized)
         log_dist(f"MoQ weight quantization applied to {quantized} tensors "
                  f"(groups={groups})", ranks=[0])
         return out

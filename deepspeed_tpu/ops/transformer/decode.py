@@ -107,8 +107,11 @@ def decode_attention(q, k_cache, v_cache, cache_len, *, k_scale=None,
     """softmax(q·K[:len]ᵀ)·V[:len] for one decode step.
 
     q: [B, H, 1, D]; k_cache/v_cache: [B, H, T, D] (T = allocated cache);
-    cache_len: scalar int32, number of valid cache positions (the current
-    token's K/V must already be written). With ``k_scale``/``v_scale``
+    cache_len: int32 scalar — or a [B] vector of PER-SEQUENCE valid
+    lengths, the continuous-batching form where every slot of the static
+    batch sits at its own position (serving/ gathers each slot's pages
+    into the contiguous [B, H, T, D] view this op reads). The current
+    token's K/V must already be written. With ``k_scale``/``v_scale``
     ([B, H, T] fp32 per-row scales) the caches are int8 and dequant folds
     into the kernel's matmuls (the reference's int8 path,
     csrc/transformer/inference/csrc/dequantize.cu). Returns [B, H, 1, D].
@@ -118,6 +121,13 @@ def decode_attention(q, k_cache, v_cache, cache_len, *, k_scale=None,
     quantized = k_scale is not None
     assert quantized == (v_scale is not None)
     T = k_cache.shape[2]
+    lens = jnp.asarray(cache_len, jnp.int32)
+    assert lens.ndim in (0, 1), (
+        f"cache_len must be a scalar or a [B] vector, got {lens.shape}")
+    if lens.ndim == 1:
+        assert lens.shape[0] == B, (
+            f"per-sequence cache_len has {lens.shape[0]} entries for "
+            f"batch {B}")
     if sm_scale is None:
         sm_scale = D ** -0.5
     if use_flash is None:
@@ -128,7 +138,11 @@ def decode_attention(q, k_cache, v_cache, cache_len, *, k_scale=None,
         if quantized:
             k = (k.astype(jnp.float32) * k_scale[..., None]).astype(q.dtype)
             v = (v.astype(jnp.float32) * v_scale[..., None]).astype(q.dtype)
-        mask = (jnp.arange(T) < cache_len)[None, None, None, :]
+        if lens.ndim == 1:
+            mask = jnp.arange(T)[None, None, None, :] \
+                < lens[:, None, None, None]
+        else:
+            mask = (jnp.arange(T) < lens)[None, None, None, :]
         return mha_reference(q, k, v, causal=False,
                              sm_scale=sm_scale, mask=mask)
 
@@ -151,11 +165,17 @@ def decode_attention(q, k_cache, v_cache, cache_len, *, k_scale=None,
     qf = jnp.broadcast_to(q.reshape(B * H, 1, D), (B * H, QROWS, D))
     kf = k_cache.reshape(B * H, Tp, D)
     vf = v_cache.reshape(B * H, Tp, D)
-    len_arr = jnp.asarray(cache_len, jnp.int32).reshape(1)
+    # one length per (b, h) program: a scalar broadcasts to every program,
+    # a [B] vector repeats per head — the kernel body reads len_ref[0]
+    # either way, so the per-sequence path costs nothing extra
+    if lens.ndim == 1:
+        len_arr = jnp.broadcast_to(lens[:, None], (B, H)).reshape(B * H)
+    else:
+        len_arr = jnp.broadcast_to(lens, (B * H,))
 
     cache_spec = pl.BlockSpec((1, Tp, D), lambda b: (b, 0, 0))
     scale_spec = pl.BlockSpec((1, 1, Tp), lambda b: (b, 0, 0))
-    in_specs = [pl.BlockSpec(memory_space=_SMEM),
+    in_specs = [pl.BlockSpec((1,), lambda b: (b,), memory_space=_SMEM),
                 pl.BlockSpec((1, QROWS, D), lambda b: (b, 0, 0)),
                 cache_spec, cache_spec]
     operands = [len_arr, qf, kf, vf]

@@ -238,6 +238,62 @@ class DeepSpeedDataPrefetchConfig(DeepSpeedConfigObject):
                 f"data_prefetch.depth must be >= 1, got {self.depth}")
 
 
+class DeepSpeedServingConfig(DeepSpeedConfigObject):
+    """``serving`` block (serving/): continuous-batching inference server
+    over a paged KV cache. ``num_blocks`` 0 auto-sizes the pool so the
+    full batch at full length fits (preemption-free); a smaller explicit
+    pool trades HBM for preemption-by-eviction under pressure.
+    ``max_model_len`` 0 defers to the served model's ``n_positions``.
+
+    Env overrides (sweep ergonomics): ``DS_SERVING_MAX_BATCH`` /
+    ``DS_SERVING_BLOCK_SIZE`` / ``DS_SERVING_PREFILL_CHUNK``."""
+
+    def __init__(self, param_dict):
+        s = param_dict.get(C.SERVING, {}) or {}
+        self.block_size = int(s.get(C.SERVING_BLOCK_SIZE,
+                                    C.SERVING_BLOCK_SIZE_DEFAULT))
+        self.num_blocks = int(s.get(C.SERVING_NUM_BLOCKS,
+                                    C.SERVING_NUM_BLOCKS_DEFAULT))
+        self.max_batch = int(s.get(C.SERVING_MAX_BATCH,
+                                   C.SERVING_MAX_BATCH_DEFAULT))
+        self.prefill_chunk = int(s.get(C.SERVING_PREFILL_CHUNK,
+                                       C.SERVING_PREFILL_CHUNK_DEFAULT))
+        self.max_model_len = int(s.get(C.SERVING_MAX_MODEL_LEN,
+                                       C.SERVING_MAX_MODEL_LEN_DEFAULT))
+        self.attention_impl = s.get(C.SERVING_ATTENTION_IMPL,
+                                    C.SERVING_ATTENTION_IMPL_DEFAULT)
+        self.decode_steps = int(s.get(C.SERVING_DECODE_STEPS,
+                                      C.SERVING_DECODE_STEPS_DEFAULT))
+        for env, attr in (("DS_SERVING_MAX_BATCH", "max_batch"),
+                          ("DS_SERVING_BLOCK_SIZE", "block_size"),
+                          ("DS_SERVING_PREFILL_CHUNK", "prefill_chunk")):
+            val = os.environ.get(env)
+            if val is not None:
+                setattr(self, attr, int(val))
+        if self.block_size < 1:
+            raise DeepSpeedConfigError(
+                f"serving.block_size must be >= 1, got {self.block_size}")
+        if self.max_batch < 1:
+            raise DeepSpeedConfigError(
+                f"serving.max_batch must be >= 1, got {self.max_batch}")
+        if self.prefill_chunk < 1:
+            raise DeepSpeedConfigError(
+                f"serving.prefill_chunk must be >= 1, got "
+                f"{self.prefill_chunk}")
+        if self.num_blocks < 0 or self.num_blocks == 1:
+            raise DeepSpeedConfigError(
+                f"serving.num_blocks must be 0 (auto) or >= 2 (1 usable "
+                f"+ the reserved null block), got {self.num_blocks}")
+        if self.attention_impl not in ("paged", "gather"):
+            raise DeepSpeedConfigError(
+                f"serving.attention_impl must be 'paged' or 'gather', "
+                f"got {self.attention_impl!r}")
+        if self.decode_steps < 1:
+            raise DeepSpeedConfigError(
+                f"serving.decode_steps must be >= 1, got "
+                f"{self.decode_steps}")
+
+
 class DeepSpeedFlopsProfilerConfig(DeepSpeedConfigObject):
     def __init__(self, param_dict):
         fp = param_dict.get(C.FLOPS_PROFILER, {}) or {}
@@ -537,6 +593,7 @@ class DeepSpeedConfig:
         # is an eager-mode luxury; an EXPLICIT false is still honored.
         self.dataloader_drop_last = pd.get(C.DATALOADER_DROP_LAST, None)
         self.data_prefetch = DeepSpeedDataPrefetchConfig(pd)
+        self.serving = DeepSpeedServingConfig(pd)
         self.gradient_accumulation_dtype = pd.get(C.GRADIENT_ACCUMULATION_FORMAT, None)
 
     # -- batch triangulation (reference config.py:926-1004) -----------------

@@ -372,6 +372,38 @@ DATA_PREFETCH_DEPTH_DEFAULT = 2
 DATA_PREFETCH_TO_DEVICE = "to_device"       # arm the device stage
 DATA_PREFETCH_TO_DEVICE_DEFAULT = True
 
+# serving: continuous-batching inference server (serving/). Paged KV
+# cache of `block_size`-token blocks (`num_blocks` 0 -> sized so
+# `max_batch` full-length sequences fit, i.e. preemption-free), a
+# `max_batch`-slot static decode batch, `prefill_chunk`-token chunked
+# prefill, and `max_model_len` (0 -> the model's n_positions) as the
+# per-request position cap. On TPU pick block_size * blocks-per-seq in
+# multiples of the decode kernel's 512-token KV tile so the per-step
+# gather stays copy-free.
+SERVING = "serving"
+SERVING_BLOCK_SIZE = "block_size"
+SERVING_BLOCK_SIZE_DEFAULT = 16
+SERVING_NUM_BLOCKS = "num_blocks"
+SERVING_NUM_BLOCKS_DEFAULT = 0
+SERVING_MAX_BATCH = "max_batch"
+SERVING_MAX_BATCH_DEFAULT = 8
+SERVING_PREFILL_CHUNK = "prefill_chunk"
+SERVING_PREFILL_CHUNK_DEFAULT = 32
+SERVING_MAX_MODEL_LEN = "max_model_len"
+SERVING_MAX_MODEL_LEN_DEFAULT = 0
+# "paged" streams attention over LIVE KV blocks (dynamic trip count, the
+# PagedAttention shape — per-step traffic scales with tokens that exist);
+# "gather" materialises the block table into the contiguous view the
+# Pallas decode kernel consumes (fixed window, tuned TPU GEMMs)
+SERVING_ATTENTION_IMPL = "attention_impl"
+SERVING_ATTENTION_IMPL_DEFAULT = "paged"
+# tokens decoded per dispatch (vLLM num_scheduler_steps-style multi-step
+# scheduling): >1 amortises host dispatch + the device sync over K
+# tokens at the cost of K-token admission/finish granularity (tokens a
+# request samples past its eos inside a dispatch are discarded)
+SERVING_DECODE_STEPS = "decode_steps"
+SERVING_DECODE_STEPS_DEFAULT = 1
+
 # Pipeline
 PIPE_REPLICATED = "ds_pipe_replicated"
 PIPELINE = "pipeline"

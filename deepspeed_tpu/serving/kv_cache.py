@@ -1,0 +1,287 @@
+"""Paged KV cache — fixed-size blocks + a block allocator + block tables.
+
+The production-serving memory model (vLLM/PagedAttention, SOSP '23): the
+decode KV cache is ONE pool of fixed-size blocks shared by every request,
+and each request owns an ordered *block table* mapping its logical token
+positions onto pool blocks. Heterogeneous prompt/generation lengths then
+share a single static-shaped compiled decode step — the per-step program
+always sees ``[num_blocks, H, block_size, D]`` pools plus small int32
+tables, and only the *values* change as requests come and go, so XLA
+compiles the decode step exactly once for the whole serving lifetime.
+
+Split of responsibilities:
+
+* ``BlockAllocator`` — host-side free-list over block ids. Block 0 is
+  reserved as the *null* block: inactive batch slots (and the padded tail
+  of a prefill chunk) route their writes there, which keeps the compiled
+  step branch-free. ``free``/``allocate`` are guarded against leaks and
+  double-frees — the scheduler tests pin those invariants.
+* ``PagedKVCache`` — owns the device pools (per layer: K, V, and for the
+  int8 KV layout the per-row fp32 scales, riding the same lane-dim
+  convention as ops/transformer/decode.py) plus the scatter/gather
+  helpers the runner traces into the compiled step: ``write_decode``
+  (one token per slot), ``write_chunk`` (a prefill chunk for one slot)
+  and ``gather`` (block table -> contiguous ``[B, H, T, D]`` view that
+  composes with ``decode_attention``'s per-sequence lengths).
+
+The gather materialises each slot's logical cache contiguously per step.
+Attention has to stream those bytes anyway — decode is KV-bandwidth
+bound — so paging costs one extra copy of the *live* window while buying
+the capacity sharing that makes continuous batching admissible.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.ops.transformer.decode import quantize_kv
+
+
+class BlockAllocatorError(RuntimeError):
+    pass
+
+
+class BlockAllocator:
+    """Free-list allocator over ``num_blocks`` pool blocks.
+
+    Block 0 is reserved (the null/trash block) and never handed out.
+    ``allocate`` is all-or-nothing; ``free`` rejects double-frees and
+    foreign ids so an accounting bug fails loudly instead of silently
+    corrupting another request's cache.
+    """
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError(
+                f"need >= 2 blocks (1 usable + the reserved null block), "
+                f"got {num_blocks}")
+        self.num_blocks = num_blocks
+        # LIFO free list: recently-freed blocks are re-used first (their
+        # pool pages are hot)
+        self._free = list(range(num_blocks - 1, 0, -1))
+        self._allocated = set()
+
+    @property
+    def num_usable(self) -> int:
+        return self.num_blocks - 1
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_allocated(self) -> int:
+        return len(self._allocated)
+
+    def occupancy(self) -> float:
+        """Fraction of usable blocks currently owned by requests."""
+        return len(self._allocated) / max(1, self.num_usable)
+
+    def can_allocate(self, n: int) -> bool:
+        return n <= len(self._free)
+
+    def allocate(self, n: int):
+        """Return ``n`` block ids, or ``None`` when the pool can't cover
+        the request (all-or-nothing; no partial grants)."""
+        if n < 0:
+            raise ValueError(f"allocate({n})")
+        if n > len(self._free):
+            return None
+        blocks = [self._free.pop() for _ in range(n)]
+        self._allocated.update(blocks)
+        return blocks
+
+    def free(self, blocks):
+        for b in blocks:
+            if b not in self._allocated:
+                raise BlockAllocatorError(
+                    f"free of block {b} which is not allocated "
+                    f"(double-free or foreign id)")
+            self._allocated.remove(b)
+            self._free.append(b)
+
+    def check_consistency(self):
+        """Invariant check used by the tests: free ∪ allocated is exactly
+        the usable id space and the two sets are disjoint."""
+        free = set(self._free)
+        if len(free) != len(self._free):
+            raise BlockAllocatorError("duplicate ids on the free list")
+        if free & self._allocated:
+            raise BlockAllocatorError(
+                f"ids both free and allocated: {free & self._allocated}")
+        universe = set(range(1, self.num_blocks))
+        if free | self._allocated != universe:
+            raise BlockAllocatorError(
+                f"leaked ids: {universe - (free | self._allocated)}")
+        return True
+
+
+class PagedKVCache:
+    """Device block pools + the traced scatter/gather helpers.
+
+    Pools are layer-STACKED arrays (one pytree leaf each, one scatter
+    per step via :meth:`write_all_layers`):
+
+    * ``k``/``v``: ``[n_layer, num_blocks, H, block_size, D]`` in the
+      activation dtype, or int8 when ``int8_kv`` (the lane-dim int8 KV
+      layout that measured 1.33x on the decode bench);
+    * ``k_scale``/``v_scale`` (int8 only): ``[n_layer, num_blocks, H,
+      block_size]`` fp32 per-row absmax scales.
+    """
+
+    def __init__(self, n_layer, n_head, head_dim, block_size, num_blocks,
+                 dtype=jnp.float32, int8_kv=False):
+        self.n_layer = n_layer
+        self.n_head = n_head
+        self.head_dim = head_dim
+        self.block_size = int(block_size)
+        self.num_blocks = int(num_blocks)
+        self.int8_kv = bool(int8_kv)
+        self.dtype = jnp.int8 if int8_kv else dtype
+        self.allocator = BlockAllocator(num_blocks)
+
+    # -------------------------------------------------- pool construction
+    def init_pools(self, sharding=None):
+        """Zeroed device pools; pass through the jitted step and thread
+        the returned (donated) pools back in. Layer-STACKED arrays
+        (``[L, N, H, BS, D]``): all layers of a step's K/V land in ONE
+        scatter (XLA scatter dispatch is the dominant per-step host cost
+        once attention streams only live blocks — 2 scatters/step beats
+        2-per-layer by the layer count)."""
+        L, N, H, BS, D = (self.n_layer, self.num_blocks, self.n_head,
+                          self.block_size, self.head_dim)
+        pools = {
+            "k": jnp.zeros((L, N, H, BS, D), self.dtype),
+            "v": jnp.zeros((L, N, H, BS, D), self.dtype),
+        }
+        if self.int8_kv:
+            pools["k_scale"] = jnp.zeros((L, N, H, BS), jnp.float32)
+            pools["v_scale"] = jnp.zeros((L, N, H, BS), jnp.float32)
+        # COMMIT the arrays (to the caller's sharding — the server passes
+        # a mesh-replicated one matching the engine params): a donated
+        # program's outputs are committed, and feeding a committed pool
+        # to a program first traced on uncommitted inputs is a silent
+        # (and large) recompile on the second step
+        return jax.device_put(
+            pools, sharding if sharding is not None
+            else jax.local_devices()[0])
+
+    def pool_bytes(self) -> int:
+        """Total HBM the pools occupy (for the serving metrics)."""
+        N, H, BS, D = (self.num_blocks, self.n_head, self.block_size,
+                       self.head_dim)
+        per_layer = 2 * N * H * BS * D * jnp.dtype(self.dtype).itemsize
+        if self.int8_kv:
+            per_layer += 2 * N * H * BS * 4
+        return per_layer * self.n_layer
+
+    # ------------------------------------------------------ traced writes
+    def write_decode(self, pools, layer, k_new, v_new, block_ids, offsets):
+        """Write one token's (or one chunk's) K/V into ONE layer's pages.
+
+        k_new/v_new: ``[B, H, D]``; block_ids/offsets: ``[B]`` int32 (the
+        scheduler routes inactive slots / pad positions to the null block
+        0). Used by the ``gather`` attention impl, whose kernel needs the
+        current token in the pool before it reads. The ``paged`` impl
+        batches all layers through :meth:`write_all_layers` instead.
+        """
+        out = dict(pools)
+        if self.int8_kv:
+            kq, ks = quantize_kv(k_new)                 # scales [B, H]
+            vq, vs = quantize_kv(v_new)
+            out["k"] = pools["k"].at[layer, block_ids, :, offsets, :].set(kq)
+            out["v"] = pools["v"].at[layer, block_ids, :, offsets, :].set(vq)
+            out["k_scale"] = pools["k_scale"].at[
+                layer, block_ids, :, offsets].set(ks)
+            out["v_scale"] = pools["v_scale"].at[
+                layer, block_ids, :, offsets].set(vs)
+        else:
+            dt = pools["k"].dtype
+            out["k"] = pools["k"].at[layer, block_ids, :, offsets, :].set(
+                k_new.astype(dt))
+            out["v"] = pools["v"].at[layer, block_ids, :, offsets, :].set(
+                v_new.astype(dt))
+        return out
+
+    write_chunk = write_decode      # [C, H, D]: C plays B's role
+
+    def write_all_layers(self, pools, k_all, v_all, block_ids, offsets):
+        """Write EVERY layer's K/V for this step in one scatter apiece.
+
+        k_all/v_all: ``[L, B, H, D]`` (decode) or ``[L, C, H, D]``
+        (prefill chunk); block_ids/offsets: ``[B]``/``[C]`` int32. The
+        advanced indices land on pool dims 1 and 3, so the update tensor
+        is expected batch-major — ``[B, L, H, D]``."""
+        out = dict(pools)
+        if self.int8_kv:
+            kq, ks = quantize_kv(k_all)        # scales [L, B, H]
+            vq, vs = quantize_kv(v_all)
+            out["k"] = pools["k"].at[:, block_ids, :, offsets, :].set(
+                kq.transpose(1, 0, 2, 3))
+            out["v"] = pools["v"].at[:, block_ids, :, offsets, :].set(
+                vq.transpose(1, 0, 2, 3))
+            out["k_scale"] = pools["k_scale"].at[
+                :, block_ids, :, offsets].set(ks.transpose(1, 0, 2))
+            out["v_scale"] = pools["v_scale"].at[
+                :, block_ids, :, offsets].set(vs.transpose(1, 0, 2))
+        else:
+            dt = pools["k"].dtype
+            out["k"] = pools["k"].at[:, block_ids, :, offsets, :].set(
+                k_all.transpose(1, 0, 2, 3).astype(dt))
+            out["v"] = pools["v"].at[:, block_ids, :, offsets, :].set(
+                v_all.transpose(1, 0, 2, 3).astype(dt))
+        return out
+
+    # ------------------------------------------------------ traced gather
+    def gather(self, pools, layer, block_tables):
+        """Block table -> contiguous per-slot cache views.
+
+        block_tables: ``[B, MB]`` int32 (or ``[MB]`` for one slot).
+        Returns ``(k, v, k_scale, v_scale)`` with k/v shaped
+        ``[B, H, MB*block_size, D]`` (scales ``[B, H, MB*block_size]`` or
+        ``None``) — exactly what ``decode_attention`` /
+        ``decode_attention_quantized`` read, with per-sequence lengths
+        masking the tail.
+        """
+        squeeze = block_tables.ndim == 1
+        bt = block_tables[None] if squeeze else block_tables
+        B, MB = bt.shape
+        T = MB * self.block_size
+
+        def _g4(pool):   # [N,H,BS,D] -> [B,H,T,D]
+            g = pool[bt]                      # [B, MB, H, BS, D]
+            g = g.transpose(0, 2, 1, 3, 4)    # [B, H, MB, BS, D]
+            return g.reshape(B, self.n_head, T, self.head_dim)
+
+        def _g3(pool):   # [N,H,BS] -> [B,H,T]
+            g = pool[bt].transpose(0, 2, 1, 3)
+            return g.reshape(B, self.n_head, T)
+
+        k = _g4(pools["k"][layer])
+        v = _g4(pools["v"][layer])
+        ks = vs = None
+        if self.int8_kv:
+            ks = _g3(pools["k_scale"][layer])
+            vs = _g3(pools["v_scale"][layer])
+        if squeeze:
+            k, v = k[0], v[0]
+            if ks is not None:
+                ks, vs = ks[0], vs[0]
+        return k, v, ks, vs
+
+    # ------------------------------------------------------- host helpers
+    def blocks_for(self, n_tokens: int) -> int:
+        """Blocks needed to hold ``n_tokens`` cache positions."""
+        return max(1, -(-int(n_tokens) // self.block_size))
+
+    def table_array(self, block_tables, max_blocks, n_rows=None):
+        """Host block tables (lists of ids) -> padded ``[B, MB]`` int32
+        np array, null-block padded; ``None`` rows (empty slots) are all
+        null."""
+        if n_rows is None:
+            n_rows = len(block_tables)
+        out = np.zeros((n_rows, max_blocks), np.int32)
+        for i, tbl in enumerate(block_tables):
+            if tbl:
+                out[i, :len(tbl)] = tbl
+        return out

@@ -1,0 +1,166 @@
+"""Streaming paged attention — work scales with LIVE tokens, not capacity.
+
+The runner's ``gather`` impl materialises each slot's block table into a
+contiguous ``[B, H, T_max, D]`` view and hands it to the
+ops/transformer/decode.py kernel. That composes with the Pallas TPU
+kernel, but it reads (and copies) the *allocated* window every step: a
+request 40 tokens into a 2048-token capacity still pays 2048 columns of
+gather+attention traffic — and decode is KV-bandwidth bound, so that tax
+is the whole step.
+
+This module is the PagedAttention-shaped alternative (SOSP '23): a
+flash-style online-softmax loop over KV *blocks* with a DYNAMIC trip
+count — ``ceil(max_past_len / block_size)`` is a traced scalar, so XLA
+lowers the ``fori_loop`` to a while loop whose iterations touch only
+blocks that actually hold tokens. One block gather per iteration
+(``[B, H, block_size, D]``, consumed immediately — never a full-window
+materialisation), one compiled program regardless of how lengths evolve.
+
+Both functions attend over the PAST pool only and fold the current
+token/chunk from registers (an extra online-softmax term / an intra-chunk
+causal piece merged in). That lets the runner defer every layer's KV
+write into ONE stacked scatter per step (kv_cache.write_all_layers) —
+XLA scatter dispatch was the dominant per-step cost once attention
+stopped reading dead columns. The int8 KV layout dequantises per block
+from the per-row scale pools; the current token stays in registers at
+full precision (it is quantised only when written, exactly like the
+flax decode path, which attends to the quantised value from the NEXT
+step on).
+
+Both impls are selectable per engine (``serving.attention_impl``) and
+pinned equal by tests/unit/test_serving.py.
+"""
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _merge(m1, l1, a1, m2, l2, a2):
+    """Combine two online-softmax partials over disjoint key sets."""
+    m = jnp.maximum(m1, m2)
+    w1 = jnp.exp(m1 - m)
+    w2 = jnp.exp(m2 - m)
+    return m, l1 * w1 + l2 * w2, a1 * w1[..., None] + a2 * w2[..., None]
+
+
+def paged_decode_attention(q, k_cur, v_cur, layer, k_pool, v_pool,
+                           block_tables, past_lens, *, k_scale_pool=None,
+                           v_scale_pool=None, sm_scale=None):
+    """One decode token per slot over the paged pools.
+
+    q/k_cur/v_cur: ``[B, H, D]`` (the current token's K/V stay in
+    registers — the pool write is deferred); pools: the layer-STACKED
+    ``[L, N, H, BS, D]`` arrays indexed as ``pool[layer, ids]`` inside
+    the loop (slicing the stacked pool outside the loop would
+    materialise a per-layer copy); block_tables: ``[B, MB]`` int32;
+    past_lens: ``[B]`` int32 tokens ALREADY in the pool. Returns
+    ``[B, H, D]`` fp32.
+    """
+    B, H, D = q.shape
+    BS = k_pool.shape[3]
+    if sm_scale is None:
+        sm_scale = D ** -0.5
+    quantized = k_scale_pool is not None
+    qf = q.astype(jnp.float32)
+    n_blocks = ((jnp.max(past_lens) + BS - 1) // BS).astype(jnp.int32)
+
+    def body(i, carry):
+        m, l, acc = carry
+        ids = block_tables[:, i]                       # [B]
+        kb = k_pool[layer, ids]                        # [B, H, BS, D]
+        vb = v_pool[layer, ids]
+        if quantized:
+            kb = kb.astype(jnp.float32) \
+                * k_scale_pool[layer, ids][..., None]
+            vb = vb.astype(jnp.float32) \
+                * v_scale_pool[layer, ids][..., None]
+        else:
+            kb = kb.astype(jnp.float32)
+            vb = vb.astype(jnp.float32)
+        s = jnp.einsum("bhd,bhsd->bhs", qf, kb) * sm_scale
+        col = i * BS + jnp.arange(BS, dtype=jnp.int32)
+        s = jnp.where(col[None, None, :] < past_lens[:, None, None],
+                      s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[..., None] + jnp.einsum("bhs,bhsd->bhd", p, vb)
+        return m_new, l_new, acc
+
+    m0 = jnp.full((B, H), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H), jnp.float32)
+    a0 = jnp.zeros((B, H, D), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m0, l0, a0))
+    # fold the current token (always self-visible, so l can never be 0)
+    s_cur = jnp.einsum("bhd,bhd->bh", qf,
+                       k_cur.astype(jnp.float32)) * sm_scale
+    m_f = jnp.maximum(m, s_cur)
+    alpha = jnp.exp(m - m_f)
+    p_cur = jnp.exp(s_cur - m_f)
+    l = l * alpha + p_cur
+    acc = acc * alpha[..., None] \
+        + p_cur[..., None] * v_cur.astype(jnp.float32)
+    return acc / l[..., None]
+
+
+def paged_prefill_attention(q, k_chunk, v_chunk, layer, k_pool, v_pool,
+                            bt_row, pos, start, *, k_scale_pool=None,
+                            v_scale_pool=None, sm_scale=None):
+    """Chunk attention for ONE slot: ``C`` queries at positions ``pos``
+    (= start + 0..C-1) over the slot's PAST pages plus the chunk itself
+    (registers, causal) — the chunk's pool write is deferred.
+
+    q/k_chunk/v_chunk: ``[H, C, D]``; bt_row: ``[MB]`` int32; start:
+    traced scalar, tokens already in the pool. Returns ``[H, C, D]``
+    fp32.
+    """
+    H, C, D = q.shape
+    BS = k_pool.shape[3]
+    if sm_scale is None:
+        sm_scale = D ** -0.5
+    quantized = k_scale_pool is not None
+    qf = q.astype(jnp.float32)
+    n_blocks = ((start + BS - 1) // BS).astype(jnp.int32)
+
+    def body(i, carry):
+        m, l, acc = carry
+        bid = bt_row[i]
+        kb = k_pool[layer, bid]                        # [H, BS, D]
+        vb = v_pool[layer, bid]
+        if quantized:
+            kb = kb.astype(jnp.float32) \
+                * k_scale_pool[layer, bid][..., None]
+            vb = vb.astype(jnp.float32) \
+                * v_scale_pool[layer, bid][..., None]
+        else:
+            kb = kb.astype(jnp.float32)
+            vb = vb.astype(jnp.float32)
+        s = jnp.einsum("hcd,hsd->hcs", qf, kb) * sm_scale
+        col = i * BS + jnp.arange(BS, dtype=jnp.int32)
+        s = jnp.where(col[None, None, :] < start, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[..., None] + jnp.einsum("hcs,hsd->hcd", p, vb)
+        return m_new, l_new, acc
+
+    m0 = jnp.full((H, C), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((H, C), jnp.float32)
+    a0 = jnp.zeros((H, C, D), jnp.float32)
+    m_p, l_p, a_p = jax.lax.fori_loop(0, n_blocks, body, (m0, l0, a0))
+    # intra-chunk causal piece from registers: key e visible to query c
+    # iff e <= c (pad-tail queries produce garbage that is discarded)
+    s_in = jnp.einsum("hcd,hed->hce", qf,
+                      k_chunk.astype(jnp.float32)) * sm_scale
+    causal = jnp.arange(C)[None, :, None] >= jnp.arange(C)[None, None, :]
+    s_in = jnp.where(causal, s_in, NEG_INF)
+    m_in = jnp.max(s_in, axis=-1)
+    p_in = jnp.exp(s_in - m_in[..., None])
+    l_in = jnp.sum(p_in, axis=-1)
+    a_in = jnp.einsum("hce,hed->hcd", p_in, v_chunk.astype(jnp.float32))
+    _, l, acc = _merge(m_p, l_p, a_p, m_in, l_in, a_in)
+    return acc / l[..., None]

@@ -1,0 +1,261 @@
+"""Continuous-batching scheduler — admit/evict between decode steps.
+
+Orca-style iteration-level scheduling (OSDI '22) over a slot-based static
+batch: the compiled decode step always runs ``max_batch`` slots; the
+scheduler decides *which request occupies which slot* between steps and
+hands the server an active mask. Policy:
+
+* **FCFS admission**: requests are admitted strictly in submit order. A
+  head request whose prompt doesn't fit the free block pool blocks the
+  tail (no out-of-order admission — the tests pin this).
+* **Preemption by eviction**: when a running request needs one more KV
+  block and the pool is dry, the LATEST-admitted running request is
+  evicted — its blocks return to the pool and it re-queues at the FRONT
+  of the waiting line (it still outranks everything submitted after it).
+  Eviction is recompute-style (vLLM's recovery mode): the victim's
+  generated-so-far tokens join its prompt and its KV is re-prefilled on
+  re-admission.
+* **Chunked prefill**: one bounded chunk per still-prefilling slot per
+  scheduler iteration (earliest-admitted first — empty decode slots are
+  pure waste, so prefill runs at batch priority), so a long prompt
+  interleaves with decode dispatches at most ``max_batch`` chunks apart
+  instead of stalling the batch for its whole forward (prefill covers
+  ``prompt[:-1]``; the final prompt token is the request's first decode
+  input — its KV is written by the decode step itself).
+
+The scheduler is pure host-side bookkeeping: it never touches device
+state. The server (serving/server.py) turns its ``StepPlan`` into the
+static tensors the compiled programs consume.
+"""
+
+import dataclasses
+import enum
+import time
+from collections import deque
+from typing import List, Optional
+
+
+class RequestState(enum.Enum):
+    WAITING = "waiting"
+    PREFILL = "prefill"
+    RUNNING = "running"
+    FINISHED = "finished"
+
+
+@dataclasses.dataclass
+class Request:
+    req_id: int
+    prompt: List[int]
+    max_new_tokens: int
+    temperature: float = 0.0
+    top_p: float = 1.0
+    seed: int = 0
+    eos_token_id: Optional[int] = None
+    # --- runtime state (scheduler/server owned) ---
+    state: RequestState = RequestState.WAITING
+    output_tokens: List[int] = dataclasses.field(default_factory=list)
+    block_table: List[int] = dataclasses.field(default_factory=list)
+    cached_len: int = 0                  # KV positions written
+    next_input: Optional[int] = None     # token the next decode step embeds
+    slot: Optional[int] = None
+    admit_seq: int = -1
+    preemptions: int = 0
+    finish_reason: Optional[str] = None
+    submit_t: float = 0.0
+    first_token_t: Optional[float] = None
+    last_token_t: Optional[float] = None
+    finish_t: Optional[float] = None
+    step_budget: int = 0        # tokens the next decode dispatch may emit
+
+    @property
+    def full_prompt(self) -> List[int]:
+        """Tokens whose KV must exist to continue decoding — the original
+        prompt plus everything generated so far (what a preempted request
+        re-prefills on re-admission)."""
+        return self.prompt + self.output_tokens
+
+
+@dataclasses.dataclass
+class StepPlan:
+    """One scheduler iteration: one prefill chunk per still-prefilling
+    slot (earliest-admitted first) + the decode slot set."""
+    prefill: List[Request] = dataclasses.field(default_factory=list)
+    decode_slots: List[int] = dataclasses.field(default_factory=list)
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self.prefill) or bool(self.decode_slots)
+
+
+class ContinuousBatchingScheduler:
+    def __init__(self, cache, max_batch: int, max_model_len: int,
+                 decode_steps: int = 1):
+        self.cache = cache                      # PagedKVCache (owns alloc)
+        self.allocator = cache.allocator
+        self.max_batch = int(max_batch)
+        self.max_model_len = int(max_model_len)
+        self.decode_steps = int(decode_steps)
+        self.waiting = deque()
+        self.slots: List[Optional[Request]] = [None] * self.max_batch
+        self._admit_counter = 0
+        self.preemptions_total = 0
+        # requests that can NEVER fit the pool (e.g. a preempted request
+        # whose prompt+generated outgrew the usable blocks) — failed at
+        # admission instead of livelocking the FCFS head; the server
+        # drains these into its finished queue
+        self.failed: List[Request] = []
+
+    # ------------------------------------------------------------- state
+    @property
+    def num_waiting(self) -> int:
+        return len(self.waiting)
+
+    @property
+    def num_active(self) -> int:
+        return sum(r is not None for r in self.slots)
+
+    def has_work(self) -> bool:
+        return bool(self.waiting) or self.num_active > 0
+
+    # ------------------------------------------------------------ submit
+    def submit(self, req: Request):
+        p = len(req.prompt)
+        if p < 1:
+            raise ValueError("empty prompt")
+        if p > self.max_model_len:
+            raise ValueError(
+                f"prompt length {p} exceeds max_model_len "
+                f"{self.max_model_len}")
+        if self.cache.blocks_for(p) > self.allocator.num_usable:
+            raise ValueError(
+                f"prompt needs {self.cache.blocks_for(p)} KV blocks but "
+                f"the pool only has {self.allocator.num_usable} usable — "
+                f"raise serving.num_blocks")
+        req.state = RequestState.WAITING
+        req.submit_t = time.perf_counter()
+        self.waiting.append(req)
+
+    # ---------------------------------------------------------- schedule
+    def schedule(self) -> StepPlan:
+        """Admission + capacity growth for one iteration. Called between
+        decode steps — never mid-step."""
+        self._admit()
+        plan = StepPlan()
+        # capacity growth FIRST: it may preempt slots (possibly ones in
+        # PREFILL state), and the plan must only name requests that still
+        # occupy a slot afterwards
+        plan.decode_slots = self._ensure_decode_capacity()
+        # one chunk per prefilling slot, earliest admission first: empty
+        # decode slots are pure waste, so prefill runs at batch priority
+        # (each chunk is still bounded, so decode interleaves at most
+        # max_batch chunks later)
+        plan.prefill = sorted(
+            (r for r in self.slots
+             if r is not None and r.state is RequestState.PREFILL),
+            key=lambda r: r.admit_seq)
+        return plan
+
+    def _admit(self):
+        while self.waiting:
+            try:
+                free = self.slots.index(None)
+            except ValueError:
+                return
+            req = self.waiting[0]
+            need = self.cache.blocks_for(len(req.full_prompt))
+            if need > self.allocator.num_usable:
+                # can NEVER fit (a preempted request whose prompt +
+                # generated tokens outgrew the pool): fail it instead of
+                # blocking the FCFS head forever
+                self.waiting.popleft()
+                req.state = RequestState.FINISHED
+                req.finish_reason = "capacity"
+                req.finish_t = time.perf_counter()
+                self.failed.append(req)
+                continue
+            blocks = self.allocator.allocate(need)
+            if blocks is None:
+                return                      # strict FCFS: head blocks tail
+            self.waiting.popleft()
+            req.block_table = blocks
+            req.cached_len = 0
+            req.slot = free
+            req.admit_seq = self._admit_counter
+            self._admit_counter += 1
+            req.next_input = req.full_prompt[-1]
+            req.state = (RequestState.PREFILL if len(req.full_prompt) > 1
+                         else RequestState.RUNNING)
+            self.slots[free] = req
+
+    def _ensure_decode_capacity(self) -> List[int]:
+        """Compute each running slot's dispatch budget (tokens the next
+        decode dispatch may emit: capped by decode_steps, remaining
+        generation and the model-length cap), grow its block table to
+        cover the budget's KV writes, and preempt-by-eviction when the
+        pool runs dry.
+
+        Two phases: capacity growth may preempt ANY slot — including one
+        visited earlier — so the decode list is collected only after
+        every slot's growth has settled (a one-pass append could name a
+        slot that a later slot's eviction emptied)."""
+        for i in range(self.max_batch):
+            req = self.slots[i]
+            if req is None or req.state is not RequestState.RUNNING:
+                continue
+            budget = min(self.decode_steps,
+                         req.max_new_tokens - len(req.output_tokens),
+                         max(1, self.max_model_len - req.cached_len))
+            req.step_budget = max(1, budget)
+            while self.cache.blocks_for(
+                    min(req.cached_len + req.step_budget,
+                        self.max_model_len)) > len(req.block_table):
+                grown = self.allocator.allocate(1)
+                if grown is not None:
+                    req.block_table.extend(grown)
+                    continue
+                # before evicting anyone, shrink the budget to the
+                # capacity this slot already owns — guaranteed forward
+                # progress even when the whole pool belongs to it (the
+                # self-preempt/re-admit cycle would otherwise loop
+                # without ever emitting a token)
+                owned = (len(req.block_table) * self.cache.block_size
+                         - req.cached_len)
+                if owned >= 1:
+                    req.step_budget = min(req.step_budget, owned)
+                    break
+                victim = self._pick_victim()
+                self._preempt(victim)
+                if victim is req:
+                    break
+        return [i for i in range(self.max_batch)
+                if self.slots[i] is not None
+                and self.slots[i].state is RequestState.RUNNING]
+
+    def _pick_victim(self) -> Request:
+        """Latest-admitted occupied slot — the request that has consumed
+        the least scheduler priority loses its blocks first."""
+        live = [r for r in self.slots if r is not None]
+        assert live, "allocator dry with no slot to evict"
+        return max(live, key=lambda r: r.admit_seq)
+
+    def _preempt(self, req: Request):
+        self.allocator.free(req.block_table)
+        req.block_table = []
+        req.cached_len = 0
+        self.slots[req.slot] = None
+        req.slot = None
+        req.state = RequestState.WAITING
+        req.preemptions += 1
+        self.preemptions_total += 1
+        # front of the line: it was admitted before anything still waiting
+        self.waiting.appendleft(req)
+
+    # ------------------------------------------------------------ finish
+    def finish(self, req: Request, reason: str):
+        self.allocator.free(req.block_table)
+        req.block_table = []
+        self.slots[req.slot] = None
+        req.slot = None
+        req.state = RequestState.FINISHED
+        req.finish_reason = reason
+        req.finish_t = time.perf_counter()

@@ -32,11 +32,14 @@ from deepspeed_tpu.utils.logging import logger
 
 
 def _leaf_sig(x):
-    """Abstract-value descriptor for one call-argument leaf."""
+    """Abstract-value descriptor for one call-argument leaf. The dtype
+    stays an object (np.dtype hashes/compares fine) — stringifying it per
+    leaf per call measurably taxes hot serving/step loops; ``_fmt`` does
+    the prettification only when a retrace is actually reported."""
     shape = getattr(x, "shape", None)
     dtype = getattr(x, "dtype", None)
     if shape is not None and dtype is not None:
-        return ("aval", tuple(shape), str(dtype))
+        return ("aval", tuple(shape), dtype)
     # static leaf: identity by value when hashable, else by repr
     try:
         hash(x)
@@ -48,6 +51,7 @@ def _leaf_sig(x):
 def _fmt(sig):
     if sig[0] == "aval":
         _, shape, dtype = sig
+        dtype = str(dtype)
         short = {"float32": "f32", "float16": "f16", "bfloat16": "bf16",
                  "int32": "i32", "int64": "i64", "uint32": "u32",
                  "int8": "i8", "uint8": "u8", "bool": "pred"}.get(dtype,

@@ -150,7 +150,11 @@ def test_activation_checkpointing_saves_less():
 def test_partition_activations_shards_saved_inputs():
     """partition_activations constrains the checkpointed segment's saved
     inputs onto the 'model' mesh axis (reference :367 slices them across
-    MP ranks); visible as a sharding_constraint in the lowering."""
+    MP ranks). Asserted on the JAXPR (the ``sharding_constraint`` eqn
+    carries the NamedSharding with the axis name) — the STABLEHLO text
+    only shows a ``custom_call @Sharding`` with GSPMD device lists, axis
+    names are erased there, so grepping the lowering for '"model"' is a
+    partitioner-version lottery."""
     from deepspeed_tpu.utils import groups
     groups.initialize(mp_size=2)
     checkpointing.reset()
@@ -163,8 +167,15 @@ def test_partition_activations_shards_saved_inputs():
         return jnp.sum(checkpointing.checkpoint(f, x) ** 2)
 
     x = jnp.ones((4, 8))
+    jaxpr = str(jax.make_jaxpr(jax.grad(g))(x))
+    assert "sharding_constraint" in jaxpr and "'model'" in jaxpr, (
+        "partition_activations must insert a sharding_constraint on the "
+        "'model' axis over the checkpointed segment's inputs")
+    # and the constraint survives into the compiled lowering (GSPMD
+    # spells it as a @Sharding custom call with an mhlo.sharding attr)
     txt = jax.jit(jax.grad(g)).lower(x).as_text()
-    assert 'sharding_constraint' in txt and '"model"' in txt
+    assert "sharding_constraint" in txt or (
+        "@Sharding" in txt and "mhlo.sharding" in txt)
     # and the math is unchanged
     checkpointing.reset()
     g_plain = jax.grad(lambda x: jnp.sum(f(x) ** 2))(x)
