@@ -1,0 +1,142 @@
+"""Background checkpoint persistence (snapshot-then-persist, CheckFreq).
+
+The engine's async save path splits a checkpoint into a SNAPSHOT phase
+(device→host copy at the step boundary — the only part the train loop
+waits for, and the only part the goodput ledger books as
+``checkpoint_save``) and a PERSIST phase (pickle + fsync + rename +
+manifest), which this writer runs on a background thread while training
+continues.
+
+Contract (mirrors the prefetch pipeline's shutdown discipline,
+``runtime/prefetch.py``):
+
+* at most ONE persist is in flight — ``submit`` drains the previous one
+  first, so two saves can never interleave files within a tag or race the
+  ``latest`` pointer;
+* a background failure is never silent: it re-raises (wrapped in
+  :class:`AsyncCheckpointError`) at the next ``submit``/``drain``/
+  ``close`` — exactly the "next save/close" surface the caller already
+  has in hand;
+* the thread runs under the goodput ledger's ``suppress_attribution`` —
+  its overlapped wall time books NOTHING; the honest ``checkpoint_save``
+  seconds are the snapshot plus whatever the consumer actually waits in
+  ``drain()``;
+* shutdown is leak-free: the (daemon) thread holds only the shared
+  :class:`_WriterState`, never the engine, so an abandoned engine is
+  reclaimed by GC via ``weakref.finalize`` — which also fires at
+  interpreter exit and joins the in-flight write (bounded), so a normal
+  process exit does not truncate a checkpoint.
+"""
+
+import threading
+import weakref
+
+from deepspeed_tpu.telemetry.ledger import suppress_attribution
+from deepspeed_tpu.utils.logging import logger
+
+# at interpreter exit the finalizer joins the in-flight persist; bounded
+# so a wedged filesystem degrades to a warning, not a hung exit
+_EXIT_JOIN_TIMEOUT_S = 120.0
+
+
+class AsyncCheckpointError(RuntimeError):
+    """A background checkpoint persist failed; raised at the next
+    save/drain/close so the failure cannot vanish."""
+
+
+class _WriterState:
+    """What the background thread (and the GC finalizer) share. Holding
+    only this — never the writer or the engine — keeps an abandoned
+    engine collectable."""
+    __slots__ = ("thread", "error", "tag")
+
+    def __init__(self):
+        self.thread = None
+        self.error = None
+        self.tag = None
+
+
+def _finalize_state(state):
+    t = state.thread
+    if t is not None and t.is_alive():
+        t.join(timeout=_EXIT_JOIN_TIMEOUT_S)
+        if t.is_alive():
+            logger.warning(
+                f"async checkpoint: background write of tag "
+                f"{state.tag!r} did not finish within "
+                f"{_EXIT_JOIN_TIMEOUT_S:.0f}s at shutdown; the tag will "
+                f"be left without a manifest (detectably incomplete)")
+
+
+class AsyncCheckpointWriter:
+    """One in-flight background persist at a time. Built lazily by the
+    engine when ``checkpoint.async_save`` is on."""
+
+    def __init__(self, name="ckpt-writer"):
+        self._name = name
+        self._state = _WriterState()
+        self._closed = False
+        self._finalizer = weakref.finalize(self, _finalize_state,
+                                           self._state)
+
+    @property
+    def in_flight(self):
+        t = self._state.thread
+        return t is not None and t.is_alive()
+
+    def submit(self, persist_fn, tag=""):
+        """Drain any previous persist (re-raising its failure), then run
+        ``persist_fn()`` on a fresh background thread."""
+        self.drain()
+        if self._closed:
+            raise AsyncCheckpointError(
+                "async checkpoint writer is closed (engine.close() ran)")
+        state = self._state
+        state.tag = str(tag)
+
+        def _run():
+            try:
+                # overlapped persist seconds must not book into the
+                # ledger's shared totals (they run CONCURRENT with the
+                # train loop's attributed time)
+                with suppress_attribution():
+                    persist_fn()
+            except BaseException as e:      # surfaced at the next drain
+                state.error = e
+
+        t = threading.Thread(target=_run, name=f"ds-{self._name}",
+                             daemon=True)
+        state.thread = t
+        t.start()
+
+    def drain(self):
+        """Wait for the in-flight persist (if any); re-raise its
+        failure. Idempotent."""
+        state = self._state
+        t = state.thread
+        if t is not None:
+            t.join()
+            state.thread = None
+        err = state.error
+        if err is not None:
+            state.error = None
+            raise AsyncCheckpointError(
+                f"background checkpoint write of tag {state.tag!r} "
+                f"failed: {err}") from err
+
+    def close(self):
+        """Drain and refuse further submits. Re-raises a pending
+        background failure (the last chance for it to surface)."""
+        self._closed = True
+        try:
+            self.drain()
+        finally:
+            if self._state.thread is None:
+                self._finalizer.detach()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -11,9 +11,11 @@ dp-resharded loads (elastic resume, reference stage_1_and_2.py:2023) work
 because reassembly is index-based, not rank-based.
 """
 
+import json
 import os
 import pickle
-from typing import Any, Callable, Dict, List, Tuple
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -22,6 +24,51 @@ from deepspeed_tpu.telemetry import trace_span
 from deepspeed_tpu.telemetry.ledger import get_ledger
 from deepspeed_tpu.telemetry.metrics import get_registry
 
+# every durable artifact goes through tmp-file + fsync + atomic rename, so
+# a file either exists COMPLETE or not at all — a crash can truncate only
+# a ``*.tmp.<pid>`` sibling, which every reader here ignores
+_TMP_MARK = ".tmp."
+
+MANIFEST_FILE = "manifest.json"
+MANIFEST_SCHEMA = "deepspeed_tpu.ckpt_manifest/1"
+
+
+def _fsync_dir(dirname: str):
+    """Durability for the rename itself: fsync the containing directory
+    (best-effort — not every filesystem hands out dir fds)."""
+    try:
+        fd = os.open(dirname or ".", os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _atomic_write(path: str, write_fn) -> int:
+    """Write via ``write_fn(fileobj)`` to a tmp sibling, fsync, then
+    atomically rename into place. Returns the written byte count."""
+    tmp = f"{path}{_TMP_MARK}{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            write_fn(f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        # failure cleanup only — after the rename the tmp name is gone.
+        # (A real SIGKILL leaves the stray tmp behind; readers skip it.)
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+    _fsync_dir(os.path.dirname(path))
+    return os.path.getsize(path)
+
 
 def dump_file(obj, path: str, kind: str = "checkpoint") -> int:
     """``pickle.dump`` wrapped in an I/O trace span, with the written
@@ -29,13 +76,15 @@ def dump_file(obj, path: str, kind: str = "checkpoint") -> int:
     checkpoint writers (engine + this module) route through here so the
     telemetry byte accounting covers every file of a save. The goodput
     ledger books the same interval as ``checkpoint_save`` wall time
-    (nesting-safe under the engine's own checkpoint attribution)."""
+    (nesting-safe under the engine's own checkpoint attribution).
+
+    Crash-consistent: the bytes land in a tmp sibling, are fsynced, and
+    renamed into place — a kill mid-write can never leave a truncated
+    pickle under the real name for ``load_file`` to explode on."""
     with get_ledger().attribute("checkpoint_save"), \
             trace_span(f"checkpoint/write/{kind}",
                        path=os.path.basename(path)):
-        with open(path, "wb") as f:
-            pickle.dump(obj, f)
-        nbytes = os.path.getsize(path)
+        nbytes = _atomic_write(path, lambda f: pickle.dump(obj, f))
     get_registry().counter("checkpoint_write_bytes_total",
                            "bytes written by checkpoint saves",
                            labels={"kind": kind}).inc(nbytes)
@@ -55,6 +104,145 @@ def load_file(path: str, kind: str = "checkpoint"):
     return obj
 
 
+# ---------------------------------------------------------------------------
+# Tag completeness: a manifest written LAST (after every rank's files are
+# durable) makes "this tag is loadable" a checked property instead of a
+# hope. The ``latest`` pointer only moves after the manifest exists, so a
+# crash at ANY point of a save leaves the previous checkpoint reachable
+# and the half-written tag detectably incomplete (CheckFreq-style
+# snapshot-then-persist needs exactly this: the persist phase can die at
+# any file boundary).
+# ---------------------------------------------------------------------------
+
+
+def write_manifest(tag_dir: str, meta: Optional[dict] = None) -> dict:
+    """Write the per-tag completeness manifest (atomically, LAST): every
+    durable file in *tag_dir* with its byte size. ``meta`` (tag,
+    world sizes, step counters) is merged in for the fallback scan."""
+    files = {}
+    for name in sorted(os.listdir(tag_dir)):
+        if name == MANIFEST_FILE or _TMP_MARK in name:
+            continue
+        path = os.path.join(tag_dir, name)
+        if os.path.isfile(path):
+            files[name] = os.path.getsize(path)
+    doc = {"schema": MANIFEST_SCHEMA, "files": files}
+    doc.update(meta or {})
+    payload = json.dumps(doc, indent=2, sort_keys=True).encode()
+    _atomic_write(os.path.join(tag_dir, MANIFEST_FILE),
+                  lambda f: f.write(payload))
+    return doc
+
+
+def load_manifest(tag_dir: str):
+    path = os.path.join(tag_dir, MANIFEST_FILE)
+    if not os.path.isfile(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def verify_tag(tag_dir: str) -> Tuple[str, str]:
+    """Is the tag at *tag_dir* loadable? Returns ``(status, detail)``:
+
+    * ``"intact"``  — manifest present, every listed file exists at its
+      recorded size;
+    * ``"legacy"``  — files but no manifest (a pre-manifest-era save, or
+      one interrupted before the manifest — indistinguishable; per-file
+      atomicity still rules out truncated pickles);
+    * ``"missing"`` — no directory, or an empty one;
+    * ``"corrupt"`` — manifest present but contradicted on disk.
+    """
+    if not os.path.isdir(tag_dir):
+        return "missing", "no such directory"
+    entries = [n for n in os.listdir(tag_dir) if _TMP_MARK not in n]
+    if not entries:
+        return "missing", "directory is empty"
+    if MANIFEST_FILE not in entries:
+        return "legacy", ("no completeness manifest (pre-manifest save or "
+                          "a save interrupted before the manifest write)")
+    try:
+        doc = load_manifest(tag_dir)
+        files = doc["files"]
+    except Exception as e:
+        return "corrupt", f"manifest unreadable: {e}"
+    mismatch = _manifest_mismatch(tag_dir, files)
+    if mismatch:
+        return "corrupt", mismatch
+    return "intact", ""
+
+
+def _manifest_mismatch(tag_dir, files):
+    """First contradiction between a manifest's file map and the disk
+    (None when everything checks out)."""
+    for name, size in files.items():
+        path = os.path.join(tag_dir, name)
+        if not os.path.isfile(path):
+            return f"manifest lists {name!r} but it is missing"
+        if size is not None and os.path.getsize(path) != size:
+            return (f"{name!r} is {os.path.getsize(path)} bytes but the "
+                    f"manifest recorded {size}")
+    return None
+
+
+def newest_intact_tag(load_dir: str, exclude=()):
+    """The newest manifest-verified tag under *load_dir* (by recorded
+    global step, then manifest mtime) — the fallback target when the
+    ``latest`` pointer names a broken tag. ``None`` when nothing intact
+    exists. Legacy (manifest-less) tags are never chosen: they cannot be
+    distinguished from an interrupted save."""
+    exclude = set(str(t) for t in (exclude or ()))
+    best = None
+    try:
+        names = os.listdir(load_dir)
+    except OSError:
+        return None
+    for name in names:
+        if name in exclude:
+            continue
+        tag_dir = os.path.join(load_dir, name)
+        if not os.path.isdir(tag_dir):
+            continue
+        try:
+            doc = load_manifest(tag_dir)
+            files = doc["files"]
+        except Exception:
+            continue        # no/unreadable manifest: not a candidate
+        if _manifest_mismatch(tag_dir, files):
+            continue
+        key = (doc.get("global_steps", -1),
+               os.path.getmtime(os.path.join(tag_dir, MANIFEST_FILE)))
+        if best is None or key > best[0]:
+            best = (key, name)
+    return best[1] if best else None
+
+
+def write_latest(save_dir: str, latest_file: str, tag: str):
+    """Atomically update the ``latest`` pointer — readers see the old tag
+    or the new one, never a torn write."""
+    payload = str(tag).encode()
+    _atomic_write(os.path.join(save_dir, latest_file),
+                  lambda f: f.write(payload))
+
+
+def wait_for_files(paths, timeout_s: float = 300.0, poll_s: float = 0.05,
+                   describe: str = "checkpoint files"):
+    """Block until every path exists (rank 0's durability gate before the
+    manifest: other ranks' shard files appear via their own atomic
+    renames — file-based coordination, deliberately collective-free so
+    it is safe on the async writer's background thread)."""
+    deadline = time.monotonic() + timeout_s
+    missing = [p for p in paths if not os.path.isfile(p)]
+    while missing:
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"timed out after {timeout_s:.0f}s waiting for {describe}: "
+                f"missing {[os.path.basename(p) for p in missing[:4]]}"
+                f"{' ...' if len(missing) > 4 else ''}")
+        time.sleep(poll_s)
+        missing = [p for p in missing if not os.path.isfile(p)]
+
+
 def _index_to_key(index, shape) -> Tuple:
     """Normalise a shard index (tuple of slices) to a hashable key."""
     key = []
@@ -65,9 +253,17 @@ def _index_to_key(index, shape) -> Tuple:
     return tuple(key)
 
 
-def tree_local_shards(tree) -> Dict[str, dict]:
+def tree_local_shards(tree, copy: bool = False) -> Dict[str, dict]:
     """{leaf_path: {"shape", "dtype", "shards": [(key, ndarray)]}} for the
-    shards addressable by THIS process (deduplicated by index)."""
+    shards addressable by THIS process (deduplicated by index).
+
+    ``copy=True`` forces a host-owned copy of every shard — required when
+    the payload outlives this call while training continues (the async
+    checkpoint snapshot): the engine's train state is DONATED to the next
+    step, and on the CPU backend ``np.asarray`` of a jax array may alias
+    the device buffer, so a background writer pickling a view would read
+    memory the next step already reused."""
+    conv = (lambda x: np.array(x, copy=True)) if copy else np.asarray
     flat = jax.tree_util.tree_flatten_with_path(tree)[0]
     out = {}
     for path, leaf in flat:
@@ -75,7 +271,7 @@ def tree_local_shards(tree) -> Dict[str, dict]:
         if not isinstance(leaf, jax.Array):
             out[pstr] = {"shape": getattr(leaf, "shape", ()),
                          "dtype": str(getattr(leaf, "dtype", "float32")),
-                         "shards": [((), np.asarray(leaf))]}
+                         "shards": [((), conv(leaf))]}
             continue
         shards = []
         seen = set()
@@ -84,7 +280,7 @@ def tree_local_shards(tree) -> Dict[str, dict]:
             if key in seen:      # replicated copies: save once
                 continue
             seen.add(key)
-            shards.append((key, np.asarray(shard.data)))
+            shards.append((key, conv(shard.data)))
         out[pstr] = {"shape": tuple(leaf.shape), "dtype": str(leaf.dtype),
                      "shards": shards}
     return out

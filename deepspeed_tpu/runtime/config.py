@@ -582,6 +582,24 @@ class DeepSpeedConfig:
             self.checkpoint_tag_validation_mode == "Fail"
         self.load_universal_checkpoint = ckpt.get(C.LOAD_UNIVERSAL_CHECKPOINT,
                                                   C.LOAD_UNIVERSAL_CHECKPOINT_DEFAULT)
+        self.checkpoint_async_save = bool(ckpt.get(
+            C.CHECKPOINT_ASYNC_SAVE, C.CHECKPOINT_ASYNC_SAVE_DEFAULT))
+        self.checkpoint_fallback = bool(ckpt.get(
+            C.CHECKPOINT_FALLBACK, C.CHECKPOINT_FALLBACK_DEFAULT))
+        self.checkpoint_wait_timeout_s = float(ckpt.get(
+            C.CHECKPOINT_WAIT_TIMEOUT, C.CHECKPOINT_WAIT_TIMEOUT_DEFAULT))
+        env_async = os.environ.get("DS_CHECKPOINT_ASYNC_SAVE")
+        if env_async is not None:
+            self.checkpoint_async_save = env_async.lower() in (
+                "1", "true", "yes", "on")
+        env_fb = os.environ.get("DS_CHECKPOINT_FALLBACK")
+        if env_fb is not None:
+            self.checkpoint_fallback = env_fb.lower() in (
+                "1", "true", "yes", "on")
+        if self.checkpoint_wait_timeout_s <= 0:
+            raise DeepSpeedConfigError(
+                f"checkpoint.{C.CHECKPOINT_WAIT_TIMEOUT} must be > 0, got "
+                f"{self.checkpoint_wait_timeout_s}")
 
         self.elasticity_enabled = bool((pd.get("elasticity", {}) or {}).get(
             "enabled", False))

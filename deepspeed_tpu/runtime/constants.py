@@ -208,6 +208,21 @@ CHECKPOINT_TAG_VALIDATION_DEFAULT = "Warn"
 CHECKPOINT_TAG_VALIDATION_MODES = ["Warn", "Ignore", "Fail"]
 LOAD_UNIVERSAL_CHECKPOINT = "load_universal"
 LOAD_UNIVERSAL_CHECKPOINT_DEFAULT = False
+# async_save: snapshot-then-persist saves (runtime/async_checkpoint.py) —
+# save_checkpoint returns after the device->host snapshot and a background
+# thread does the file I/O while training continues. DS_CHECKPOINT_ASYNC_SAVE
+# =1/0 force-toggles it.
+CHECKPOINT_ASYNC_SAVE = "async_save"
+CHECKPOINT_ASYNC_SAVE_DEFAULT = False
+# fallback_to_intact: when the `latest` pointer names a tag that fails
+# manifest verification, recover to the newest intact tag instead of
+# raising. Explicit tag= loads never fall back. DS_CHECKPOINT_FALLBACK=1/0.
+CHECKPOINT_FALLBACK = "fallback_to_intact"
+CHECKPOINT_FALLBACK_DEFAULT = True
+# writable_wait_timeout_s: how long rank 0 waits for the other ranks'
+# shard files before writing the manifest (shared-filesystem gate).
+CHECKPOINT_WAIT_TIMEOUT = "rank_wait_timeout_s"
+CHECKPOINT_WAIT_TIMEOUT_DEFAULT = 300.0
 
 # Eigenvalue (MoQ curvature)
 EIGENVALUE = "eigenvalue"

@@ -37,6 +37,10 @@ class RepeatingLoader:
         # continue from the wrapped loader's own epoch counter when it
         # has one (a resumed loader must not restart the shuffle stream)
         self.epoch = int(getattr(loader, "epoch", 0))
+        # batches already yielded from the CURRENT epoch — with the epoch
+        # it pins the exact position in the (epoch-seeded) shuffle stream,
+        # which is what a preempted run must resume from
+        self.batch_in_epoch = 0
 
     def __iter__(self):
         return self
@@ -50,12 +54,47 @@ class RepeatingLoader:
                 batch = next(self.data_iter)
             except StopIteration:
                 self.epoch += 1
+                self.batch_in_epoch = 0
                 set_epoch = getattr(self.loader, "set_epoch", None)
                 if set_epoch is not None:
                     set_epoch(self.epoch)
                 self.data_iter = iter(self.loader)
                 batch = next(self.data_iter)
+            self.batch_in_epoch += 1
         return batch
+
+    # ------------------------------------------------- preemption resume
+    def state_dict(self):
+        """The (epoch, offset) pair that pins the data stream position.
+        Both counters are world-size invariant: an epoch holds
+        ``dataset/global_batch`` batches per process regardless of how
+        many processes stride it, so a checkpoint saved at dp=N resumes
+        correctly at any other dp (``engine.save_checkpoint(...,
+        data_iter=loader)`` carries this in the checkpoint)."""
+        return {"epoch": int(self.epoch),
+                "batch_in_epoch": int(self.batch_in_epoch)}
+
+    def load_state_dict(self, sd):
+        """Rewind/advance the stream to ``sd``'s position: re-seed the
+        shuffle at the saved epoch, then skip the already-consumed
+        batches. A loader exposing ``set_resume`` (DeepSpeedDataLoader,
+        PrefetchLoader) skips inside its index plan — nothing is
+        materialized; a generic iterator pulls and discards."""
+        epoch = int(sd.get("epoch", 0))
+        offset = int(sd.get("batch_in_epoch", 0))
+        self.epoch = epoch
+        set_epoch = getattr(self.loader, "set_epoch", None)
+        if set_epoch is not None:
+            set_epoch(epoch)
+        set_resume = getattr(self.loader, "set_resume", None)
+        if set_resume is not None:
+            set_resume(offset)
+            self.data_iter = iter(self.loader)
+        else:
+            self.data_iter = iter(self.loader)
+            for _ in range(offset):
+                next(self.data_iter)
+        self.batch_in_epoch = offset
 
 
 class DeepSpeedDataLoader:
@@ -80,6 +119,9 @@ class DeepSpeedDataLoader:
         # worker count; without prefetch the loader is synchronous and the
         # engine warns once that the knob has no effect
         self.num_local_io_workers = num_local_io_workers
+        # one-shot mid-epoch resume offset (set_resume): consumed by the
+        # next _index_plan, which drops the first k slices un-materialized
+        self._resume_batches = 0
         n = len(dataset)
         per_proc = n // process_count if drop_last else -(-n // process_count)
         if drop_last:
@@ -89,6 +131,14 @@ class DeepSpeedDataLoader:
 
     def set_epoch(self, epoch):
         self.epoch = epoch
+
+    def set_resume(self, batch_in_epoch):
+        """Skip the first *batch_in_epoch* batches of the NEXT iteration
+        (one-shot). Deterministic mid-epoch resume: the epoch's index
+        plan is a pure function of (seed, epoch), so dropping its first
+        slices reproduces the preempted run's exact remaining stream —
+        and skipped batches are never fetched or collated."""
+        self._resume_batches = max(0, int(batch_in_epoch))
 
     def __len__(self):
         return self.len
@@ -118,11 +168,15 @@ class DeepSpeedDataLoader:
                 order = np.arange(n)
             # host slice (DistributedSampler analogue): strided by process
             order = order[self.process_index::self.process_count]
+        skip, self._resume_batches = self._resume_batches, 0
         limit = self.len * self.batch_size
-        for start in range(0, min(len(order), limit), self.batch_size):
+        for bnum, start in enumerate(
+                range(0, min(len(order), limit), self.batch_size)):
             idx = order[start:start + self.batch_size]
             if self.drop_last and len(idx) < self.batch_size:
                 break
+            if bnum < skip:       # mid-epoch resume: already consumed
+                continue
             yield idx
 
     def materialize(self, idx):

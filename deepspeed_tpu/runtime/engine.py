@@ -31,7 +31,6 @@ the zero_to_fp32 converter work unchanged.
 
 import contextlib
 import os
-import pickle
 import time
 from typing import Any, NamedTuple, Optional
 
@@ -503,6 +502,15 @@ class DeepSpeedEngine:
         self._prefetch_wrap_cache = {}
         self._warned_io_workers = False
         self._warned_prefetch_host_only = False
+        self._warned_prefetch_stateful = False
+
+        # ---- async checkpointing (runtime/async_checkpoint.py) ------------
+        # snapshot-then-persist: save_checkpoint returns after the
+        # device->host snapshot; a background writer persists while
+        # training continues. Writer built lazily on the first async save.
+        self._ckpt_async = bool(getattr(self.config,
+                                        "checkpoint_async_save", False))
+        self._ckpt_writer = None
 
         # ---- dataloader (reference deepspeed_io, :1474) -------------------
         self.training_dataloader = None
@@ -2286,6 +2294,27 @@ class DeepSpeedEngine:
         # already prefetch-backed — don't stack a second pipeline on it
         if isinstance(getattr(data_iter, "loader", None), PrefetchLoader):
             return data_iter
+        if hasattr(data_iter, "state_dict") \
+                and hasattr(data_iter, "load_state_dict"):
+            # a STATEFUL iterator (RepeatingLoader) counts its position
+            # in __next__ — a background puller wrapped OUTSIDE it would
+            # advance (epoch, batch_in_epoch) up to `depth` batches ahead
+            # of what training consumed, and save_checkpoint(data_iter=)
+            # would record a future position (a resumed run would skip
+            # those batches). The correct composition is the pipeline
+            # INSIDE the counter: RepeatingLoader over a prefetch-enabled
+            # deepspeed_io loader.
+            if not self._warned_prefetch_stateful:
+                self._warned_prefetch_stateful = True
+                logger.warning(
+                    f"data_prefetch: not wrapping the stateful iterator "
+                    f"{type(data_iter).__name__!r} passed to train_batch "
+                    f"(a background puller would advance its resume "
+                    f"counters ahead of consumption); build the loader "
+                    f"via engine.deepspeed_io(...) and wrap THAT in "
+                    f"RepeatingLoader to get prefetch AND deterministic "
+                    f"resume")
+            return data_iter
         cached = self._prefetch_wrap_cache.get(id(data_iter))
         if cached is not None and cached[0] is data_iter:
             return cached[1]
@@ -2301,16 +2330,23 @@ class DeepSpeedEngine:
         return wrapped
 
     def close(self):
-        """Engine teardown: stop the prefetch pipelines (joins their
+        """Engine teardown: drain the async checkpoint writer (an
+        in-flight save finishes, a failed one re-raises HERE — its last
+        chance to surface), stop the prefetch pipelines (joins their
         worker threads) and close the telemetry manager. Idempotent; the
-        pipelines also self-close on exhaustion and at interpreter
+        pipelines and the writer also self-finalize at GC/interpreter
         exit, so this is the orderly path, not the only one."""
-        for pl in self._prefetchers:
-            pl.close()
-        for _src, wrapped in list(self._prefetch_wrap_cache.values()):
-            wrapped.close()
-        self._prefetch_wrap_cache.clear()
-        self.telemetry.close()
+        try:
+            if self._ckpt_writer is not None:
+                with self._led_attr("checkpoint_save"):
+                    self._ckpt_writer.close()
+        finally:
+            for pl in self._prefetchers:
+                pl.close()
+            for _src, wrapped in list(self._prefetch_wrap_cache.values()):
+                wrapped.close()
+            self._prefetch_wrap_cache.clear()
+            self.telemetry.close()
 
     # ------------------------------------------------------------ checkpoints
     def _get_ckpt_name(self, checkpoints_path, tag):
@@ -2326,71 +2362,147 @@ class DeepSpeedEngine:
                             f"zero_pp_rank_{pp_rank}_mp_rank_00" + OPTIM_FILE_SUFFIX)
 
     def save_checkpoint(self, save_dir, tag=None, client_state=None,
-                        save_latest=True):
+                        save_latest=True, data_iter=None):
         """Shard-aware save: every process writes its addressable shards of
         params + optimizer state to its zero_pp_rank file (reference
         per-rank partition files, engine.py:2345); process 0 additionally
         writes metadata (and full params when it can address them) to the
-        model-states file and the 'latest' tag (engine.py:2889)."""
-        from deepspeed_tpu.runtime import checkpoint_io
-        import deepspeed_tpu.comm as dist
+        model-states file, the per-tag completeness manifest, and the
+        'latest' tag pointer (engine.py:2889).
+
+        Two-phase (CheckFreq snapshot-then-persist): the SNAPSHOT copies
+        device state to host at the step boundary — the only phase the
+        train loop (and the goodput ledger's ``checkpoint_save``
+        category) pays for when ``checkpoint.async_save`` is on; the
+        PERSIST phase (pickle + fsync + atomic rename + manifest) then
+        runs on a background writer while training continues. A second
+        save drains the in-flight one first, and a background write
+        failure re-raises here (or at close()) rather than vanishing.
+
+        ``data_iter``: a :class:`RepeatingLoader` (or anything exposing
+        ``state_dict``) whose stream position is carried in the
+        checkpoint, so a preempted run resumes its exact batch stream."""
         if tag is None:
             tag = f"global_step{self.global_steps}"
+        tag = str(tag)
+        if self._ckpt_writer is not None:
+            # one save in flight, ever: drain the previous persist so two
+            # saves can never interleave files or race the latest pointer
+            # (the wait is honest checkpoint badput)
+            with self._led_attr("checkpoint_save"):
+                self._ckpt_writer.drain()
         with self._led_attr("checkpoint_save"), \
-                self.telemetry.span("checkpoint/save", tag=str(tag)):
-            return self._save_checkpoint(save_dir, tag, client_state,
+                self.telemetry.span("checkpoint/save", tag=tag):
+            self._validate_checkpoint_tag(tag)
+            os.makedirs(os.path.join(save_dir, tag), exist_ok=True)
+            snapshot = self._snapshot_checkpoint(client_state, data_iter)
+        if not self._ckpt_async:
+            with self._led_attr("checkpoint_save"), \
+                    self.telemetry.span("checkpoint/persist", tag=tag):
+                self._persist_checkpoint(save_dir, tag, snapshot,
                                          save_latest)
+            log_dist(f"saved checkpoint {save_dir}/{tag}", ranks=[0])
+            return True
+        reg = self.telemetry.registry
+        if reg is not None:
+            reg.counter("checkpoint_async_saves_total",
+                        "async (snapshot-then-persist) saves started").inc()
+        self._get_ckpt_writer().submit(
+            lambda: self._persist_checkpoint(save_dir, tag, snapshot,
+                                             save_latest), tag=tag)
+        log_dist(f"checkpoint {save_dir}/{tag}: snapshot taken, "
+                 f"persisting in background", ranks=[0])
+        return True
 
-    def _save_checkpoint(self, save_dir, tag, client_state, save_latest):
+    def _get_ckpt_writer(self):
+        if self._ckpt_writer is None:
+            from deepspeed_tpu.runtime.async_checkpoint import \
+                AsyncCheckpointWriter
+            self._ckpt_writer = AsyncCheckpointWriter()
+        return self._ckpt_writer
+
+    def _validate_checkpoint_tag(self, tag):
+        if not self.config.checkpoint_tag_validation_enabled:
+            return
+        # reference _checkpoint_tag_validation (engine.py:2693) +
+        # stage3's cross-rank consistency asserts: silently diverged
+        # hosts must not write a mixed checkpoint. Collectives — always
+        # on the main thread, never inside the background persist.
+        from deepspeed_tpu.utils.debug import (
+            assert_bytes_same_as_other_ranks,
+            assert_ints_same_as_other_ranks,
+            assert_shapes_same_as_other_ranks)
+        try:
+            assert_bytes_same_as_other_ranks(str(tag).encode(),
+                                             tag="checkpoint-tag")
+            assert_ints_same_as_other_ranks(
+                [self.global_steps, self.micro_steps],
+                tag="save_checkpoint")
+            assert_shapes_same_as_other_ranks(self.state.params,
+                                              tag="params")
+        except AssertionError as e:
+            if self.config.checkpoint_tag_validation_fail:
+                raise
+            log_dist(f"WARNING: cross-rank checkpoint mismatch "
+                     f"({e}); writing anyway (validation mode Warn)",
+                     ranks=[0])
+
+    def _snapshot_checkpoint(self, client_state, data_iter):
+        """Device->host snapshot of everything a save persists. With
+        async_save the copies are FORCED (``copy=True`` / deepcopy): the
+        train state is donated to the next step, so the background writer
+        must own its bytes outright — a view into a donated buffer would
+        pickle whatever the next step reused the memory for."""
         from deepspeed_tpu.runtime import checkpoint_io
         import deepspeed_tpu.comm as dist
-        if self.config.checkpoint_tag_validation_enabled:
-            # reference _checkpoint_tag_validation (engine.py:2693) +
-            # stage3's cross-rank consistency asserts: silently diverged
-            # hosts must not write a mixed checkpoint
-            from deepspeed_tpu.utils.debug import (
-                assert_bytes_same_as_other_ranks,
-                assert_ints_same_as_other_ranks,
-                assert_shapes_same_as_other_ranks)
-            try:
-                assert_bytes_same_as_other_ranks(str(tag).encode(),
-                                                 tag="checkpoint-tag")
-                assert_ints_same_as_other_ranks(
-                    [self.global_steps, self.micro_steps],
-                    tag="save_checkpoint")
-                assert_shapes_same_as_other_ranks(self.state.params,
-                                                  tag="params")
-            except AssertionError as e:
-                if self.config.checkpoint_tag_validation_fail:
-                    raise
-                log_dist(f"WARNING: cross-rank checkpoint mismatch "
-                         f"({e}); writing anyway (validation mode Warn)",
-                         ranks=[0])
-        os.makedirs(os.path.join(save_dir, str(tag)), exist_ok=True)
-
-        self._save_zero_checkpoint(save_dir, tag)
+        copy = self._ckpt_async
+        with self.telemetry.span("checkpoint/gather_shards"):
+            offload_sd = (self._offload_opt.state_dict()
+                          if self._offload_opt else None)
+            if copy and offload_sd is not None:
+                import copy as _copy
+                offload_sd = _copy.deepcopy(offload_sd)
+            zero_sd = {
+                "format": "shards-v1",
+                "optimizer_state_dict": checkpoint_io.tree_local_shards(
+                    self.state.opt_state, copy=copy),
+                "offload_optimizer_state": offload_sd,
+                "param_shards": checkpoint_io.tree_local_shards(
+                    self.state.params, copy=copy),
+                "scale_state": {k: np.array(jax.device_get(v), copy=True)
+                                for k, v in
+                                self.state.scale._asdict().items()},
+                "zero_stage": self.zero_stage,
+                "partition_count": self.dp_world_size,
+            }
+        snapshot = {"zero_sd": zero_sd, "params_tree": None, "meta": None}
         if dist.get_rank() != 0:
-            return True
+            return snapshot
 
         fully_addressable = all(
             getattr(x, "is_fully_addressable", True)
             for x in jax.tree.leaves(self.state.params))
-        model_np = (jax.tree.map(np.asarray, jax.device_get(self.state.params))
-                    if fully_addressable else None)
-        # MoE expert params get the reference's per-expert file layout
-        # (engine.py:2780 _save_moe_checkpoint): one
-        # layer_{L}_expert_{E}_mp_rank_XX file per global expert, with the
-        # non-moe state in the model-states file
-        moe_prefixes, moe_counts = [], []
-        if model_np is not None and isinstance(model_np, dict):
-            model_np, moe_prefixes, moe_counts = \
-                checkpoint_io.save_moe_experts(
-                    os.path.join(save_dir, str(tag)), model_np)
-        sd = {
-            "module": model_np,
-            "has_moe_layers": bool(moe_prefixes),
-            "moe_layer_prefixes": moe_prefixes,
-            "moe_expert_counts": moe_counts,
+        if fully_addressable:
+            # the params are ALREADY host-side in param_shards — don't
+            # copy them a second time on the critical path; the persist
+            # phase reassembles the full model-states tree from the
+            # shards (host numpy work, overlapped when async)
+            paths, treedef = jax.tree_util.tree_flatten_with_path(
+                self.state.params)
+            snapshot["params_tree"] = (
+                [jax.tree_util.keystr(p) for p, _ in paths], treedef)
+        it_state = None
+        if data_iter is not None:
+            sd_fn = getattr(data_iter, "state_dict", None)
+            if sd_fn is not None:
+                it_state = sd_fn()
+            else:
+                logger.warning(
+                    "save_checkpoint(data_iter=...): the iterator has no "
+                    "state_dict(); the data-stream position is NOT saved "
+                    "(wrap the loader in RepeatingLoader for "
+                    "deterministic resume)")
+        snapshot["meta"] = {
             "global_steps": self.global_steps,
             "global_samples": self.global_samples,
             "skipped_steps": self.skipped_steps,
@@ -2401,42 +2513,132 @@ class DeepSpeedEngine:
                 jax.device_get(self.state.scale.loss_scale))),
             "lr_scheduler": (self.lr_scheduler.state_dict()
                              if self.lr_scheduler else None),
+            "data_iterator": it_state,
             "ds_config": self.config._param_dict,
             "ds_version": "tpu-0.1",
             "client_state": client_state or {},
         }
-        checkpoint_io.dump_file(sd, self._get_ckpt_name(save_dir, tag),
-                                kind="model_states")
+        return snapshot
 
-        if save_latest:
-            with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
-                f.write(str(tag))
-        log_dist(f"saved checkpoint {save_dir}/{tag}", ranks=[0])
-        return True
-
-    def _save_zero_checkpoint(self, save_dir, tag):
+    def _persist_checkpoint(self, save_dir, tag, snapshot, save_latest):
+        """File half of a save — pure host I/O over the snapshot's
+        numpy, safe on the background writer thread (no device access,
+        no collectives). Durability order is the crash-consistency
+        contract: per-rank shard files (each atomic), model states,
+        THEN — after every rank's shard file exists — the completeness
+        manifest, and only then the ``latest`` pointer. A kill anywhere
+        leaves the previous checkpoint reachable and this tag
+        detectably incomplete."""
         from deepspeed_tpu.runtime import checkpoint_io
-        with self.telemetry.span("checkpoint/gather_shards"):
-            zero_sd = {
-                "format": "shards-v1",
-                "optimizer_state_dict": checkpoint_io.tree_local_shards(
-                    self.state.opt_state),
-                "offload_optimizer_state": (self._offload_opt.state_dict()
-                                            if self._offload_opt else None),
-                "param_shards": checkpoint_io.tree_local_shards(
-                    self.state.params),
-                "scale_state": {k: np.asarray(jax.device_get(v)) for k, v in
-                                self.state.scale._asdict().items()},
-                "zero_stage": self.zero_stage,
-                "partition_count": self.dp_world_size,
-            }
-        checkpoint_io.dump_file(zero_sd,
+        import deepspeed_tpu.comm as dist
+        tag_dir = os.path.join(save_dir, tag)
+        checkpoint_io.dump_file(snapshot["zero_sd"],
                                 self._get_zero_ckpt_name(save_dir, tag),
                                 kind="zero_states")
+        if snapshot["meta"] is None:       # not rank 0
+            return
+        meta = snapshot["meta"]
+        model_np = None
+        if snapshot["params_tree"] is not None:
+            # reassemble the full params from the snapshotted shards
+            # (bit-identical to a direct device_get: the shards carry
+            # their global indices)
+            pstrs, treedef = snapshot["params_tree"]
+            merged = checkpoint_io.assemble([snapshot["zero_sd"]
+                                             ["param_shards"]])
+            model_np = jax.tree_util.tree_unflatten(
+                treedef, [merged[p] for p in pstrs])
+        # MoE expert params get the reference's per-expert file layout
+        # (engine.py:2780 _save_moe_checkpoint): one
+        # layer_{L}_expert_{E}_mp_rank_XX file per global expert, with the
+        # non-moe state in the model-states file
+        moe_prefixes, moe_counts = [], []
+        if model_np is not None and isinstance(model_np, dict):
+            model_np, moe_prefixes, moe_counts = \
+                checkpoint_io.save_moe_experts(tag_dir, model_np)
+        sd = {
+            "module": model_np,
+            "has_moe_layers": bool(moe_prefixes),
+            "moe_layer_prefixes": moe_prefixes,
+            "moe_expert_counts": moe_counts,
+            **meta,
+        }
+        checkpoint_io.dump_file(sd, self._get_ckpt_name(save_dir, tag),
+                                kind="model_states")
+        # durability gate: all ranks' shard files, via the shared
+        # filesystem (file polling, deliberately collective-free — this
+        # may be a background thread)
+        n_proc = dist.get_process_count()
+        expected = [os.path.join(
+            tag_dir, f"zero_pp_rank_{r}_mp_rank_00" + OPTIM_FILE_SUFFIX)
+            for r in range(n_proc)]
+        checkpoint_io.wait_for_files(
+            expected, timeout_s=self.config.checkpoint_wait_timeout_s,
+            describe=f"all {n_proc} ranks' shard files of tag {tag!r}")
+        # re-saving an existing tag from a SMALLER world must not leave
+        # the old run's extra rank files behind: load's zero_pp_rank_*
+        # glob would mix shards from two different optimizer states, and
+        # the manifest below would certify the mix as intact
+        import glob as _glob
+        import re as _re
+        for f in _glob.glob(os.path.join(
+                tag_dir, "zero_pp_rank_*" + OPTIM_FILE_SUFFIX)):
+            m = _re.search(r"zero_pp_rank_(\d+)_", os.path.basename(f))
+            if m and int(m.group(1)) >= n_proc:
+                os.remove(f)
+        checkpoint_io.write_manifest(tag_dir, meta={
+            "tag": tag,
+            "global_steps": meta["global_steps"],
+            "dp_world_size": meta["dp_world_size"],
+            "processes": n_proc,
+        })
+        if save_latest:
+            checkpoint_io.write_latest(save_dir, LATEST_FILE, tag)
+
+    def _verify_load_tag(self, load_dir, tag, explicit_tag):
+        """Gate every load on the tag's completeness manifest. An intact
+        tag passes; a legacy (manifest-less) tag loads with a warning
+        (per-file atomicity still rules out truncated pickles); a
+        missing/empty/corrupt tag raises a clear error naming the tag
+        and directory — or, for implicit (``latest``-resolved) loads
+        with ``checkpoint.fallback_to_intact`` on, recovers to the
+        newest intact tag."""
+        from deepspeed_tpu.runtime import checkpoint_io
+        tag_dir = os.path.join(load_dir, tag)
+        status, detail = checkpoint_io.verify_tag(tag_dir)
+        if status == "intact":
+            return tag
+        if status == "legacy":
+            logger.warning(
+                f"checkpoint tag {tag!r} at {tag_dir} has no completeness "
+                f"manifest ({detail}); loading with per-file checks only")
+            return tag
+        source = ("requested" if explicit_tag
+                  else "named by the 'latest' pointer")
+        msg = (f"checkpoint tag {tag!r} ({source}) at {tag_dir} is not "
+               f"loadable: {detail}")
+        if explicit_tag or not self.config.checkpoint_fallback:
+            raise (FileNotFoundError(msg) if status == "missing"
+                   else RuntimeError(msg))
+        fallback = checkpoint_io.newest_intact_tag(load_dir, exclude=(tag,))
+        if fallback is None:
+            raise (FileNotFoundError if status == "missing"
+                   else RuntimeError)(
+                msg + "; no intact fallback tag exists under "
+                + str(load_dir))
+        logger.warning(f"{msg}; falling back to the newest intact tag "
+                       f"{fallback!r}")
+        return fallback
 
     def load_checkpoint(self, load_dir, tag=None, load_module_strict=True,
                         load_optimizer_states=True, load_lr_scheduler_states=True,
-                        load_module_only=False):
+                        load_module_only=False, data_iter=None):
+        if self._ckpt_writer is not None:
+            # an in-flight async save must be durable before tags are
+            # read — and its failure must surface here, not be read over
+            with self._led_attr("checkpoint_load"):
+                self._ckpt_writer.drain()
+        explicit_tag = tag is not None
         if tag is None:
             latest = os.path.join(load_dir, LATEST_FILE)
             if not os.path.isfile(latest):
@@ -2444,6 +2646,7 @@ class DeepSpeedEngine:
                 return None, {}
             with open(latest) as f:
                 tag = f.read().strip()
+        tag = self._verify_load_tag(load_dir, str(tag), explicit_tag)
 
         from deepspeed_tpu.runtime import checkpoint_io
         import glob as _glob
@@ -2511,11 +2714,15 @@ class DeepSpeedEngine:
                 elif self._offload:
                     # host-optimizer moments are SHARD-LOCAL: restore only
                     # from THIS process's own zero file; another rank's
-                    # moments belong to different param slices
+                    # moments belong to different param slices. Routed
+                    # through checkpoint_io.load_file so this read gets
+                    # the same span / byte-counter / ledger attribution
+                    # as every other checkpoint read (it used to be a
+                    # bare open()+pickle.load, invisible to telemetry)
                     own = self._get_zero_ckpt_name(load_dir, tag)
                     if os.path.isfile(own):
-                        with open(own, "rb") as f:
-                            self._pending_offload_sd = pickle.load(f).get(
+                        self._pending_offload_sd = checkpoint_io.load_file(
+                            own, kind="zero_states").get(
                                 "offload_optimizer_state")
                     else:
                         logger.warning(
@@ -2545,6 +2752,25 @@ class DeepSpeedEngine:
                             loss_scale=jnp.float32(ss["loss_scale"]),
                             good_steps=jnp.int32(ss["good_steps"]),
                             hysteresis=jnp.int32(ss["hysteresis"])))
+
+            # deterministic data-pipeline resume: rewind the caller's
+            # loader to the exact (epoch, batch offset) the save
+            # captured — composes with the prefetcher (the skip lives in
+            # the index plan) and set_epoch shuffle semantics
+            if data_iter is not None:
+                it_state = sd.get("data_iterator")
+                restore = getattr(data_iter, "load_state_dict", None)
+                if it_state is None:
+                    logger.warning(
+                        "load_checkpoint(data_iter=...): the checkpoint "
+                        "carries no data-iterator state (saved without "
+                        "data_iter=); the stream is NOT rewound")
+                elif restore is None:
+                    logger.warning(
+                        "load_checkpoint(data_iter=...): the iterator has "
+                        "no load_state_dict(); the stream is NOT rewound")
+                else:
+                    restore(it_state)
 
         self.state = new_state
         if self._offload:

@@ -397,6 +397,20 @@ class PrefetchLoader:
         if set_epoch is not None:
             set_epoch(epoch)
 
+    def set_resume(self, batch_in_epoch):
+        """Mid-epoch resume passthrough (RepeatingLoader.load_state_dict):
+        the skip lives in the wrapped loader's index plan, so the next
+        ``iter()``'s pipeline simply never schedules the skipped
+        batches."""
+        set_resume = getattr(self.loader, "set_resume", None)
+        if set_resume is not None:
+            set_resume(batch_in_epoch)
+        else:
+            raise AttributeError(
+                f"wrapped loader {type(self.loader).__name__!r} has no "
+                f"set_resume; mid-epoch resume needs a "
+                f"DeepSpeedDataLoader-style index plan")
+
     @property
     def epoch(self):
         return getattr(self.loader, "epoch", 0)

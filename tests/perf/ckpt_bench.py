@@ -1,0 +1,166 @@
+"""Checkpointing-under-preemption proof: CKPT_BENCH.json.
+
+Runs the SAME train-and-checkpoint loop twice — ``checkpoint.async_save``
+off, then on — and records what the train loop actually paid: wall-clock
+stall inside ``save_checkpoint`` (sync = snapshot + pickle + fsync +
+manifest on the critical path; async = snapshot only, the persist
+overlaps the next steps), the goodput ledger's ``checkpoint_save``
+seconds (the async run's must shrink to ~the snapshot time, with the
+categories still summing to elapsed), and the bytes written (equal by
+construction — the two modes persist identical files).
+
+The committed repo-root ``CKPT_BENCH.json`` is the acceptance artifact
+for the fault-tolerance runtime (ISSUE 7): async must stall the train
+loop >= 5x less than sync at equal checkpoint bytes. The script REFUSES
+to write a regen that fails the floors — a broken overlap must not be
+committed as the proof.
+
+Regenerate with:  python tests/perf/ckpt_bench.py
+(not collected by pytest — no test_ prefix, like the other perf scripts;
+the artifact's schema + floors are pinned by tests/unit/test_artifacts.py)
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+SCHEMA = "deepspeed_tpu.ckpt_bench/1"
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+
+HIDDEN = 768          # ~9.4 MB params -> ~38 MB checkpoint state: big
+NLAYERS = 4           # enough that per-file overheads don't dominate
+SAVES = 4
+STEPS_BETWEEN = 6     # step work the background persist overlaps with
+STALL_RATIO_FLOOR = 5.0
+
+
+def _run(async_save):
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import deepspeed_tpu
+    from deepspeed_tpu.models.simple import SimpleModel, sample_batch
+    from deepspeed_tpu.utils import groups
+    import numpy as np
+    groups.destroy()
+    groups.initialize()
+    ckpt_dir = tempfile.mkdtemp(prefix="ckpt_bench_")
+    snap_dir = tempfile.mkdtemp(prefix="ckpt_bench_telemetry_")
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=HIDDEN, nlayers=NLAYERS),
+        config={
+            "train_batch_size": 8,
+            "steps_per_print": 10 ** 9,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 2},
+            "checkpoint": {"async_save": async_save},
+            "telemetry": {
+                "enabled": True, "trace": False, "jsonl": False,
+                "prometheus": False,
+                "goodput": {"enabled": True, "cadence": 2,
+                            "profiler_capture": False,
+                            "snapshot_file": snap_dir + "/GOODPUT.json"}}},
+        sample_batch=sample_batch(8, HIDDEN), seed=42)
+
+    def batch(i):
+        rng = np.random.default_rng(i)
+        return (rng.standard_normal((8, HIDDEN)).astype(np.float32),
+                rng.standard_normal((8, HIDDEN)).astype(np.float32))
+
+    engine.train_batch(batch=batch(0))         # compile outside the loop
+    stalls = []
+    t_loop = time.perf_counter()
+    for k in range(SAVES):
+        t0 = time.perf_counter()
+        engine.save_checkpoint(ckpt_dir, tag=f"s{k}")
+        stalls.append(time.perf_counter() - t0)
+        for i in range(STEPS_BETWEEN):
+            engine.train_batch(batch=batch(1 + k * STEPS_BETWEEN + i))
+    loop_s = time.perf_counter() - t_loop
+    t0 = time.perf_counter()
+    if engine._ckpt_writer is not None:
+        engine._ckpt_writer.drain()
+    final_drain_s = time.perf_counter() - t0
+
+    rep = engine.goodput_report()
+    cats = rep["categories_s"]
+    sum_err = abs(sum(cats.values()) - rep["elapsed_s"]) / rep["elapsed_s"]
+    snap = engine.telemetry.registry.snapshot() or {}
+    write_bytes = sum(s["value"] for s in
+                      snap.get("checkpoint_write_bytes_total", []))
+    state_bytes = sum(
+        int(np.prod(x.shape)) * x.dtype.itemsize
+        for x in jax.tree.leaves({"p": engine.state.params,
+                                  "o": engine.state.opt_state}))
+    engine.close()
+    shutil.rmtree(ckpt_dir, ignore_errors=True)
+    shutil.rmtree(snap_dir, ignore_errors=True)
+    return {
+        "train_loop_stall_s": round(sum(stalls), 4),
+        "stall_per_save_ms": [round(s * 1e3, 2) for s in stalls],
+        "final_drain_ms": round(final_drain_s * 1e3, 2),
+        "ledger_checkpoint_save_s": round(cats["checkpoint_save"], 4),
+        "ledger_checkpoint_save_frac": round(
+            cats["checkpoint_save"] / rep["elapsed_s"], 4),
+        "ledger_categories_sum_err_frac": round(sum_err, 6),
+        "ledger_goodput_fraction": rep["goodput_fraction"],
+        "write_bytes": int(write_bytes),
+        "write_mb_s": round(write_bytes / 1e6 / max(loop_s, 1e-9), 1),
+        "device_state_bytes": int(state_bytes),
+    }
+
+
+def main(write=True):
+    sync = _run(async_save=False)
+    async_ = _run(async_save=True)
+    ratio = sync["train_loop_stall_s"] / async_["train_loop_stall_s"]
+    doc = {
+        "schema": SCHEMA,
+        "scenario": {
+            "model": f"SimpleModel(hidden={HIDDEN}, nlayers={NLAYERS})",
+            "zero_stage": 2,
+            "saves": SAVES,
+            "steps_between_saves": STEPS_BETWEEN,
+            "platform": "cpu (8 virtual devices)",
+        },
+        "sync": sync,
+        "async": async_,
+        "stall_ratio": round(ratio, 3),
+    }
+    out = json.dumps(doc, indent=2)
+    print(out)
+    errs = []
+    if ratio < STALL_RATIO_FLOOR:
+        errs.append(f"stall_ratio {ratio:.2f} < {STALL_RATIO_FLOOR} — the "
+                    f"async overlap regressed")
+    if abs(sync["write_bytes"] - async_["write_bytes"]) > \
+            0.01 * sync["write_bytes"]:
+        errs.append("sync and async runs did not write equal checkpoint "
+                    "bytes — the comparison is not apples-to-apples")
+    if async_["ledger_checkpoint_save_s"] > \
+            sync["ledger_checkpoint_save_s"] / 3:
+        errs.append("the ledger's async checkpoint_save did not shrink "
+                    "to ~the snapshot time")
+    if max(sync["ledger_categories_sum_err_frac"],
+           async_["ledger_categories_sum_err_frac"]) > 0.01:
+        errs.append("ledger categories stopped summing to elapsed — the "
+                    "suppress_attribution wiring broke")
+    if errs:
+        for e in errs:
+            print(f"# REFUSING to write: {e}", file=sys.stderr)
+        return 1
+    if write:
+        with open(os.path.join(ROOT, "CKPT_BENCH.json"), "w") as f:
+            f.write(out + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,14 +14,26 @@ Modes via DS_MP_MODE:
               DIFFERENT world size (elastic dp resize) and keep training
   uneven    — feed a wrong-sized per-process slice; expect the loud
               rejection from engine._globalize_batch
+  truth     — uninterrupted run over a RepeatingLoader: the loss
+              trajectory the kill/resume scenario must reproduce
+  preempt   — train mid-epoch, checkpoint WITH the data-iterator state,
+              print the CHECKPOINTED marker, then train forever — the
+              harness SIGKILLs the processes mid-step (Bamboo-style
+              preemption as a first-class tested event)
+  preempt_resume — load the preempted checkpoint at a DIFFERENT dp
+              world size, rewind the data stream, continue training
 """
 
 import json
 import os
 import sys
 
-os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
-                           " --xla_force_host_platform_device_count=2")
+# devices per process: 2 by default; the preemption tests resize the
+# worker's dp world ACROSS a SIGKILL by restarting with a different count
+_DEVICES = int(os.environ.get("DS_MP_DEVICES", "2"))
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") +
+    f" --xla_force_host_platform_device_count={_DEVICES}")
 os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402
@@ -61,6 +73,22 @@ def my_slice(rank, nproc, gx, gy):
     return gx[lo:lo + per], gy[lo:lo + per]
 
 
+def make_loader(engine):
+    """Deterministic shared dataset behind a RepeatingLoader. Both the
+    epoch length (dataset/global_batch) and the per-batch GLOBAL row
+    set are world-size invariant (deepspeed_io strides the dataset and
+    the batch size by process count equally), so the same (epoch,
+    batch offset) position yields the same global batch at any dp."""
+    from deepspeed_tpu.models.simple import random_dataset
+    from deepspeed_tpu.runtime.dataloader import RepeatingLoader
+    return RepeatingLoader(engine.deepspeed_io(
+        random_dataset(32, HIDDEN, seed=11)))
+
+
+PREEMPT_STEPS = 5      # mid epoch 2: 32/8 = 4 batches per epoch
+TRUTH_STEPS = 8
+
+
 def main():
     out_dir = sys.argv[1]
     mode = os.environ.get("DS_MP_MODE", "train_save")
@@ -70,7 +98,7 @@ def main():
     want = os.environ.get("DS_NUM_PROCESSES")  # launcher path sets JAX_*
     if want is not None:
         assert nproc == int(want), nproc
-    assert jax.device_count() == 2 * nproc, jax.device_count()
+    assert jax.device_count() == _DEVICES * nproc, jax.device_count()
 
     engine = make_engine()
     rng = np.random.default_rng(7)
@@ -98,6 +126,43 @@ def main():
             print(f"worker {rank} UNEVEN-REJECTED OK", flush=True)
             return
         raise SystemExit("uneven slice was NOT rejected")
+
+    if mode == "truth":
+        it = make_loader(engine)
+        losses = [float(engine.train_batch(data_iter=it))
+                  for _ in range(TRUTH_STEPS)]
+        dist.barrier()
+        with open(os.path.join(out_dir, f"truth_losses_{rank}.json"),
+                  "w") as f:
+            json.dump(losses, f)
+        print(f"worker {rank} TRUTH OK", flush=True)
+        return
+
+    if mode == "preempt":
+        it = make_loader(engine)
+        for _ in range(PREEMPT_STEPS):
+            engine.train_batch(data_iter=it)
+        engine.save_checkpoint(os.path.join(out_dir, "ck_pre"), tag="pre",
+                               data_iter=it)
+        dist.barrier()       # every rank's files durable before the marker
+        print(f"worker {rank} CHECKPOINTED", flush=True)
+        while True:          # train until the harness SIGKILLs us
+            engine.train_batch(data_iter=it)
+
+    if mode == "preempt_resume":
+        it = make_loader(engine)
+        engine.load_checkpoint(os.path.join(out_dir, "ck_pre"), tag="pre",
+                               data_iter=it)
+        assert engine.global_steps == PREEMPT_STEPS, engine.global_steps
+        losses = [float(engine.train_batch(data_iter=it))
+                  for _ in range(TRUTH_STEPS - PREEMPT_STEPS)]
+        dist.barrier()
+        with open(os.path.join(out_dir,
+                               f"resumed_preempt_losses_{rank}.json"),
+                  "w") as f:
+            json.dump(losses, f)
+        print(f"worker {rank} RESUME-PREEMPT OK", flush=True)
+        return
 
     if mode == "resume":
         # elastic dp resize: the checkpoint was saved by a run with a

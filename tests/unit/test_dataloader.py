@@ -164,3 +164,58 @@ class TestCollate:
         dl = DeepSpeedDataLoader(_int_dataset(8), batch_size=4,
                                  collate_fn=lambda samples: tuple(samples))
         assert next(iter(dl)) == (0, 1, 2, 3)
+
+
+class TestMidEpochResume:
+    """state_dict/load_state_dict on RepeatingLoader + set_resume on the
+    loader: the (epoch, batch offset) pair pins the exact position in
+    the epoch-seeded shuffle stream (preemption resume, ISSUE 7)."""
+
+    def _repeating(self, n=12, bs=4, shuffle=True):
+        return RepeatingLoader(DeepSpeedDataLoader(
+            _int_dataset(n), batch_size=bs, shuffle=shuffle))
+
+    def test_state_dict_tracks_epoch_and_offset(self):
+        it = self._repeating()          # 3 batches/epoch
+        assert it.state_dict() == {"epoch": 0, "batch_in_epoch": 0}
+        for _ in range(4):
+            next(it)
+        assert it.state_dict() == {"epoch": 1, "batch_in_epoch": 1}
+
+    def test_load_state_dict_resumes_exact_stream(self):
+        ref = self._repeating()
+        stream = [np.asarray(next(ref)).copy() for _ in range(10)]
+        for k in (0, 1, 4, 7):          # incl. epoch boundaries
+            src = self._repeating()
+            for _ in range(k):
+                next(src)
+            fresh = self._repeating()
+            fresh.load_state_dict(src.state_dict())
+            got = [np.asarray(next(fresh)).copy() for _ in range(10 - k)]
+            for r, g in zip(stream[k:], got):
+                np.testing.assert_array_equal(r, g)
+
+    def test_set_resume_skips_without_materializing(self):
+        fetched = []
+
+        class Spy(DeepSpeedDataLoader):
+            def materialize(self, idx):
+                fetched.append(list(idx))
+                return super().materialize(idx)
+
+        dl = Spy(_int_dataset(12), batch_size=4, shuffle=True)
+        dl.set_resume(2)
+        batches = list(dl)
+        assert len(batches) == 1        # only the unconsumed tail
+        assert len(fetched) == 1        # skipped batches never fetched
+        # one-shot: the next epoch iteration is full again
+        assert len(list(dl)) == 3
+
+    def test_generic_iterator_fallback_pulls_and_discards(self):
+        class NoResume:
+            """loader-shaped, but no set_resume / index plan"""
+            def __iter__(self):
+                return iter(range(10))
+        it = RepeatingLoader(NoResume())
+        it.load_state_dict({"epoch": 0, "batch_in_epoch": 3})
+        assert next(it) == 3

@@ -443,8 +443,14 @@ class TestEngineIntegration:
 
     def test_train_batch_wraps_user_iterator_once(self):
         engine = _make_engine(enabled=True)
-        it = RepeatingLoader(DeepSpeedDataLoader(
-            random_dataset(64, HIDDEN), batch_size=8))
+
+        def forever():
+            while True:
+                for b in DeepSpeedDataLoader(random_dataset(64, HIDDEN),
+                                             batch_size=8):
+                    yield b
+
+        it = forever()
         engine.train_batch(data_iter=it)
         assert len(engine._prefetch_wrap_cache) == 1
         (src, wrapped), = engine._prefetch_wrap_cache.values()
@@ -453,6 +459,25 @@ class TestEngineIntegration:
         assert len(engine._prefetch_wrap_cache) == 1
         (_, wrapped2), = engine._prefetch_wrap_cache.values()
         assert wrapped2 is wrapped      # one pipeline per iterator
+        engine.close()
+        _assert_no_threads()
+
+    def test_stateful_iterator_not_wrapped(self):
+        """A RepeatingLoader over a NON-prefetch-backed loader passes
+        through unwrapped: a background puller outside the counter would
+        advance its (epoch, batch_in_epoch) resume state ahead of what
+        training consumed, so save_checkpoint(data_iter=...) would
+        record a future position and a resumed run would skip batches.
+        The supported composition — RepeatingLoader over a
+        prefetch-enabled deepspeed_io loader — keeps both."""
+        engine = _make_engine(enabled=True)
+        it = RepeatingLoader(DeepSpeedDataLoader(
+            random_dataset(64, HIDDEN), batch_size=8))
+        engine.train_batch(data_iter=it)
+        engine.train_batch(data_iter=it)
+        assert not engine._prefetch_wrap_cache     # never wrapped
+        # the recorded position is exactly what training consumed
+        assert it.state_dict() == {"epoch": 0, "batch_in_epoch": 2}
         engine.close()
         _assert_no_threads()
 
