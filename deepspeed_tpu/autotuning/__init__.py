@@ -1,3 +1,8 @@
-"""Reference deepspeed/autotuning/__init__.py surface."""
+"""Reference deepspeed/autotuning/__init__.py surface, plus the
+TPU-native goodput-driven two-stage tuner (tune.py)."""
 
 from deepspeed_tpu.autotuning.autotuner import Autotuner  # noqa: F401
+from deepspeed_tpu.autotuning.tune import (GoodputTuner,  # noqa: F401
+                                           GuidedCostModelTuner,
+                                           TUNE_REPORT_SCHEMA,
+                                           TuneCandidate)

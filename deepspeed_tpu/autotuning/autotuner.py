@@ -168,11 +168,25 @@ class Autotuner:
 
     @staticmethod
     def _detect_device_memory():
-        try:
-            stats = jax.devices()[0].memory_stats()
-            return stats.get("bytes_limit", 16 << 30)
-        except Exception:
-            return 16 << 30
+        """Device memory budget, via the SAME detection chain the PR-2
+        pre-flight uses (``cost_explorer.device_hbm_bytes``: allocator
+        ``bytes_limit``, else the chip peak table) so stage pruning and
+        the HBM watermark pre-flight agree on the budget, then the
+        telemetry registry's host-RSS fallback
+        (``metrics.device_memory_stats``) for CPU/virtual meshes — a
+        lower bound of the host budget, better than a made-up constant;
+        runs that care (tests, benches) pass an explicit budget."""
+        from deepspeed_tpu.telemetry.cost_explorer import device_hbm_bytes
+        from deepspeed_tpu.telemetry.metrics import device_memory_stats
+        hbm = device_hbm_bytes()
+        if hbm:
+            return int(hbm)
+        stats = device_memory_stats()
+        for key in ("bytes_limit", "host_rss_bytes",
+                    "host_peak_rss_bytes"):
+            if stats.get(key):
+                return int(stats[key])
+        return 16 << 30
 
     # ------------------------------------------------------------- pruning
     def prune_stages(self, dp_world: int) -> List[int]:

@@ -1,0 +1,812 @@
+"""Goodput-driven autotuner: compile-time pruning, measured probes, one
+winning config.
+
+The reference autotuner (PAPER.md §5, ``deepspeed/autotuning``) searches
+micro-batch/ZeRO configs by launching whole trial training jobs and
+grepping their profiles. This rebuild closes the same loop with the
+instruments PRs 1-5 built, in two stages:
+
+**Stage 1 — zero-execution pruning.** Every candidate is built as an
+*abstract* engine (``abstract_init=True`` — no array ever materialises)
+and its step program(s) are AOT ``lower().compile()``d exactly once
+(``engine.lower_step_programs``, the same machinery as
+``runtime/zero/aot_check.py``). The compiled artifact's
+``memory_analysis`` gives the true HBM watermark — candidates that
+cannot fit ``memory_headroom x budget`` are rejected with reason
+``"hbm"`` having never executed an instruction — and the
+``hlo_census``/``CostExplorer`` roofline ranks the survivors by
+predicted cost per sample.
+
+**Stage 2 — measured probes.** The top-K survivors (plus the base
+config) run short in-process probes through the existing
+``ResourceManager``: a materialised twin engine ADOPTS the stage-1
+compiled artifact (``engine.adopt_compiled_step``) so the probe compiles
+nothing, runs ``probe_steps`` steps, and is scored by the goodput
+ledger: ``score = (step_time / goodput_fraction) / samples_per_step`` —
+an input-bound or overflow-thrashing config cannot win by shrinking
+device compute, because its badput inflates the score. Probe order is
+the ``CostModelTuner`` family seeded with the stage-1 predictions
+(``GuidedCostModelTuner``), and measured scores feed back into the
+model. Engines are fully torn down between probes (``engine.close()``
+joins prefetch/checkpoint/ledger threads and drops the AOT artifacts).
+
+The run emits ``TUNE_REPORT.json`` — every candidate with its
+pruned/probed status, reject reason, predicted cost and measured
+goodput-scored step time — plus the winning full config dict.
+
+CLI::
+
+    python -m deepspeed_tpu.autotuning.tune --config ds_config.json
+
+reads the ``autotuning`` config block (see CONFIG.md) and runs the demo
+model factories; library users call ``GoodputTuner`` with their own
+``model_factory`` / ``make_batch`` / ``data_factory``.
+"""
+
+import copy
+import itertools
+import json
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from deepspeed_tpu.autotuning.autotuner import Autotuner, CostModelTuner
+from deepspeed_tpu.utils.logging import logger
+
+TUNE_REPORT_SCHEMA = "deepspeed_tpu.tune_report/1"
+
+# dims with engine-level meaning beyond a dotted config path
+SPECIAL_DIMS = ("micro_batch", "gas", "zero_stage", "prefetch_depth")
+
+# relative-ranking pseudo peaks for chips the explorer cannot identify
+# (CPU test meshes): absolute seconds are meaningless there, but the
+# per-candidate ORDER is still driven by the censused flops/bytes/wire
+_PSEUDO_PEAKS = {"peak_tflops": 1.0, "peak_hbm_gbps": 100.0,
+                 "ici_gbps": 25.0}
+
+
+class GuidedCostModelTuner(CostModelTuner):
+    """``CostModelTuner`` whose cold-start picks follow the stage-1
+    predicted-cost prior (best-predicted first) instead of random, and
+    whose feature matrix carries the prediction as an extra column so
+    the ridge / boosted-tree model can calibrate the static roofline
+    against measured goodput as probes accrue. A (seeded) epsilon of
+    random exploration survives from the base class as the escape hatch
+    from a miscalibrated prior; probe budgets are small, so the default
+    is leaner than the base's 0.2."""
+
+    def __init__(self, configs: List[Dict], prior_costs: List[float],
+                 seed: int = 0, explore_ratio: float = 0.1):
+        super().__init__(configs, seed=seed, explore_ratio=explore_ratio)
+        assert len(prior_costs) == len(configs)
+        self.prior = [float(p) for p in prior_costs]
+        self.X = np.concatenate(
+            [self.X, np.asarray(self.prior, np.float64)[:, None]], axis=1)
+        self.keys = list(self.keys) + ["predicted_cost"]
+
+    def next(self) -> Optional[Dict]:
+        rest = self._unvisited()
+        if not rest:
+            return None
+        if len(self.xs) < self.INIT_NUM:
+            idx = min(rest, key=lambda i: self.prior[i])
+        elif self.explore_ratio and \
+                self.rng.random() < self.explore_ratio:
+            # genuine exploration (the base class's epsilon) — an escape
+            # hatch from a miscalibrated static prior, NOT another
+            # prior-greedy pick
+            idx = self.rng.choice(rest)
+        else:
+            self.model.fit(self.X[self.xs], np.asarray(self.ys))
+            pred = self.model.predict(self.X[rest])
+            idx = rest[int(np.argmax(pred))]
+        self.visited.add(idx)
+        self._pending = idx
+        return self.configs[idx]
+
+    def mark_measured(self, config: Dict, perf: Optional[float]):
+        """Record a measurement taken OUTSIDE the next() protocol (the
+        forced base-config probe) so the model still learns from it."""
+        for i, c in enumerate(self.configs):
+            if c is config:
+                self.visited.add(i)
+                self._pending = i
+                self.update(config, perf)
+                return
+        raise ValueError("mark_measured: config is not in the space")
+
+
+class TuneCandidate:
+    """One point of the declared space: overrides + the derived full
+    config, stage-1 artifacts and results, stage-2 probe results."""
+
+    def __init__(self, cand_id: int, overrides: Dict[str, Any],
+                 config: Dict, model_kwargs: Dict[str, Any]):
+        self.id = cand_id
+        self.overrides = overrides
+        self.config = config
+        self.model_kwargs = model_kwargs
+        self.status = "pending"
+        self.reject_reason: Optional[str] = None
+        self.error: Optional[str] = None
+        self.compiled: Optional[Dict[str, Any]] = None   # name -> Compiled
+        self.programs: List[str] = []
+        self.hbm_watermark_bytes: Optional[int] = None
+        self.predicted_step_s: Optional[float] = None
+        self.predicted_cost_s_per_sample: Optional[float] = None
+        self.predicted_rank: Optional[int] = None
+        self.probe: Optional[Dict[str, Any]] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "id": self.id,
+            "overrides": self.overrides,
+            "status": self.status,
+            "reject_reason": self.reject_reason,
+            "error": self.error,
+            "programs": self.programs,
+            "hbm_watermark_bytes": self.hbm_watermark_bytes,
+            "predicted_step_s": self.predicted_step_s,
+            "predicted_cost_s_per_sample": self.predicted_cost_s_per_sample,
+            "predicted_rank": self.predicted_rank,
+            "probe": self.probe,
+        }
+
+
+def _set_dotted(cfg: Dict, dotted: str, value):
+    node = cfg
+    parts = dotted.split(".")
+    for k in parts[:-1]:
+        node = node.setdefault(k, {})
+    node[parts[-1]] = value
+
+
+class GoodputTuner:
+    """Two-stage goodput-driven config search. See the module docstring.
+
+    Parameters
+    ----------
+    model_factory: ``model_factory(**model_kwargs) -> flax module`` —
+        ``model_kwargs`` come from ``model.<kwarg>`` space dims (remat,
+        attention impl, ...); called once per trial engine.
+    make_batch: ``make_batch(per_dispatch_batch_size) -> batch`` — one
+        synthetic batch at the per-dispatch size (micro_batch x dp);
+        used for engine shape init and stage-1 lowering.
+    base_config: the user's DeepSpeed config dict — candidate 0, the
+        yardstick the winner must beat.
+    data_factory: optional ``data_factory(per_dispatch_batch_size) ->
+        iterable of batches`` feeding the measured probes (pass the real
+        input pipeline here so goodput scoring sees real input
+        behavior); defaults to repeating ``make_batch``'s batch.
+    space: ``{dim: [values]}`` — ``micro_batch`` / ``gas`` /
+        ``zero_stage`` / ``prefetch_depth`` are engine-level dims,
+        ``model.<kwarg>`` dims go to ``model_factory``, anything else is
+        a dotted config path.
+
+    Remaining knobs mirror the ``autotuning`` config block and are
+    overridden by it when ``from_config`` is used.
+    """
+
+    def __init__(self,
+                 model_factory: Callable[..., Any],
+                 make_batch: Callable[[int], Any],
+                 base_config: Dict,
+                 data_factory: Optional[Callable[[int], Any]] = None,
+                 space: Optional[Dict[str, List]] = None,
+                 metric: str = "goodput",
+                 top_k: int = 3,
+                 probe_steps: int = 8,
+                 probe_warmup_steps: int = 2,
+                 memory_headroom: float = 0.95,
+                 hbm_budget_bytes: Optional[int] = None,
+                 results_dir: str = "autotuning_results",
+                 report_file: str = "TUNE_REPORT.json",
+                 seed: int = 0):
+        self.model_factory = model_factory
+        self.make_batch = make_batch
+        self.base_config = base_config
+        self.data_factory = data_factory or self._default_data_factory
+        self.space = dict(space or {})
+        assert metric in ("goodput", "step_time"), metric
+        self.metric = metric
+        self.top_k = int(top_k)
+        self.probe_steps = int(probe_steps)
+        self.probe_warmup_steps = int(probe_warmup_steps)
+        self.memory_headroom = float(memory_headroom)
+        self._budget_explicit = hbm_budget_bytes is not None
+        self.hbm_budget_bytes = int(
+            hbm_budget_bytes if hbm_budget_bytes is not None
+            else Autotuner._detect_device_memory())
+        self.results_dir = results_dir
+        self.report_file = report_file
+        self.seed = int(seed)
+        self.candidates: List[TuneCandidate] = []
+        self._compiles = {"train_step": 0, "aux": 0}
+        self._probe_extra_compiles = 0
+        self._by_cfg_id: Dict[int, TuneCandidate] = {}
+
+    @classmethod
+    def from_config(cls, base_config: Dict, model_factory, make_batch,
+                    data_factory=None, space=None, **overrides):
+        """Build from the ``autotuning`` block inside ``base_config``
+        (env overrides already applied by the config parser); explicit
+        kwargs win over the block."""
+        from deepspeed_tpu.runtime.config import DeepSpeedAutotuningConfig
+        at = DeepSpeedAutotuningConfig(base_config
+                                       if isinstance(base_config, dict)
+                                       else {})
+        kw = dict(
+            space=space if space is not None else at.space,
+            metric=at.metric, top_k=at.top_k,
+            probe_steps=at.probe_steps,
+            probe_warmup_steps=at.probe_warmup_steps,
+            memory_headroom=at.memory_headroom,
+            hbm_budget_bytes=(int(at.hbm_budget_gb * 1024 ** 3)
+                              if at.hbm_budget_gb else None),
+            results_dir=at.results_dir, report_file=at.report_file,
+            seed=at.seed)
+        kw.update(overrides)
+        return cls(model_factory, make_batch, base_config,
+                   data_factory=data_factory, **kw)
+
+    # ------------------------------------------------------------ space
+    def _default_data_factory(self, batch_size):
+        batch = self.make_batch(batch_size)
+
+        def _repeat():
+            while True:
+                yield batch
+        return _repeat()
+
+    def _dp_world(self) -> int:
+        import jax
+        from deepspeed_tpu.utils import groups
+        if groups.mesh_is_initialized():
+            return groups.get_data_parallel_world_size()
+        return jax.device_count()
+
+    def build_candidates(self) -> List[TuneCandidate]:
+        """Base config (id 0, empty overrides) + the cartesian product
+        of the declared space, deduplicated against the base."""
+        from deepspeed_tpu.runtime.config import DeepSpeedConfig
+        dp = self._dp_world()
+        parsed = DeepSpeedConfig(copy.deepcopy(self.base_config),
+                                 data_parallel_size=dp)
+        base_micro = parsed.train_micro_batch_size_per_gpu
+        base_gas = parsed.gradient_accumulation_steps
+
+        def derive(overrides: Dict[str, Any]) -> (Dict, Dict):
+            cfg = copy.deepcopy(self.base_config)
+            micro = int(overrides.get("micro_batch", base_micro))
+            gas = int(overrides.get("gas", base_gas))
+            cfg["train_micro_batch_size_per_gpu"] = micro
+            cfg["gradient_accumulation_steps"] = gas
+            cfg["train_batch_size"] = micro * gas * dp
+            model_kwargs = {}
+            for key, val in overrides.items():
+                if key in ("micro_batch", "gas"):
+                    continue
+                if key == "zero_stage":
+                    cfg["zero_optimization"] = dict(
+                        cfg.get("zero_optimization", {}) or {},
+                        stage=int(val))
+                elif key == "prefetch_depth":
+                    cfg["data_prefetch"] = dict(
+                        cfg.get("data_prefetch", {}) or {},
+                        enabled=int(val) > 0, depth=max(int(val), 1))
+                elif key.startswith("model."):
+                    model_kwargs[key[len("model."):]] = val
+                else:
+                    _set_dotted(cfg, key, val)
+            return cfg, model_kwargs
+
+        def cand_sig(cfg, mk):
+            # dedup on the PARSED config, not the raw dict: an override
+            # that merely materialises a block the base omits (zero
+            # stage 0, prefetch off, ...) is the SAME trial and must not
+            # burn a compile or a probe slot on a duplicate. The parser
+            # normalises every schema default; unparseable candidates
+            # fall back to the raw text (stage 1 will record the error).
+            try:
+                parsed = DeepSpeedConfig(copy.deepcopy(cfg),
+                                         data_parallel_size=dp)
+                body = {k: v for k, v in parsed.__dict__.items()
+                        if not k.startswith("_")}
+            except Exception:
+                body = cfg
+            return json.dumps(body, sort_keys=True, default=repr) + \
+                json.dumps(mk, sort_keys=True, default=repr)
+
+        cands = [TuneCandidate(0, {}, *derive({}))]
+        seen = {cand_sig(cands[0].config, cands[0].model_kwargs)}
+        keys = sorted(self.space)
+        for combo in itertools.product(*[self.space[k] for k in keys]):
+            overrides = dict(zip(keys, combo))
+            cfg, mk = derive(overrides)
+            sig = cand_sig(cfg, mk)
+            if sig in seen:
+                continue
+            seen.add(sig)
+            cands.append(TuneCandidate(len(cands), overrides, cfg, mk))
+        self.candidates = cands
+        self._by_cfg_id = {id(c.config): c for c in cands}
+        return cands
+
+    # ---------------------------------------------------------- stage 1
+    def _dispatch_batch_size(self, cand: TuneCandidate) -> int:
+        return int(cand.config["train_micro_batch_size_per_gpu"]) * \
+            self._dp_world()
+
+    def _ranking_explorer(self):
+        """One CostExplorer for the whole run; unknown chips (CPU test
+        meshes) get pseudo peaks so ranking still works."""
+        if getattr(self, "_explorer", None) is None:
+            from deepspeed_tpu.telemetry.cost_explorer import CostExplorer
+            ex = CostExplorer()
+            if not ex.peak_tflops:
+                ex.peak_tflops = _PSEUDO_PEAKS["peak_tflops"]
+            if not ex.peak_hbm_gbps:
+                ex.peak_hbm_gbps = _PSEUDO_PEAKS["peak_hbm_gbps"]
+            if not ex.ici_gbps:
+                ex.ici_gbps = _PSEUDO_PEAKS["ici_gbps"]
+            self._explorer = ex
+        return self._explorer
+
+    def _predicted_step_seconds(self, census, invocations: int) -> float:
+        """Roofline floor of one global step: the max of the compute /
+        memory / comm lower bounds (the census covers ONE dispatch;
+        ``invocations`` = gas scales it to the full step)."""
+        ex = self._ranking_explorer()
+        flops = census.flops * invocations
+        nbytes = census.bytes_accessed * invocations
+        wire = census.total_wire_bytes * invocations
+        floors = [flops / (ex.peak_tflops * 1e12),
+                  nbytes / (ex.peak_hbm_gbps * 1e9)]
+        if wire:
+            floors.append(wire / (ex.ici_gbps * 1e9))
+        return max(floors)
+
+    def _stage1_config(self, cand: TuneCandidate) -> Dict:
+        """The abstract twin's config: telemetry stripped (no manager
+        side effects; abstract engines never own an artifact anyway)."""
+        cfg = copy.deepcopy(cand.config)
+        cfg.pop("telemetry", None)
+        return cfg
+
+    def _stage1_compile(self, cand: TuneCandidate):
+        """Abstract-build the candidate, AOT-compile its step program(s)
+        ONCE, census + HBM-prune + rank. Zero device execution: the
+        engine is ``abstract_init`` — no parameter, batch or state array
+        ever materialises on a device."""
+        import deepspeed_tpu
+        from deepspeed_tpu.telemetry.hlo_census import census_compiled
+        batch = self.make_batch(self._dispatch_batch_size(cand))
+        engine = None
+        try:
+            engine, _, _, _ = deepspeed_tpu.initialize(
+                model=self.model_factory(**cand.model_kwargs),
+                config=self._stage1_config(cand),
+                sample_batch=batch, abstract_init=True, seed=self.seed)
+            lowereds = engine.lower_step_programs(batch)
+            cand.programs = sorted(lowereds)
+            compiled, censuses = {}, {}
+            for name, low in lowereds.items():
+                compiled[name] = low.compile()
+                key = ("train_step"
+                       if name in ("fused_train_step", "micro_step")
+                       else "aux")
+                self._compiles[key] += 1
+                censuses[name] = census_compiled(compiled[name],
+                                                 mesh=engine.mesh)
+            cand.compiled = compiled
+            main = ("fused_train_step" if "fused_train_step" in compiled
+                    else "micro_step")
+            # peak static watermark over every program the step runs
+            cand.hbm_watermark_bytes = max(
+                c.hbm_watermark_bytes for c in censuses.values())
+            limit = self.hbm_budget_bytes * self.memory_headroom
+            if cand.hbm_watermark_bytes > limit:
+                cand.status = "pruned"
+                cand.reject_reason = "hbm"
+                cand.compiled = None        # pruned: drop the artifact
+                logger.info(
+                    "[autotune] candidate %d %s PRUNED at compile time: "
+                    "HBM watermark %.3f GiB > %.2f x %.3f GiB budget",
+                    cand.id, cand.overrides,
+                    cand.hbm_watermark_bytes / 1024 ** 3,
+                    self.memory_headroom,
+                    self.hbm_budget_bytes / 1024 ** 3)
+                return
+            gas = int(cand.config.get("gradient_accumulation_steps", 1))
+            cand.predicted_step_s = self._predicted_step_seconds(
+                censuses[main], gas)
+            cand.predicted_cost_s_per_sample = (
+                cand.predicted_step_s
+                / int(cand.config["train_batch_size"]))
+            cand.status = "survivor"
+        except Exception as e:
+            cand.status = "failed"
+            cand.error = f"{type(e).__name__}: {e}"
+            cand.compiled = None
+            logger.warning("[autotune] candidate %d %s failed stage 1: %s",
+                           cand.id, cand.overrides, cand.error)
+        finally:
+            if engine is not None:
+                engine.close()
+
+    # ---------------------------------------------------------- stage 2
+    def _trial_config(self, cand: TuneCandidate) -> Dict:
+        """The materialised probe's config: force-enable the cost
+        explorer (so the engine owns an ``_AOTStep`` to adopt the
+        stage-1 artifact into) and the goodput ledger (the probe's
+        score); snapshots/rules are pointed away from the run's cwd and
+        the cadence is pushed past the probe so no window machinery
+        fires mid-measurement."""
+        cfg = copy.deepcopy(cand.config)
+        cfg.setdefault("steps_per_print", 10 ** 9)
+        tel = dict(cfg.get("telemetry", {}) or {})
+        tel["enabled"] = True
+        tel.setdefault("trace", False)
+        tel.setdefault("jsonl", False)
+        tel.setdefault("prometheus", False)
+        tel["cost_explorer"] = dict(tel.get("cost_explorer", {}) or {},
+                                    enabled=True)
+        # the stage-1 artifact was compiled WITHOUT the health stats
+        # variant (abstract engines force it off) — a probe engine with
+        # health on would unpack one more output than the adopted
+        # program returns; probes are measurements, not health runs
+        tel["health"] = {"enabled": False}
+        tel["goodput"] = dict(
+            tel.get("goodput", {}) or {},
+            enabled=True, profiler_capture=False,
+            snapshot_file=os.path.join(self.results_dir,
+                                       f"trial_{cand.id}_GOODPUT.json"))
+        cfg["telemetry"] = tel
+        return cfg
+
+    def _probe_run_fn(self, config: Dict) -> float:
+        """ResourceManager entry point: config -> goodput-scored
+        samples/sec (HIGHER is better, the scheduler/tuner convention);
+        details land on the candidate."""
+        cand = self._by_cfg_id.get(id(config))
+        assert cand is not None, "probe config not from this tuner's space"
+        return self._run_probe(cand)
+
+    def _run_probe(self, cand: TuneCandidate) -> float:
+        """One measured probe: materialised engine, stage-1 artifact
+        adopted (nothing compiles), ``probe_warmup_steps`` then
+        ``probe_steps`` timed steps, scored by the ledger's goodput
+        fraction over exactly the timed window. The engine is fully torn
+        down afterwards."""
+        import jax
+        import deepspeed_tpu
+        from deepspeed_tpu.telemetry.ledger import GoodputLedger
+        bs = self._dispatch_batch_size(cand)
+        engine = None
+        try:
+            batch = self.make_batch(bs)
+            engine, _, _, _ = deepspeed_tpu.initialize(
+                model=self.model_factory(**cand.model_kwargs),
+                config=self._trial_config(cand),
+                sample_batch=batch, seed=self.seed)
+            adopted = []
+            # a health-variant engine (DS_TELEMETRY_HEALTH=1 overrides
+            # the trial config's force-off) returns MORE outputs than
+            # the health-off stage-1 artifact — skip adoption and let
+            # the probe compile its own variant (the report's compile
+            # accounting records the fallback honestly)
+            if cand.compiled and not engine._health_on:
+                adopted = sorted(engine.adopt_compiled_step(
+                    cand.compiled, batch=batch))
+            data_iter = iter(self.data_factory(bs))
+            for _ in range(self.probe_warmup_steps):
+                engine.train_batch(data_iter=data_iter)
+            jax.block_until_ready(jax.tree.leaves(engine.state.params))
+            led = engine._goodput
+            led_on = led is not None and led.enabled
+            t0 = time.perf_counter()
+            el0 = led.elapsed() if led_on else 0.0
+            tot0 = led.totals() if led_on else {}
+            for _ in range(self.probe_steps):
+                engine.train_batch(data_iter=data_iter)
+            jax.block_until_ready(jax.tree.leaves(engine.state.params))
+            wall_s = time.perf_counter() - t0
+            step_time_s = wall_s / self.probe_steps
+            goodput_fraction = None
+            window = None
+            if led_on:
+                dur = led.elapsed() - el0
+                tot1 = led.totals()
+                window = {c: round(tot1[c] - tot0.get(c, 0.0), 6)
+                          for c in tot1}
+                goodput_fraction = GoodputLedger.goodput_fraction(
+                    window, dur)
+            goodput_scored = (self.metric == "goodput"
+                              and goodput_fraction is not None)
+            if goodput_scored:
+                goodput_step_time_s = step_time_s / max(
+                    goodput_fraction, 1e-3)
+            else:
+                goodput_step_time_s = step_time_s
+            samples = int(engine.train_batch_size())
+            score = goodput_step_time_s / samples
+            # compile accounting: EVERY stage-1 program must have been
+            # executed from its adopted artifact, never a fresh compile
+            # — checked per program (an apply_step that silently
+            # recompiled would otherwise hide behind the main program's
+            # clean receipt)
+            reused = bool(cand.compiled)
+            fallbacks = 0
+            for name, comp in (cand.compiled or {}).items():
+                aot = engine._aot_step_for(name)
+                if aot is None or aot.compiled is not comp:
+                    reused = False
+                if aot is not None:
+                    fallbacks += int(aot.fallback_calls)
+            if cand.compiled and not reused:
+                self._probe_extra_compiles += 1
+            self._probe_extra_compiles += fallbacks
+            cand.probe = {
+                "steps": self.probe_steps,
+                "warmup_steps": self.probe_warmup_steps,
+                "step_time_s": round(step_time_s, 6),
+                "goodput_fraction": (round(goodput_fraction, 6)
+                                     if goodput_fraction is not None
+                                     else None),
+                "goodput_scored": goodput_scored,
+                "goodput_step_time_s": round(goodput_step_time_s, 6),
+                "score_s_per_sample": score,
+                "samples_per_sec": round(samples / goodput_step_time_s, 3),
+                "categories_s": window,
+                "adopted": adopted,
+                "artifact_reused": reused,
+                "aot_fallback_calls": fallbacks,
+            }
+            cand.status = "probed"
+            logger.info(
+                "[autotune] probe %d %s: step %.2f ms, goodput %.3f -> "
+                "scored %.2f ms (%.1f samples/s)",
+                cand.id, cand.overrides, step_time_s * 1e3,
+                goodput_fraction if goodput_fraction is not None else -1,
+                goodput_step_time_s * 1e3, samples / goodput_step_time_s)
+            return samples / goodput_step_time_s
+        finally:
+            if engine is not None:
+                engine.close()
+
+    # ------------------------------------------------------------- tune
+    def tune(self):
+        """Run both stages; returns ``(best_config_dict, report_dict)``
+        and writes ``report_file``."""
+        from deepspeed_tpu.autotuning.scheduler import ResourceManager
+        os.makedirs(self.results_dir, exist_ok=True)
+        if not self.candidates:
+            self.build_candidates()
+        t_start = time.perf_counter()
+
+        # ---- stage 1: compile/prune/rank, zero device execution ------
+        for cand in self.candidates:
+            self._stage1_compile(cand)
+        survivors = [c for c in self.candidates if c.status == "survivor"]
+        if not survivors:
+            self._write_report(None, time.perf_counter() - t_start)
+            raise RuntimeError(
+                "autotuning: no candidate survived compile-time pruning "
+                f"(budget {self.hbm_budget_bytes / 1024 ** 3:.3f} GiB x "
+                f"{self.memory_headroom} headroom) — see "
+                f"{self.report_file}")
+        for rank, cand in enumerate(sorted(
+                survivors, key=lambda c: c.predicted_cost_s_per_sample)):
+            cand.predicted_rank = rank
+        logger.info(
+            "[autotune] stage 1: %d candidates -> %d pruned (hbm), "
+            "%d failed, %d survivors",
+            len(self.candidates),
+            sum(c.status == "pruned" for c in self.candidates),
+            sum(c.status == "failed" for c in self.candidates),
+            len(survivors))
+
+        # ---- stage 2: measured probes through the ResourceManager ----
+        rm = ResourceManager(run_fn=self._probe_run_fn,
+                             exps_dir=os.path.join(self.results_dir,
+                                                   "exps"))
+        tuner = GuidedCostModelTuner(
+            [c.config for c in survivors],
+            [c.predicted_cost_s_per_sample for c in survivors],
+            seed=self.seed)
+
+        def probe(cand, via_tuner):
+            exp = rm.schedule_experiments([cand.config])[0]
+            rm.run()
+            if exp.metric is None:
+                cand.status = "probe_failed"
+                cand.error = exp.error
+            if via_tuner:
+                tuner.update(cand.config, exp.metric)
+            else:
+                tuner.mark_measured(cand.config, exp.metric)
+            return exp.metric
+
+        base = self.candidates[0]
+        if base.status == "survivor":
+            # the yardstick is probed unconditionally — the report's
+            # "winner beats base" claim needs a measured base
+            probe(base, via_tuner=False)
+        probed_nonbase = 0
+        while probed_nonbase < self.top_k:
+            cfg = tuner.next()
+            if cfg is None:
+                break
+            cand = self._by_cfg_id[id(cfg)]
+            if probe(cand, via_tuner=True) is not None:
+                # a crashed probe must not consume a measurement slot —
+                # the tuner's visited set already prevents re-picking
+                # it, so the next-best survivor gets the probe instead
+                probed_nonbase += 1
+        for cand in survivors:
+            if cand.status == "survivor":
+                cand.status = "ranked_out"
+
+        probed = [c for c in self.candidates if c.status == "probed"]
+        if not probed:
+            self._write_report(None, time.perf_counter() - t_start)
+            raise RuntimeError("autotuning: every probe failed — see "
+                               f"{self.report_file}")
+        winner = min(probed, key=lambda c: c.probe["score_s_per_sample"])
+        report = self._write_report(winner,
+                                    time.perf_counter() - t_start)
+        for cand in self.candidates:    # artifacts served their purpose
+            cand.compiled = None
+        return winner.config, report
+
+    def _write_report(self, winner, elapsed_s):
+        ex = self._ranking_explorer()
+        base = self.candidates[0] if self.candidates else None
+        base_probe = base.probe if base is not None else None
+        winner_entry = None
+        if winner is not None:
+            vs_base = None
+            if base_probe and winner is not base:
+                vs_base = round(base_probe["score_s_per_sample"]
+                                / winner.probe["score_s_per_sample"], 4)
+            elif winner is base:
+                vs_base = 1.0
+            winner_entry = {
+                "id": winner.id,
+                "overrides": winner.overrides,
+                "score_s_per_sample": winner.probe["score_s_per_sample"],
+                "goodput_fraction": winner.probe["goodput_fraction"],
+                "vs_base_speedup": vs_base,
+                "config": winner.config,
+            }
+        report = {
+            "schema": TUNE_REPORT_SCHEMA,
+            "generated_by": "deepspeed_tpu.autotuning.tune",
+            "metric": self.metric,
+            "elapsed_s": round(elapsed_s, 3),
+            "dp_world": self._dp_world(),
+            "device": {
+                "device_kind": ex.device_kind,
+                "memory_budget_bytes": self.hbm_budget_bytes,
+                "memory_headroom": self.memory_headroom,
+                "budget_source": ("explicit" if self._budget_explicit
+                                  else "detected"),
+            },
+            "space": {k: list(v) for k, v in self.space.items()},
+            "n_candidates": len(self.candidates),
+            "stage1": {
+                "pruned": sum(c.status == "pruned"
+                              for c in self.candidates),
+                "failed": sum(c.status == "failed"
+                              for c in self.candidates),
+                "survivors": sum(c.status in ("survivor", "ranked_out",
+                                              "probed", "probe_failed")
+                                 for c in self.candidates),
+            },
+            "stage2": {
+                "probed": sum(c.status == "probed"
+                              for c in self.candidates),
+                "probe_failed": sum(c.status == "probe_failed"
+                                    for c in self.candidates),
+                "probe_steps": self.probe_steps,
+                "probe_warmup_steps": self.probe_warmup_steps,
+                "top_k": self.top_k,
+            },
+            "compile": {
+                "train_step_compiles": self._compiles["train_step"],
+                "aux_program_compiles": self._compiles["aux"],
+                "candidates_compiled": sum(
+                    c.hbm_watermark_bytes is not None
+                    for c in self.candidates),
+                "probe_train_step_compiles": self._probe_extra_compiles,
+            },
+            "candidates": [c.to_dict() for c in self.candidates],
+            "winner": winner_entry,
+        }
+        path = self.report_file
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(report, f, indent=1, default=repr, allow_nan=False)
+        return report
+
+
+# ------------------------------------------------------------------ CLI
+def main(argv=None):
+    import argparse
+    parser = argparse.ArgumentParser(
+        prog="python -m deepspeed_tpu.autotuning.tune",
+        description="Goodput-driven autotuner: compile-time pruning + "
+                    "measured probes over a demo model (library users "
+                    "call GoodputTuner with their own factories)")
+    parser.add_argument("--config", help="DeepSpeed config JSON (with an "
+                        "optional 'autotuning' block)")
+    parser.add_argument("--model", default="simple",
+                        choices=("simple", "linear"))
+    parser.add_argument("--hidden", type=int, default=128)
+    parser.add_argument("--nlayers", type=int, default=2)
+    parser.add_argument("--space", help="JSON search space, e.g. "
+                        "'{\"micro_batch\": [1, 4, 16]}'")
+    parser.add_argument("--top-k", type=int, default=None)
+    parser.add_argument("--probe-steps", type=int, default=None)
+    parser.add_argument("--hbm-budget-gb", type=float, default=None)
+    parser.add_argument("--out", default=None,
+                        help="report path (overrides the config block)")
+    args = parser.parse_args(argv)
+
+    if args.config:
+        with open(args.config) as f:
+            base = json.load(f)
+    else:
+        import jax
+        base = {"train_batch_size": jax.device_count(),
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}}
+
+    from deepspeed_tpu.models.simple import (LinearStack, SimpleModel,
+                                             sample_batch)
+    hidden, nlayers = args.hidden, args.nlayers
+    if args.model == "simple":
+        def model_factory(**kw):
+            return SimpleModel(hidden_dim=hidden,
+                               nlayers=kw.get("nlayers", nlayers))
+    else:
+        def model_factory(**kw):
+            return LinearStack(input_dim=hidden, hidden_dim=hidden,
+                               output_dim=hidden,
+                               num_layers=kw.get("num_layers", nlayers))
+
+    def make_batch(bs):
+        return tuple(np.asarray(x) for x in sample_batch(bs, hidden))
+
+    space = json.loads(args.space) if args.space else None
+    overrides = {}
+    if args.top_k is not None:
+        overrides["top_k"] = args.top_k
+    if args.probe_steps is not None:
+        overrides["probe_steps"] = args.probe_steps
+    if args.hbm_budget_gb is not None:
+        overrides["hbm_budget_bytes"] = int(
+            args.hbm_budget_gb * 1024 ** 3)
+    if args.out:
+        overrides["report_file"] = args.out
+    if space is None and not (base.get("autotuning", {}) or {}).get("space"):
+        space = {"micro_batch": [1, 4, 16]}
+    tuner = GoodputTuner.from_config(base, model_factory, make_batch,
+                                     space=space, **overrides)
+    best, report = tuner.tune()
+    w = report["winner"]
+    print(json.dumps({
+        "winner_overrides": w["overrides"],
+        "score_s_per_sample": w["score_s_per_sample"],
+        "vs_base_speedup": w["vs_base_speedup"],
+        "pruned": report["stage1"]["pruned"],
+        "probed": report["stage2"]["probed"],
+        "report": tuner.report_file}, indent=1))
+    return best
+
+
+if __name__ == "__main__":
+    main()

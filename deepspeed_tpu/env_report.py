@@ -87,6 +87,9 @@ def telemetry_report():
         "(data_prefetch block; host workers + device double-buffering)")
     row("serving engine (paged KV)", True,
         "(serving block; continuous batching + chunked prefill + top-p)")
+    row("goodput autotuner (2-stage)", True,
+        "(autotuning block; compile-time pruning + measured probes -> "
+        "TUNE_REPORT.json)")
     try:
         from deepspeed_tpu.telemetry.ledger import profiler_available
         row("jax.profiler programmatic capture", profiler_available(),

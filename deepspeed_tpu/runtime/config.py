@@ -294,6 +294,82 @@ class DeepSpeedServingConfig(DeepSpeedConfigObject):
                 f"{self.decode_steps}")
 
 
+class DeepSpeedAutotuningConfig(DeepSpeedConfigObject):
+    """``autotuning`` block (autotuning/tune.py): goodput-driven
+    two-stage config search — compile-time pruning of the declared
+    space, then measured probes of the top-K survivors scored by the
+    goodput ledger. The block carries the tuner's defaults;
+    ``GoodputTuner.from_config`` / the ``python -m
+    deepspeed_tpu.autotuning.tune`` CLI consume it (the engine itself
+    never autotunes mid-run).
+
+    Env overrides (sweep ergonomics): ``DS_AUTOTUNING`` = 1/0
+    force-toggles ``enabled``; ``DS_AUTOTUNING_TOP_K`` overrides
+    ``top_k``; ``DS_AUTOTUNING_REPORT`` overrides ``report_file``."""
+
+    def __init__(self, param_dict):
+        a = param_dict.get(C.AUTOTUNING, {}) or {}
+        self.enabled = a.get(C.AUTOTUNING_ENABLED,
+                             C.AUTOTUNING_ENABLED_DEFAULT)
+        self.metric = a.get(C.AUTOTUNING_METRIC, C.AUTOTUNING_METRIC_DEFAULT)
+        self.top_k = int(a.get(C.AUTOTUNING_TOP_K,
+                               C.AUTOTUNING_TOP_K_DEFAULT))
+        self.probe_steps = int(a.get(C.AUTOTUNING_PROBE_STEPS,
+                                     C.AUTOTUNING_PROBE_STEPS_DEFAULT))
+        self.probe_warmup_steps = int(a.get(
+            C.AUTOTUNING_PROBE_WARMUP, C.AUTOTUNING_PROBE_WARMUP_DEFAULT))
+        self.memory_headroom = float(a.get(
+            C.AUTOTUNING_MEMORY_HEADROOM,
+            C.AUTOTUNING_MEMORY_HEADROOM_DEFAULT))
+        self.hbm_budget_gb = float(a.get(C.AUTOTUNING_HBM_BUDGET_GB,
+                                         C.AUTOTUNING_HBM_BUDGET_GB_DEFAULT))
+        self.report_file = a.get(C.AUTOTUNING_REPORT_FILE,
+                                 C.AUTOTUNING_REPORT_FILE_DEFAULT)
+        self.results_dir = a.get(C.AUTOTUNING_RESULTS_DIR,
+                                 C.AUTOTUNING_RESULTS_DIR_DEFAULT)
+        self.seed = int(a.get(C.AUTOTUNING_SEED, C.AUTOTUNING_SEED_DEFAULT))
+        self.space = a.get(C.AUTOTUNING_SPACE, C.AUTOTUNING_SPACE_DEFAULT)
+        env = os.environ.get("DS_AUTOTUNING")
+        if env is not None:
+            self.enabled = env.lower() in ("1", "true", "yes", "on")
+        env_k = os.environ.get("DS_AUTOTUNING_TOP_K")
+        if env_k:
+            self.top_k = int(env_k)
+        env_r = os.environ.get("DS_AUTOTUNING_REPORT")
+        if env_r:
+            self.report_file = env_r
+        if self.metric not in ("goodput", "step_time"):
+            raise DeepSpeedConfigError(
+                f"autotuning.metric must be 'goodput' or 'step_time', "
+                f"got {self.metric!r}")
+        if self.top_k < 1:
+            raise DeepSpeedConfigError(
+                f"autotuning.top_k must be >= 1, got {self.top_k}")
+        if self.probe_steps < 1:
+            raise DeepSpeedConfigError(
+                f"autotuning.probe_steps must be >= 1, got "
+                f"{self.probe_steps}")
+        if self.probe_warmup_steps < 0:
+            raise DeepSpeedConfigError(
+                f"autotuning.probe_warmup_steps must be >= 0, got "
+                f"{self.probe_warmup_steps}")
+        if not 0.0 < self.memory_headroom <= 1.0:
+            raise DeepSpeedConfigError(
+                f"autotuning.memory_headroom must be in (0, 1], got "
+                f"{self.memory_headroom}")
+        if self.hbm_budget_gb < 0:
+            raise DeepSpeedConfigError(
+                f"autotuning.hbm_budget_gb must be >= 0 (0 = detect), "
+                f"got {self.hbm_budget_gb}")
+        if self.space is not None and (
+                not isinstance(self.space, dict)
+                or not all(isinstance(v, list) and v
+                           for v in self.space.values())):
+            raise DeepSpeedConfigError(
+                "autotuning.space must map each dimension name to a "
+                "non-empty list of values")
+
+
 class DeepSpeedFlopsProfilerConfig(DeepSpeedConfigObject):
     def __init__(self, param_dict):
         fp = param_dict.get(C.FLOPS_PROFILER, {}) or {}
@@ -612,6 +688,8 @@ class DeepSpeedConfig:
         self.dataloader_drop_last = pd.get(C.DATALOADER_DROP_LAST, None)
         self.data_prefetch = DeepSpeedDataPrefetchConfig(pd)
         self.serving = DeepSpeedServingConfig(pd)
+        self.autotuning = DeepSpeedAutotuningConfig(pd)
+        self.autotuning_enabled = self.autotuning.enabled
         self.gradient_accumulation_dtype = pd.get(C.GRADIENT_ACCUMULATION_FORMAT, None)
 
     # -- batch triangulation (reference config.py:926-1004) -----------------

@@ -419,6 +419,46 @@ SERVING_ATTENTION_IMPL_DEFAULT = "paged"
 SERVING_DECODE_STEPS = "decode_steps"
 SERVING_DECODE_STEPS_DEFAULT = 1
 
+# autotuning: goodput-driven two-stage config search (autotuning/tune.py).
+# Stage 1 AOT-compiles every candidate ONCE (abstract engines — zero
+# device execution), rejects candidates whose HBM watermark exceeds
+# `memory_headroom` x the device budget (`hbm_budget_gb` 0 -> the same
+# memory_stats/host-RSS detection chain the telemetry registry uses) and
+# ranks survivors by roofline cost; stage 2 probes the top `top_k`
+# survivors for `probe_steps` measured steps each (after
+# `probe_warmup_steps`), scored by the goodput ledger's goodput fraction
+# (metric "goodput") or raw wall time (metric "step_time"). The run
+# emits `report_file` (TUNE_REPORT.json). DS_AUTOTUNING=1/0 force-
+# toggles `enabled`; DS_AUTOTUNING_TOP_K / DS_AUTOTUNING_REPORT override
+# top_k / report_file.
+AUTOTUNING = "autotuning"
+AUTOTUNING_ENABLED = "enabled"
+AUTOTUNING_ENABLED_DEFAULT = False
+AUTOTUNING_METRIC = "metric"
+AUTOTUNING_METRIC_DEFAULT = "goodput"
+AUTOTUNING_TOP_K = "top_k"
+AUTOTUNING_TOP_K_DEFAULT = 3
+AUTOTUNING_PROBE_STEPS = "probe_steps"
+AUTOTUNING_PROBE_STEPS_DEFAULT = 8
+AUTOTUNING_PROBE_WARMUP = "probe_warmup_steps"
+AUTOTUNING_PROBE_WARMUP_DEFAULT = 2
+AUTOTUNING_MEMORY_HEADROOM = "memory_headroom"
+AUTOTUNING_MEMORY_HEADROOM_DEFAULT = 0.95
+AUTOTUNING_HBM_BUDGET_GB = "hbm_budget_gb"
+AUTOTUNING_HBM_BUDGET_GB_DEFAULT = 0
+AUTOTUNING_REPORT_FILE = "report_file"
+AUTOTUNING_REPORT_FILE_DEFAULT = "TUNE_REPORT.json"
+AUTOTUNING_RESULTS_DIR = "results_dir"
+AUTOTUNING_RESULTS_DIR_DEFAULT = "autotuning_results"
+AUTOTUNING_SEED = "seed"
+AUTOTUNING_SEED_DEFAULT = 0
+# declared search space: {dim: [values]} — special dims micro_batch /
+# gas / zero_stage / prefetch_depth, "model.<kwarg>" dims forwarded to
+# the model factory (remat, attention impl, ...), anything else a
+# dotted config path set into each candidate's config dict
+AUTOTUNING_SPACE = "space"
+AUTOTUNING_SPACE_DEFAULT = None
+
 # Pipeline
 PIPE_REPLICATED = "ds_pipe_replicated"
 PIPELINE = "pipeline"

@@ -902,6 +902,23 @@ class DeepSpeedEngine:
         self._health_last_loss = None      # device scalar loss (no sync)
         self._health_last_obs_step = -1
 
+    def _abstract_step_args(self, batch):
+        """(batch_sharded, rng, theta) ShapeDtypeStructs for AOT-lowering
+        a step program at this engine's shapes — ``batch`` may be arrays
+        or ShapeDtypeStructs; only avals are read."""
+        import numpy as _np
+        batch_sds = jax.tree.map(
+            lambda x: x if isinstance(x, jax.ShapeDtypeStruct)
+            else jax.ShapeDtypeStruct(_np.shape(x), _np.asarray(x).dtype),
+            batch)
+        rng_sds = jax.eval_shape(lambda: jax.random.PRNGKey(0))
+        theta_sds = jax.ShapeDtypeStruct((), jnp.float32)
+        batch_sharded = jax.tree.map(
+            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype,
+                                               sharding=sh),
+            batch_sds, self._batch_sharding(batch_sds))
+        return batch_sharded, rng_sds, theta_sds
+
     def lower_train_step(self, batch):
         """AOT-lower the fused global train step (gas=1) at the engine's
         shapes WITHOUT executing anything — the at-scale proof for
@@ -915,23 +932,53 @@ class DeepSpeedEngine:
         assert self._jit_train is not None, (
             "lower_train_step needs the fused gas=1 step (gradient "
             "accumulation > 1 lowers per-microbatch programs instead)")
-        import numpy as _np
-        batch_sds = jax.tree.map(
-            lambda x: x if isinstance(x, jax.ShapeDtypeStruct)
-            else jax.ShapeDtypeStruct(_np.shape(x), _np.asarray(x).dtype),
-            batch)
-        rng_sds = jax.eval_shape(lambda: jax.random.PRNGKey(0))
-        theta_sds = jax.ShapeDtypeStruct((), jnp.float32)
         with self.mesh:
-            batch_sharded = jax.tree.map(
-                lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype,
-                                                   sharding=sh),
-                batch_sds, self._batch_sharding(batch_sds))
+            batch_sharded, rng_sds, theta_sds = \
+                self._abstract_step_args(batch)
             # the compile-watch wrapper (if any) hides the AOT surface
             jit_train = getattr(self._jit_train, "_compile_watch_target",
                                 self._jit_train)
             return jit_train.lower(self.state, batch_sharded,
                                    rng_sds, theta_sds)
+
+    def lower_step_programs(self, batch):
+        """AOT-lower every program one global step dispatches, WITHOUT
+        executing anything: ``{"fused_train_step": Lowered}`` for the
+        gas=1 fused config, ``{"micro_step": ..., "apply_step": ...}``
+        for gradient accumulation (or wall_clock_breakdown) configs.
+        ``batch`` is ONE dispatch's batch (micro_batch x dp samples —
+        the same shape ``train_batch`` pulls from its iterator); arrays
+        or ShapeDtypeStructs.
+
+        This is the autotuner's stage-1 surface: compile each Lowered
+        once, census/prune/rank the candidate, then hand the artifacts
+        to a materialised twin engine via ``adopt_compiled_step`` so the
+        measured probe compiles nothing."""
+        assert self._abstract_init, (
+            "lower_step_programs is the abstract_init=True surface; a "
+            "materialised engine owns its programs via the cost explorer")
+        with self.mesh:
+            batch_sharded, rng_sds, theta_sds = \
+                self._abstract_step_args(batch)
+            out = {}
+            if self._jit_train is not None:
+                jit_train = getattr(self._jit_train,
+                                    "_compile_watch_target",
+                                    self._jit_train)
+                out["fused_train_step"] = jit_train.lower(
+                    self.state, batch_sharded, rng_sds, theta_sds)
+            else:
+                jit_micro = getattr(self._jit_micro,
+                                    "_compile_watch_target",
+                                    self._jit_micro)
+                out["micro_step"] = jit_micro.lower(
+                    self.state, batch_sharded, rng_sds, theta_sds)
+                if self._jit_apply is not None and not self._offload:
+                    jit_apply = getattr(self._jit_apply,
+                                        "_compile_watch_target",
+                                        self._jit_apply)
+                    out["apply_step"] = jit_apply.lower(self.state)
+            return out
 
     def _build_sparse_mask(self, params):
         """Flat boolean mask over the param leaves: True = embedding table
@@ -1239,7 +1286,11 @@ class DeepSpeedEngine:
     def _install_aot_steps(self):
         """Cost-explorer mode: own the step programs' compiled artifacts
         (see _AOTStep). The TRAIN entry points only — eval/offload
-        auxiliaries are not the program being explained."""
+        auxiliaries are not the program being explained. apply_step rides
+        along (gas>1 dispatches it once per global step) so the autotuner
+        can hand a gas>1 trial BOTH of its stage-1 artifacts and the probe
+        compiles nothing; its census never overwrites the step census
+        (_on_step_compiled filters by name)."""
         if not self._cost_explorer_on:
             return
         if self._jit_train is not None:
@@ -1247,6 +1298,9 @@ class DeepSpeedEngine:
                                        self._on_step_compiled)
         self._jit_micro = _AOTStep(self._jit_micro, "micro_step",
                                    self._on_step_compiled)
+        if self._jit_apply is not None:
+            self._jit_apply = _AOTStep(self._jit_apply, "apply_step",
+                                       self._on_step_compiled)
 
     def _build_onebit_step_fns(self):
         """Step fns for the compressed 1-bit optimizers (reference
@@ -1378,6 +1432,11 @@ class DeepSpeedEngine:
         """First-dispatch hook from _AOTStep: census the artifact and run
         the HBM watermark pre-flight BEFORE the program first executes."""
         from deepspeed_tpu.telemetry.hlo_census import census_compiled
+        if name not in ("fused_train_step", "micro_step"):
+            # apply_step (and any future auxiliary) is owned for artifact
+            # reuse only — the per-step census/pre-flight describe the
+            # TRAIN program, which an auxiliary must never overwrite
+            return
         # the fused step supersedes the micro census (it is the whole
         # program); a micro census never overwrites a fused one
         if self._cost_census is not None and \
@@ -1391,6 +1450,71 @@ class DeepSpeedEngine:
         if getattr(self.config.telemetry, "cost_explorer_preflight", True):
             explorer.preflight(self._cost_census, name=name)
         explorer.publish(self._cost_census)
+
+    def _aot_step_for(self, name):
+        """The ``_AOTStep`` dispatcher behind a step entry point (unwraps
+        the compile-watch layer), or None when the cost explorer is off /
+        the program does not exist in this configuration."""
+        attr = {"fused_train_step": "_jit_train",
+                "micro_step": "_jit_micro",
+                "apply_step": "_jit_apply"}.get(name)
+        if attr is None:
+            return None
+        fn = getattr(self, attr, None)
+        if fn is None:
+            return None
+        target = getattr(fn, "_compile_watch_target", fn)
+        return target if isinstance(target, _AOTStep) else None
+
+    def adopt_compiled_step(self, compiled_map, batch):
+        """Prime this engine's owned-AOT dispatchers with EXTERNALLY
+        compiled artifacts (``{program_name: jax.stages.Compiled}`` from
+        an abstract twin's ``lower_step_programs().compile()``), so the
+        first train step executes them instead of paying a fresh XLA
+        compile — the autotuner's stage-1 -> stage-2 handoff, and the
+        reason a whole tune run compiles each candidate exactly once.
+
+        ``batch`` is one dispatch's batch (shapes only — used to build
+        the signature the dispatcher matches against). Per-program the
+        handoff mirrors the census-before-first-step path in
+        ``get_cost_census``: signature FIRST, then artifact, then the
+        census/pre-flight hook. Returns the set of adopted program
+        names; a name is skipped (never an error) when the cost explorer
+        is off, the program is already primed, or the signature cannot
+        be computed — the dispatcher then falls back to the plain jit,
+        which is correct, just not compile-free."""
+        adopted = set()
+        if not self._cost_explorer_on:
+            logger.warning(
+                "adopt_compiled_step: telemetry.cost_explorer is off — "
+                "no _AOTStep dispatchers to prime; the first step will "
+                "compile")
+            return adopted
+        # signature from ShapeDtypeStructs — _AOTStep._signature only
+        # reads shape/dtype/sharding, so nothing is placed on device
+        # just to compute a match key (SDS leaves have no `committed`
+        # attribute -> sharding unconstrained, same as the uncommitted
+        # rng/theta scalars at real dispatch; the batch SDS carries the
+        # same NamedSharding _globalize_batch would commit)
+        with self.mesh:
+            batch_sds, rng_sds, theta_sds = \
+                self._abstract_step_args(batch)
+        for name, compiled in compiled_map.items():
+            aot_step = self._aot_step_for(name)
+            if aot_step is None or aot_step.compiled is not None:
+                continue
+            args = ((self.state,) if name == "apply_step"
+                    else (self.state, batch_sds, rng_sds, theta_sds))
+            try:
+                sig = aot_step._signature(args)
+            except Exception:
+                sig = None
+            if sig is None:
+                continue
+            aot_step.compiled, aot_step._sig = compiled, sig
+            self._on_step_compiled(name, compiled)
+            adopted.add(name)
+        return adopted
 
     def get_cost_census(self, batch=None):
         """Static census (flops / bytes / memory / per-axis collectives)
@@ -2346,6 +2470,18 @@ class DeepSpeedEngine:
             for _src, wrapped in list(self._prefetch_wrap_cache.values()):
                 wrapped.close()
             self._prefetch_wrap_cache.clear()
+            # drop the owned AOT artifacts and cached device refs: a
+            # closed engine must not pin compiled executables or batch
+            # buffers alive (the autotuner runs many trial engines in one
+            # process — leaked artifacts would accumulate per probe)
+            for name in ("fused_train_step", "micro_step", "apply_step"):
+                aot_step = self._aot_step_for(name)
+                if aot_step is not None:
+                    aot_step.compiled = None
+                    aot_step._sig = None
+            self._cost_census = None
+            self._cost_census_program = None
+            self._last_batch = None
             self.telemetry.close()
 
     # ------------------------------------------------------------ checkpoints

@@ -301,6 +301,440 @@ class TestCrossHostScheduling:
             ResourceManager(run_fn=lambda c: 1.0, hosts=["a"])
 
 
+# ----------------------------------------- goodput-driven tuner (tune.py)
+HID = 64
+
+
+def _gp_model_factory(**kw):
+    return SimpleModel(hidden_dim=HID, nlayers=kw.get("nlayers", 2))
+
+
+def _gp_make_batch(bs):
+    rng = np.random.default_rng(0)
+    return (rng.standard_normal((bs, HID)).astype(np.float32),
+            rng.standard_normal((bs, HID)).astype(np.float32))
+
+
+_GP_BASE = {"train_batch_size": 16,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}}
+
+
+@pytest.fixture(scope="module")
+def tuned(tmp_path_factory):
+    """ONE full two-stage tune over a space with two OOM-infeasible
+    candidates (65536-per-chip micro batches vs a 64 MiB budget), shared
+    by the pruning / report / compile-accounting tests."""
+    from deepspeed_tpu.autotuning.tune import GoodputTuner
+    tmp = tmp_path_factory.mktemp("tune")
+    tuner = GoodputTuner(
+        _gp_model_factory, _gp_make_batch, dict(_GP_BASE),
+        space={"micro_batch": [2, 8, 65536], "zero_stage": [0, 1]},
+        hbm_budget_bytes=64 << 20, top_k=2, probe_steps=3,
+        probe_warmup_steps=1, results_dir=str(tmp / "results"),
+        report_file=str(tmp / "TUNE_REPORT.json"))
+    probed_ids = []
+    orig = GoodputTuner._run_probe
+
+    def recording(self, cand):
+        probed_ids.append(cand.id)
+        return orig(self, cand)
+
+    GoodputTuner._run_probe = recording
+    try:
+        best, report = tuner.tune()
+    finally:
+        GoodputTuner._run_probe = orig
+    return tuner, best, report, probed_ids
+
+
+class TestGoodputTunerPruning:
+    def test_oom_candidates_pruned_at_compile_time(self, tuned):
+        tuner, _, report, probed_ids = tuned
+        pruned = [c for c in report["candidates"]
+                  if c["overrides"].get("micro_batch") == 65536]
+        assert len(pruned) == 2
+        for c in pruned:
+            assert c["status"] == "pruned"
+            assert c["reject_reason"] == "hbm"
+            # the rejection came from the COMPILED program's own memory
+            # analysis, not a heuristic
+            assert c["hbm_watermark_bytes"] > \
+                tuner.hbm_budget_bytes * tuner.memory_headroom
+            # zero device execution: never probed, no measured numbers
+            assert c["probe"] is None
+            assert c["id"] not in probed_ids
+
+    def test_pruned_candidates_dropped_their_artifacts(self, tuned):
+        tuner, _, _, _ = tuned
+        assert all(c.compiled is None for c in tuner.candidates)
+
+    def test_survivors_ranked_by_predicted_cost(self, tuned):
+        _, _, report, _ = tuned
+        ranked = [c for c in report["candidates"]
+                  if c["predicted_rank"] is not None]
+        # micro [2, 8, 65536] x stage [0, 1]: the (2, 0) combo dedups
+        # against the base, the two 65536s prune -> 4 ranked survivors
+        assert len(ranked) == 4
+        ranked.sort(key=lambda c: c["predicted_rank"])
+        costs = [c["predicted_cost_s_per_sample"] for c in ranked]
+        assert costs == sorted(costs)
+        # larger micro batches amortise fixed per-step work: the best
+        # predicted cost must not be the smallest micro batch
+        assert ranked[0]["overrides"].get("micro_batch") == 8
+
+    def test_compile_accounting_one_compile_per_candidate(self, tuned):
+        _, _, report, _ = tuned
+        comp = report["compile"]
+        # every candidate that reached stage 1 compiled EXACTLY once...
+        assert comp["train_step_compiles"] == comp["candidates_compiled"] \
+            == report["n_candidates"]
+        # ...and the measured probes compiled NOTHING: they executed the
+        # adopted stage-1 artifact
+        assert comp["probe_train_step_compiles"] == 0
+        for c in report["candidates"]:
+            if c["probe"] is not None:
+                assert c["probe"]["artifact_reused"] is True
+                assert c["probe"]["aot_fallback_calls"] == 0
+
+    def test_report_content_and_winner(self, tuned):
+        tuner, best, report, _ = tuned
+        import json as _json
+        assert report["schema"] == "deepspeed_tpu.tune_report/1"
+        assert report["stage1"]["pruned"] == 2
+        assert report["stage2"]["probed"] >= 2
+        statuses = {c["status"] for c in report["candidates"]}
+        assert statuses <= {"pruned", "probed", "ranked_out", "failed",
+                            "probe_failed"}
+        # base (id 0, empty overrides) was probed as the yardstick
+        base = report["candidates"][0]
+        assert base["overrides"] == {} and base["status"] == "probed"
+        w = report["winner"]
+        assert w["vs_base_speedup"] is not None
+        probed = [c for c in report["candidates"] if c["probe"]]
+        assert w["score_s_per_sample"] == min(
+            c["probe"]["score_s_per_sample"] for c in probed)
+        for c in probed:
+            assert 0.0 < c["probe"]["goodput_fraction"] <= 1.0
+            assert c["probe"]["goodput_scored"] is True
+        assert best == w["config"]
+        # the report file is strict JSON on disk
+        with open(tuner.report_file) as f:
+            doc = _json.load(f, parse_constant=lambda t: 1 / 0)
+        assert doc["schema"] == report["schema"]
+
+
+class TestGoodputScoring:
+    """A fast-but-input-stalled config must lose under the goodput
+    metric — and win under raw step_time, proving the ledger term is
+    what flips the verdict."""
+
+    STALL_S = 0.05
+    BIG = 128           # dispatch 1024 samples: best RAW s/sample even
+                        # with the stall amortised over them
+
+    def _stalling_factory(self, bs):
+        batch = _gp_make_batch(bs)
+        stall = self.STALL_S if bs == self.BIG * 8 else 0.0
+
+        def gen():
+            import time as _t
+            while True:
+                if stall:
+                    _t.sleep(stall)
+                yield batch
+        return gen()
+
+    def _tune(self, tmp_path, metric):
+        from deepspeed_tpu.autotuning.tune import GoodputTuner
+        tuner = GoodputTuner(
+            _gp_model_factory, _gp_make_batch, dict(_GP_BASE),
+            data_factory=self._stalling_factory,
+            space={"micro_batch": [self.BIG]}, metric=metric,
+            hbm_budget_bytes=1 << 30, top_k=1, probe_steps=3,
+            probe_warmup_steps=1,
+            results_dir=str(tmp_path / f"results_{metric}"),
+            report_file=str(tmp_path / f"TUNE_{metric}.json"))
+        _, report = tuner.tune()
+        return report
+
+    def test_input_stalled_config_loses_under_goodput(self, tmp_path):
+        report = self._tune(tmp_path, "goodput")
+        stalled = [c for c in report["candidates"]
+                   if c["overrides"].get("micro_batch") == self.BIG][0]
+        base = report["candidates"][0]
+        p = stalled["probe"]
+        # the ledger saw the stall: goodput collapses, and the scored
+        # step time is inflated well past the raw wall time
+        assert p["goodput_fraction"] < 0.5
+        assert p["categories_s"]["input_wait"] > 0.5 * self.STALL_S
+        assert p["goodput_step_time_s"] > 1.5 * p["step_time_s"]
+        # raw wall per sample FAVOURS the stalled config (the stall
+        # amortises over 512 samples)...
+        raw = {c["id"]: c["probe"]["step_time_s"]
+               / (c["overrides"].get("micro_batch", 2) * 8)
+               for c in (stalled, base)}
+        assert raw[stalled["id"]] < raw[base["id"]]
+        # ...but goodput scoring hands the win to the clean base config
+        assert report["winner"]["id"] == base["id"]
+
+    def test_same_setup_flips_under_raw_step_time(self, tmp_path):
+        report = self._tune(tmp_path, "step_time")
+        stalled = [c for c in report["candidates"]
+                   if c["overrides"].get("micro_batch") == self.BIG][0]
+        assert stalled["probe"]["goodput_scored"] is False
+        assert report["winner"]["id"] == stalled["id"]
+
+
+class TestCandidateSpace:
+    def test_space_point_equal_to_base_is_deduplicated(self, tmp_path):
+        """A combo that derives the exact base config must not become a
+        duplicate candidate (it would burn a stage-1 compile and a
+        top_k probe slot on a config the base probe already covers)."""
+        from deepspeed_tpu.autotuning.tune import GoodputTuner
+        tuner = GoodputTuner(
+            _gp_model_factory, _gp_make_batch, dict(_GP_BASE),
+            space={"micro_batch": [2, 8]},   # base triangulates to 2
+            results_dir=str(tmp_path), report_file=str(tmp_path / "r.json"))
+        cands = tuner.build_candidates()
+        assert len(cands) == 2
+        assert cands[0].overrides == {}
+        assert cands[1].overrides == {"micro_batch": 8}
+
+    def test_space_point_equal_to_base_defaults_is_deduplicated(
+            self, tmp_path):
+        """Dedup is SEMANTIC: an override that merely materialises a
+        block the base omits (zero_optimization.stage 0 when the base
+        has no zero block) is the same trial — the parsed-config
+        signature must catch it, not the raw dict text."""
+        from deepspeed_tpu.autotuning.tune import GoodputTuner
+        tuner = GoodputTuner(
+            _gp_model_factory, _gp_make_batch, dict(_GP_BASE),
+            space={"micro_batch": [2, 8], "zero_stage": [0, 1]},
+            results_dir=str(tmp_path), report_file=str(tmp_path / "r.json"))
+        cands = tuner.build_candidates()
+        # base == (micro 2, stage 0): 4 combos - 1 duplicate + base = 4
+        assert len(cands) == 4
+        assert {"micro_batch": 2, "zero_stage": 0} not in \
+            [c.overrides for c in cands]
+
+    def test_failed_probe_does_not_consume_a_topk_slot(self, tmp_path):
+        """A crashed probe must not shrink the measured search: the
+        next-best survivor gets the slot instead."""
+        from deepspeed_tpu.autotuning.tune import GoodputTuner
+        tuner = GoodputTuner(
+            _gp_model_factory, _gp_make_batch, dict(_GP_BASE),
+            space={"micro_batch": [8, 32]},
+            hbm_budget_bytes=1 << 30, top_k=1, probe_steps=2,
+            probe_warmup_steps=1,
+            results_dir=str(tmp_path / "results"),
+            report_file=str(tmp_path / "TUNE_REPORT.json"))
+        failed = []
+        orig = GoodputTuner._run_probe
+
+        def failing_once(self, cand):
+            if cand.id != 0 and not failed:
+                failed.append(cand.id)
+                raise RuntimeError("injected probe crash")
+            return orig(self, cand)
+
+        GoodputTuner._run_probe = failing_once
+        try:
+            _, report = tuner.tune()
+        finally:
+            GoodputTuner._run_probe = orig
+        assert len(failed) == 1
+        by_id = {c["id"]: c for c in report["candidates"]}
+        assert by_id[failed[0]]["status"] == "probe_failed"
+        assert "injected probe crash" in by_id[failed[0]]["error"]
+        # base + ONE successful non-base probe: the slot was re-issued
+        assert report["stage2"]["probed"] == 2
+        assert report["stage2"]["probe_failed"] == 1
+        assert report["winner"] is not None
+
+    def test_probe_survives_health_enabled_base_config(self, tmp_path):
+        """The stage-1 artifact is compiled WITHOUT the health stats
+        variant; a base config carrying telemetry.health must not make
+        every probe unpack a missing stats output (regression: probes
+        force health off)."""
+        from deepspeed_tpu.autotuning.tune import GoodputTuner
+        base = dict(_GP_BASE)
+        base["telemetry"] = {"enabled": True, "trace": False,
+                             "jsonl": False, "prometheus": False,
+                             "health": {"enabled": True}}
+        tuner = GoodputTuner(
+            _gp_model_factory, _gp_make_batch, base, space={},
+            hbm_budget_bytes=1 << 30, probe_steps=2, probe_warmup_steps=1,
+            results_dir=str(tmp_path / "results"),
+            report_file=str(tmp_path / "TUNE_REPORT.json"))
+        _, report = tuner.tune()
+        base_cand = report["candidates"][0]
+        assert base_cand["status"] == "probed"
+        assert base_cand["probe"]["artifact_reused"] is True
+        assert report["compile"]["probe_train_step_compiles"] == 0
+
+
+class TestGuidedCostModelTuner:
+    def test_cold_start_follows_the_prior(self):
+        from deepspeed_tpu.autotuning.tune import GuidedCostModelTuner
+        configs = [{"micro": m} for m in (1, 2, 4, 8)]
+        prior = [4.0, 1.0, 3.0, 2.0]       # predicted cost: lower wins
+        t = GuidedCostModelTuner(configs, prior, seed=0)
+        first = t.next()
+        assert first is configs[1]          # best predicted first
+        t.update(first, 10.0)
+        second = t.next()
+        assert second is configs[3]         # next best predicted
+        t.update(second, 5.0)
+
+    def test_measured_scores_steer_after_warmup(self):
+        from deepspeed_tpu.autotuning.tune import GuidedCostModelTuner
+        configs = [{"micro": float(m)} for m in (1, 2, 4, 8, 16, 32)]
+        prior = [6.0, 5.0, 4.0, 3.0, 2.0, 1.0]   # prior says micro=32
+        t = GuidedCostModelTuner(configs, prior, seed=0)
+
+        def perf(c):                             # truth peaks at micro=4
+            return -abs(c["micro"] - 4.0) + 100.0
+
+        best_seen = None
+        for _ in range(len(configs)):
+            cfg = t.next()
+            if cfg is None:
+                break
+            p = perf(cfg)
+            t.update(cfg, p)
+            if best_seen is None or p > best_seen[0]:
+                best_seen = (p, cfg)
+        assert best_seen[1]["micro"] == 4.0
+        assert "predicted_cost" in t.keys
+
+    def test_mark_measured_records_external_probe(self):
+        from deepspeed_tpu.autotuning.tune import GuidedCostModelTuner
+        configs = [{"x": 1}, {"x": 2}]
+        t = GuidedCostModelTuner(configs, [2.0, 1.0], seed=0)
+        t.mark_measured(configs[0], 7.0)
+        assert t.xs and t.ys == [7.0]
+        assert t.next() is configs[1]       # the measured one is visited
+
+
+class TestProbeLifecycle:
+    def test_sequential_probes_leak_nothing(self, tmp_path):
+        """N sequential probes (each a full engine with prefetch +
+        goodput + cost explorer) must not grow the live-buffer count or
+        leave daemon threads behind — engine.close() joins the pipeline
+        threads and drops the AOT artifacts."""
+        import gc
+        import threading
+        import jax
+        from deepspeed_tpu.autotuning.tune import GoodputTuner
+        base = dict(_GP_BASE)
+        base["data_prefetch"] = {"enabled": True, "depth": 2}
+        tuner = GoodputTuner(
+            _gp_model_factory, _gp_make_batch, base, space={},
+            hbm_budget_bytes=1 << 30, probe_steps=2, probe_warmup_steps=1,
+            results_dir=str(tmp_path / "results"),
+            report_file=str(tmp_path / "TUNE_REPORT.json"))
+        tuner.build_candidates()
+        cand = tuner.candidates[0]
+        tuner._stage1_compile(cand)
+        assert cand.status == "survivor"
+        tuner._run_probe(cand)              # warm global jit/const caches
+        gc.collect()
+        base_arrays = len(jax.live_arrays())
+        base_threads = len(threading.enumerate())
+        for _ in range(3):
+            tuner._run_probe(cand)
+        gc.collect()
+        leaked = len(jax.live_arrays()) - base_arrays
+        assert leaked <= 4, (
+            f"3 probes grew the live-buffer count by {leaked} — a trial "
+            f"engine is pinning state/batch/artifact buffers past close()")
+        assert len(threading.enumerate()) == base_threads, (
+            f"probe left threads behind: "
+            f"{[t.name for t in threading.enumerate()]}")
+        assert tuner._probe_extra_compiles == 0
+
+    def test_engine_close_drops_aot_artifacts(self):
+        import deepspeed_tpu
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=SimpleModel(hidden_dim=HID, nlayers=1),
+            config={"train_batch_size": 8,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                    "telemetry": {"enabled": True, "trace": False,
+                                  "jsonl": False, "prometheus": False,
+                                  "cost_explorer": {"enabled": True}}},
+            sample_batch=sample_batch(8, HID))
+        engine.train_batch(batch=_gp_make_batch(8))
+        aot = engine._aot_step_for("fused_train_step")
+        assert aot is not None and aot.compiled is not None
+        engine.close()
+        assert aot.compiled is None and aot._sig is None
+        assert engine._cost_census is None
+        assert engine._last_batch is None
+
+
+class TestAutotuningConfigBlock:
+    def test_defaults_and_parse(self):
+        from deepspeed_tpu.runtime.config import DeepSpeedConfig
+        cfg = DeepSpeedConfig({"train_batch_size": 8},
+                              data_parallel_size=8)
+        at = cfg.autotuning
+        assert at.enabled is False
+        assert at.metric == "goodput"
+        assert at.top_k == 3 and at.probe_steps == 8
+        assert cfg.autotuning_enabled is False
+
+    def test_block_values_and_space(self):
+        from deepspeed_tpu.runtime.config import DeepSpeedConfig
+        cfg = DeepSpeedConfig(
+            {"train_batch_size": 8,
+             "autotuning": {"enabled": True, "metric": "step_time",
+                            "top_k": 5, "probe_steps": 4,
+                            "hbm_budget_gb": 2.5,
+                            "space": {"micro_batch": [1, 2]}}},
+            data_parallel_size=8)
+        at = cfg.autotuning
+        assert at.enabled and at.metric == "step_time"
+        assert at.top_k == 5 and at.hbm_budget_gb == 2.5
+        assert at.space == {"micro_batch": [1, 2]}
+
+    def test_invalid_values_rejected(self):
+        from deepspeed_tpu.runtime.config import (DeepSpeedConfig,
+                                                  DeepSpeedConfigError)
+        for bad in ({"metric": "flops"}, {"top_k": 0},
+                    {"probe_steps": 0}, {"memory_headroom": 0.0},
+                    {"hbm_budget_gb": -1}, {"space": {"micro_batch": []}},
+                    {"space": [1, 2]}):
+            with pytest.raises(DeepSpeedConfigError):
+                DeepSpeedConfig({"train_batch_size": 8,
+                                 "autotuning": bad},
+                                data_parallel_size=8)
+
+    def test_env_overrides(self, monkeypatch):
+        from deepspeed_tpu.runtime.config import DeepSpeedConfig
+        monkeypatch.setenv("DS_AUTOTUNING", "1")
+        monkeypatch.setenv("DS_AUTOTUNING_TOP_K", "7")
+        monkeypatch.setenv("DS_AUTOTUNING_REPORT", "/tmp/x.json")
+        cfg = DeepSpeedConfig({"train_batch_size": 8},
+                              data_parallel_size=8)
+        assert cfg.autotuning.enabled is True
+        assert cfg.autotuning.top_k == 7
+        assert cfg.autotuning.report_file == "/tmp/x.json"
+
+
+def test_detect_device_memory_uses_preflight_chain(monkeypatch):
+    """Satellite: pruning and the PR-2 pre-flight must agree on the
+    budget — allocator bytes_limit / chip table first, the telemetry
+    registry's host-RSS fallback after."""
+    from deepspeed_tpu.autotuning.autotuner import Autotuner
+    import deepspeed_tpu.telemetry.cost_explorer as ce
+    monkeypatch.setattr(ce, "device_hbm_bytes", lambda device=None: 7 << 30)
+    assert Autotuner._detect_device_memory() == 7 << 30
+    # CPU path: no allocator limit -> the registry's host-RSS fallback
+    monkeypatch.setattr(ce, "device_hbm_bytes", lambda device=None: None)
+    got = Autotuner._detect_device_memory()
+    assert isinstance(got, int) and got > 0
+
+
 class TestGradientBoostingCostModel:
     def test_ranks_like_truth_and_switches_family(self):
         from deepspeed_tpu.autotuning.cost_model import (
