@@ -87,6 +87,9 @@ def telemetry_report():
         "(data_prefetch block; host workers + device double-buffering)")
     row("serving engine (paged KV)", True,
         "(serving block; continuous batching + chunked prefill + top-p)")
+    row("serving observatory", True,
+        "(serving.observability block; slot-step ledger + SLO rules -> "
+        "SERVING_HEALTH.json)")
     row("goodput autotuner (2-stage)", True,
         "(autotuning block; compile-time pruning + measured probes -> "
         "TUNE_REPORT.json)")

@@ -238,6 +238,85 @@ class DeepSpeedDataPrefetchConfig(DeepSpeedConfigObject):
                 f"data_prefetch.depth must be >= 1, got {self.depth}")
 
 
+class DeepSpeedServingObservabilityConfig(DeepSpeedConfigObject):
+    """``serving.observability`` sub-block
+    (telemetry/serving_observatory.py): per-request lifecycle timelines
+    + per-slot Chrome-trace lanes, the slot-step attribution ledger
+    (decode_useful/prefill/recompute/frozen/idle, sums to
+    ``steps x max_batch x decode_steps`` by construction), and windowed
+    SLO rules escalating warn-once -> throttled ``SERVING_HEALTH.json``
+    -> trace flush.
+
+    Env override (sweep ergonomics): ``DS_SERVING_OBS`` = 1/0
+    force-toggles ``enabled`` after JSON parsing."""
+
+    def __init__(self, serving_dict):
+        o = serving_dict.get(C.SERVING_OBSERVABILITY, {}) or {}
+        self.enabled = o.get(C.SERVING_OBS_ENABLED,
+                             C.SERVING_OBS_ENABLED_DEFAULT)
+        self.window = int(o.get(C.SERVING_OBS_WINDOW,
+                                C.SERVING_OBS_WINDOW_DEFAULT))
+        self.warmup_windows = int(o.get(C.SERVING_OBS_WARMUP,
+                                        C.SERVING_OBS_WARMUP_DEFAULT))
+        self.ttft_slo_ms = float(o.get(C.SERVING_OBS_TTFT_SLO_MS,
+                                       C.SERVING_OBS_TTFT_SLO_MS_DEFAULT))
+        self.ttft_breach_frac = float(
+            o.get(C.SERVING_OBS_TTFT_BREACH_FRAC,
+                  C.SERVING_OBS_TTFT_BREACH_FRAC_DEFAULT))
+        self.queue_growth_windows = int(
+            o.get(C.SERVING_OBS_QUEUE_GROWTH_WINDOWS,
+                  C.SERVING_OBS_QUEUE_GROWTH_WINDOWS_DEFAULT))
+        self.preemption_thrash = int(
+            o.get(C.SERVING_OBS_PREEMPTION_THRASH,
+                  C.SERVING_OBS_PREEMPTION_THRASH_DEFAULT))
+        self.no_progress_steps = int(
+            o.get(C.SERVING_OBS_NO_PROGRESS_STEPS,
+                  C.SERVING_OBS_NO_PROGRESS_STEPS_DEFAULT))
+        self.timeline_ring = int(o.get(C.SERVING_OBS_TIMELINE_RING,
+                                       C.SERVING_OBS_TIMELINE_RING_DEFAULT))
+        self.window_ring = int(o.get(C.SERVING_OBS_WINDOW_RING,
+                                     C.SERVING_OBS_WINDOW_RING_DEFAULT))
+        self.trace_lanes = o.get(C.SERVING_OBS_TRACE_LANES,
+                                 C.SERVING_OBS_TRACE_LANES_DEFAULT)
+        self.snapshot_file = o.get(C.SERVING_OBS_SNAPSHOT_FILE,
+                                   C.SERVING_OBS_SNAPSHOT_FILE_DEFAULT)
+        env = os.environ.get("DS_SERVING_OBS")
+        if env is not None:
+            self.enabled = env.lower() in ("1", "true", "yes", "on")
+        if self.window < 1:
+            raise DeepSpeedConfigError(
+                f"serving.observability.window must be >= 1, got "
+                f"{self.window}")
+        if self.warmup_windows < 0:
+            raise DeepSpeedConfigError(
+                f"serving.observability.warmup_windows must be >= 0, got "
+                f"{self.warmup_windows}")
+        if not 0.0 < self.ttft_breach_frac <= 1.0:
+            raise DeepSpeedConfigError(
+                f"serving.observability.ttft_breach_frac must be in "
+                f"(0, 1], got {self.ttft_breach_frac}")
+        if self.no_progress_steps < 1:
+            raise DeepSpeedConfigError(
+                f"serving.observability.no_progress_steps must be >= 1, "
+                f"got {self.no_progress_steps}")
+        if self.queue_growth_windows < 1:
+            raise DeepSpeedConfigError(
+                f"serving.observability.queue_growth_windows must be "
+                f">= 1, got {self.queue_growth_windows}")
+        if self.preemption_thrash < 1:
+            # the rule is `window preemptions >= threshold`, and every
+            # window has >= 0 preemptions — a 0 threshold would fire the
+            # thrash rule on every post-warmup window forever
+            raise DeepSpeedConfigError(
+                f"serving.observability.preemption_thrash must be >= 1 "
+                f"(disable rules with enabled=false), got "
+                f"{self.preemption_thrash}")
+        if self.ttft_slo_ms <= 0:
+            raise DeepSpeedConfigError(
+                f"serving.observability.ttft_slo_ms must be > 0, got "
+                f"{self.ttft_slo_ms}")
+
+
 class DeepSpeedServingConfig(DeepSpeedConfigObject):
     """``serving`` block (serving/): continuous-batching inference server
     over a paged KV cache. ``num_blocks`` 0 auto-sizes the pool so the
@@ -264,6 +343,7 @@ class DeepSpeedServingConfig(DeepSpeedConfigObject):
                                     C.SERVING_ATTENTION_IMPL_DEFAULT)
         self.decode_steps = int(s.get(C.SERVING_DECODE_STEPS,
                                       C.SERVING_DECODE_STEPS_DEFAULT))
+        self.observability = DeepSpeedServingObservabilityConfig(s)
         for env, attr in (("DS_SERVING_MAX_BATCH", "max_batch"),
                           ("DS_SERVING_BLOCK_SIZE", "block_size"),
                           ("DS_SERVING_PREFILL_CHUNK", "prefill_chunk")):

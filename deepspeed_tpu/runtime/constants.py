@@ -419,6 +419,43 @@ SERVING_ATTENTION_IMPL_DEFAULT = "paged"
 SERVING_DECODE_STEPS = "decode_steps"
 SERVING_DECODE_STEPS_DEFAULT = 1
 
+# serving.observability: the serving observatory
+# (telemetry/serving_observatory.py). Per-request lifecycle timelines
+# (exported as per-slot Chrome-trace lanes when the tracer is live), a
+# slot-step ledger decomposing every scheduler step's
+# max_batch x decode_steps slot micro-units into decode_useful / prefill
+# / recompute / frozen / idle (sums to steps x max_batch x K by
+# construction), and windowed SLO rules (ttft_slo_breach, queue_growth,
+# preemption_thrash, decode_stall, no_progress) escalating warn-once ->
+# throttled SERVING_HEALTH.json -> trace flush. Pure host bookkeeping:
+# adds zero device syncs and zero compiled-program changes.
+# DS_SERVING_OBS=1/0 force-toggles `enabled`.
+SERVING_OBSERVABILITY = "observability"
+SERVING_OBS_ENABLED = "enabled"
+SERVING_OBS_ENABLED_DEFAULT = False
+SERVING_OBS_WINDOW = "window"               # scheduler steps per window
+SERVING_OBS_WINDOW_DEFAULT = 32
+SERVING_OBS_WARMUP = "warmup_windows"       # windows before rules arm
+SERVING_OBS_WARMUP_DEFAULT = 1
+SERVING_OBS_TTFT_SLO_MS = "ttft_slo_ms"
+SERVING_OBS_TTFT_SLO_MS_DEFAULT = 1000.0
+SERVING_OBS_TTFT_BREACH_FRAC = "ttft_breach_frac"
+SERVING_OBS_TTFT_BREACH_FRAC_DEFAULT = 0.5
+SERVING_OBS_QUEUE_GROWTH_WINDOWS = "queue_growth_windows"
+SERVING_OBS_QUEUE_GROWTH_WINDOWS_DEFAULT = 3
+SERVING_OBS_PREEMPTION_THRASH = "preemption_thrash"  # per window
+SERVING_OBS_PREEMPTION_THRASH_DEFAULT = 8
+SERVING_OBS_NO_PROGRESS_STEPS = "no_progress_steps"
+SERVING_OBS_NO_PROGRESS_STEPS_DEFAULT = 200
+SERVING_OBS_TIMELINE_RING = "timeline_ring"  # finished timelines kept
+SERVING_OBS_TIMELINE_RING_DEFAULT = 64
+SERVING_OBS_WINDOW_RING = "window_ring"
+SERVING_OBS_WINDOW_RING_DEFAULT = 128
+SERVING_OBS_TRACE_LANES = "trace_lanes"     # per-slot Chrome lanes
+SERVING_OBS_TRACE_LANES_DEFAULT = True
+SERVING_OBS_SNAPSHOT_FILE = "snapshot_file"
+SERVING_OBS_SNAPSHOT_FILE_DEFAULT = "SERVING_HEALTH.json"
+
 # autotuning: goodput-driven two-stage config search (autotuning/tune.py).
 # Stage 1 AOT-compiles every candidate ONCE (abstract engines — zero
 # device execution), rejects candidates whose HBM watermark exceeds

@@ -13,4 +13,5 @@ from deepspeed_tpu.serving.sampling import (sample_tokens,  # noqa: F401
 from deepspeed_tpu.serving.scheduler import (  # noqa: F401
     ContinuousBatchingScheduler, Request, RequestState, StepPlan)
 from deepspeed_tpu.serving.server import (RequestOutput,  # noqa: F401
-                                          ServingEngine)
+                                          ServingEngine,
+                                          ServingLivelockError)

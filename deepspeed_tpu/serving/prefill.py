@@ -45,8 +45,13 @@ class ChunkedPrefill:
 
     def run(self, params, scales, pools, req, max_blocks: int):
         """Execute one chunk for *req*; returns ``(pools, n_valid,
-        done)`` where ``done`` means the prompt KV is complete and the
-        request is decode-ready."""
+        n_recompute, done)`` where ``done`` means the prompt KV is
+        complete and the request is decode-ready. ``n_recompute`` counts
+        the chunk's tokens below the request's eviction high-water mark
+        — positions whose KV existed before a preemption threw it away,
+        i.e. compute this chunk is paying a SECOND time (the slot-step
+        ledger and ``serving_recompute_tokens_total`` book preemption
+        cost from it)."""
         tokens, start, n_valid = self.next_chunk(req)
         bt_row = np.zeros((max_blocks,), np.int32)
         bt_row[:len(req.block_table)] = req.block_table
@@ -54,4 +59,6 @@ class ChunkedPrefill:
             params, scales, pools, bt_row, tokens,
             np.int32(start), np.int32(n_valid))
         req.cached_len += n_valid
-        return pools, n_valid, self.remaining(req) == 0
+        n_recompute = max(0, min(start + n_valid,
+                                 getattr(req, "max_cached_len", 0)) - start)
+        return pools, n_valid, n_recompute, self.remaining(req) == 0

@@ -56,6 +56,9 @@ class Request:
     output_tokens: List[int] = dataclasses.field(default_factory=list)
     block_table: List[int] = dataclasses.field(default_factory=list)
     cached_len: int = 0                  # KV positions written
+    max_cached_len: int = 0              # high-water mark across evictions:
+    # re-prefilled positions below it are RECOMPUTE (their KV existed
+    # before a preemption threw it away)
     next_input: Optional[int] = None     # token the next decode step embeds
     slot: Optional[int] = None
     admit_seq: int = -1
@@ -89,16 +92,21 @@ class StepPlan:
 
 class ContinuousBatchingScheduler:
     def __init__(self, cache, max_batch: int, max_model_len: int,
-                 decode_steps: int = 1):
+                 decode_steps: int = 1, observer=None):
         self.cache = cache                      # PagedKVCache (owns alloc)
         self.allocator = cache.allocator
         self.max_batch = int(max_batch)
         self.max_model_len = int(max_model_len)
         self.decode_steps = int(decode_steps)
+        # optional lifecycle observer (the serving observatory): called
+        # synchronously on admit / preempt / admission-fail with the
+        # request still carrying its pre-transition state
+        self.observer = observer
         self.waiting = deque()
         self.slots: List[Optional[Request]] = [None] * self.max_batch
         self._admit_counter = 0
         self.preemptions_total = 0
+        self.preemptions_by_reason = {}         # reason -> count
         # requests that can NEVER fit the pool (e.g. a preempted request
         # whose prompt+generated outgrew the usable blocks) — failed at
         # admission instead of livelocking the FCFS head; the server
@@ -172,6 +180,8 @@ class ContinuousBatchingScheduler:
                 req.finish_reason = "capacity"
                 req.finish_t = time.perf_counter()
                 self.failed.append(req)
+                if self.observer is not None:
+                    self.observer.on_admission_fail(req)
                 continue
             blocks = self.allocator.allocate(need)
             if blocks is None:
@@ -186,6 +196,8 @@ class ContinuousBatchingScheduler:
             req.state = (RequestState.PREFILL if len(req.full_prompt) > 1
                          else RequestState.RUNNING)
             self.slots[free] = req
+            if self.observer is not None:
+                self.observer.on_admit(req)
 
     def _ensure_decode_capacity(self) -> List[int]:
         """Compute each running slot's dispatch budget (tokens the next
@@ -224,7 +236,7 @@ class ContinuousBatchingScheduler:
                     req.step_budget = min(req.step_budget, owned)
                     break
                 victim = self._pick_victim()
-                self._preempt(victim)
+                self._preempt(victim, reason="capacity_growth")
                 if victim is req:
                     break
         return [i for i in range(self.max_batch)
@@ -238,7 +250,17 @@ class ContinuousBatchingScheduler:
         assert live, "allocator dry with no slot to evict"
         return max(live, key=lambda r: r.admit_seq)
 
-    def _preempt(self, req: Request):
+    def _preempt(self, req: Request, reason: str = "capacity_growth"):
+        """Evict *req* (recompute-style). ``reason`` labels the
+        preemption counters: ``capacity_growth`` is the only policy
+        today (a running slot needed one more KV block and the pool was
+        dry); ``admission`` is reserved for a future evict-to-admit
+        policy — strict FCFS never evicts at admission."""
+        # the high-water mark is what re-prefill will RE-compute: every
+        # position below it had KV before this eviction threw it away
+        req.max_cached_len = max(req.max_cached_len, req.cached_len)
+        if self.observer is not None:
+            self.observer.on_preempt(req, reason, req.cached_len)
         self.allocator.free(req.block_table)
         req.block_table = []
         req.cached_len = 0
@@ -247,6 +269,8 @@ class ContinuousBatchingScheduler:
         req.state = RequestState.WAITING
         req.preemptions += 1
         self.preemptions_total += 1
+        self.preemptions_by_reason[reason] = \
+            self.preemptions_by_reason.get(reason, 0) + 1
         # front of the line: it was admitted before anything still waiting
         self.waiting.appendleft(req)
 

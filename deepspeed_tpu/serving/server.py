@@ -33,12 +33,28 @@ from deepspeed_tpu.serving.scheduler import (ContinuousBatchingScheduler,
                                              Request, RequestState)
 from deepspeed_tpu.telemetry import metrics as _metrics
 from deepspeed_tpu.telemetry.compile_watch import CompileWatch
+from deepspeed_tpu.telemetry.serving_observatory import (
+    SERVING_HEALTH_SCHEMA, ServingObservatory)
 from deepspeed_tpu.telemetry.tracer import trace_span
 from deepspeed_tpu.utils.logging import log_dist
 
 # latency histograms: serving cares about the 0.1 ms .. 10 s band
 _LAT_BUCKETS = (0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000, 2500,
                 5000, 10000)
+
+
+class ServingLivelockError(RuntimeError):
+    """serve_forever made no progress for its hard limit of iterations.
+
+    Carries the full ``serving_report()`` dict in ``.report`` — the
+    scheduler/slot/KV state dump and (observability on) the slot-step
+    ledger, windows and timelines — so the forensics that motivated the
+    guard are captured at the point of death instead of lost with the
+    process."""
+
+    def __init__(self, message, report=None):
+        super().__init__(message)
+        self.report = report
 
 
 @dataclasses.dataclass
@@ -95,6 +111,18 @@ class ServingEngine:
             decode_steps=config.decode_steps)
         self.registry = registry if registry is not None \
             else _metrics.get_registry()
+        # serving observatory (telemetry/serving_observatory.py): pure
+        # host bookkeeping — timelines, the slot-step ledger, SLO rules.
+        # None when disabled, so every call site is one attribute check.
+        obs_cfg = getattr(config, "observability", None)
+        self.observatory = None
+        if obs_cfg is not None and obs_cfg.enabled:
+            self.observatory = ServingObservatory.from_config(
+                obs_cfg, max_batch=self.max_batch,
+                decode_steps=int(config.decode_steps),
+                registry=self.registry,
+                engine_state_fn=self._engine_state)
+            self.scheduler.observer = self.observatory
         self._watch = CompileWatch(registry=self.registry)
         self._decode_fn = self._watch.wrap(self.runner.decode_step,
                                            name="serving_decode_step")
@@ -146,6 +174,8 @@ class ServingEngine:
         self._next_id += 1
         self.scheduler.submit(req)
         self._lanes[req.req_id] = make_rng_lane(seed)
+        if self.observatory is not None:
+            self.observatory.record_submit(req)
         self.registry.counter("serving_requests_submitted_total",
                               "requests accepted by submit()").inc()
         self._publish_gauges()
@@ -159,12 +189,27 @@ class ServingEngine:
         with trace_span("serving_step"):
             plan = self.scheduler.schedule()
             progress = self._drain_failed()
+            # acts: slot -> what it did this step (("prefill"|"recompute",
+            # n_valid) or ("decode", delivered)) — the slot-step ledger's
+            # input; collected DURING the step because finished requests
+            # vacate their slots before the step ends
+            acts = {}
             for req in plan.prefill:
-                progress |= self._run_prefill(req)
+                progress |= self._run_prefill(req, acts)
             if plan.decode_slots:
-                self._run_decode(plan.decode_slots)
+                self._run_decode(plan.decode_slots, acts)
                 progress = True
             self._publish_gauges()
+            if self.observatory is not None:
+                occupied = {i for i, r in enumerate(self.scheduler.slots)
+                            if r is not None}
+                self.observatory.end_step(
+                    acts, occupied,
+                    queue_depth=self.scheduler.num_waiting,
+                    active=self.scheduler.num_active,
+                    kv_occupancy=self.cache.allocator.occupancy(),
+                    kv_fragmentation=self._kv_fragmentation(),
+                    progress=progress)
         return progress
 
     def _drain_failed(self) -> bool:
@@ -181,21 +226,37 @@ class ServingEngine:
                 "requests completed", labels={"reason": "capacity"}).inc()
         return True
 
-    def _run_prefill(self, req) -> bool:
+    def _run_prefill(self, req, acts=None) -> bool:
+        slot, start = req.slot, req.cached_len
+        t0 = time.perf_counter_ns()
         with trace_span("serving_prefill", req=req.req_id):
             with self.engine.mesh:
-                self.pools, n_valid, done = self.prefill.run(
+                self.pools, n_valid, n_recompute, done = self.prefill.run(
                     self.engine.params, self.engine.quant_scales,
                     self.pools, req, self.max_blocks_per_seq)
+        t1 = time.perf_counter_ns()
         self.registry.counter("serving_prefill_chunks_total",
                               "prefill chunks executed").inc()
         self.registry.counter("serving_prefill_tokens_total",
                               "prompt tokens cached by prefill").inc(n_valid)
+        if n_recompute:
+            # preemption COST, not just count: every token here is KV the
+            # pool already computed once and an eviction threw away
+            self.registry.counter(
+                "serving_recompute_tokens_total",
+                "tokens re-prefilled because a preemption evicted their "
+                "KV").inc(n_recompute)
+        if acts is not None:
+            acts[slot] = ("recompute" if n_recompute else "prefill",
+                          n_valid)
+        if self.observatory is not None:
+            self.observatory.record_prefill(req, slot, start, n_valid,
+                                            n_recompute, t0, t1, done)
         if done:
             req.state = RequestState.RUNNING
         return True
 
-    def _run_decode(self, decode_slots):
+    def _run_decode(self, decode_slots, acts=None):
         B = self.max_batch
         MB = self.max_blocks_per_seq
         slots = self.scheduler.slots
@@ -218,6 +279,7 @@ class ServingEngine:
             top_p[i] = r.top_p
             lanes[i] = self._lanes[r.req_id]
             budget[i] = r.step_budget
+        t0 = time.perf_counter_ns()
         with trace_span("serving_decode", batch=len(decode_slots)):
             with self.engine.mesh:
                 self.pools, toks = self._decode_fn(
@@ -225,16 +287,28 @@ class ServingEngine:
                     self.pools, bt, pos, active, tok, temp, top_p, lanes,
                     budget)
             toks = np.asarray(toks)        # [K, B]; the one host sync
+        t1 = time.perf_counter_ns()
         now = time.perf_counter()
         self.registry.counter("serving_decode_steps_total",
                               "compiled decode dispatches executed").inc()
+        if self.observatory is not None:
+            # before delivery, so each timeline's decode_begin precedes
+            # its first_token
+            self.observatory.record_decode(
+                {i: (slots[i], int(budget[i])) for i in decode_slots},
+                t0, t1)
         for i in decode_slots:
-            self._deliver(slots[i], toks[:budget[i], i].tolist(), now)
+            delivered = self._deliver(slots[i],
+                                      toks[:budget[i], i].tolist(), now)
+            if acts is not None:
+                acts[i] = ("decode", delivered)
 
     def _deliver(self, req, tokens, now):
         """Hand a dispatch's tokens to the request (one token in
         single-step mode, up to ``decode_steps`` otherwise; anything the
-        request samples past eos/max_tokens is discarded)."""
+        request samples past eos/max_tokens is discarded). Returns the
+        KEPT token count — the slot-step ledger's ``decode_useful``."""
+        slot = req.slot
         prev = req.last_token_t if req.first_token_t is not None else None
         delivered = 0
         reason = None
@@ -252,14 +326,16 @@ class ServingEngine:
             if reason is not None:
                 break
         if not delivered:
-            return
+            return 0
         req.last_token_t = now
         if req.first_token_t is None:
             req.first_token_t = now
+            ttft_ms = (now - req.submit_t) * 1e3
             self.registry.histogram(
                 "serving_ttft_ms", "submit -> first generated token",
-                buckets=_LAT_BUCKETS).observe(
-                    (now - req.submit_t) * 1e3)
+                buckets=_LAT_BUCKETS).observe(ttft_ms)
+            if self.observatory is not None:
+                self.observatory.record_first_token(req, ttft_ms)
             extra = 0      # same-dispatch tokens are part of the TTFT
         else:
             extra = delivered
@@ -280,6 +356,8 @@ class ServingEngine:
         if reason is not None:
             self.scheduler.finish(req, reason)
             self._finished.append(req)
+            if self.observatory is not None:
+                self.observatory.record_finish(req, reason, slot)
             self.registry.counter(
                 "serving_requests_finished_total",
                 "requests completed", labels={"reason": reason}).inc()
@@ -287,6 +365,7 @@ class ServingEngine:
                 "serving_e2e_latency_ms", "submit -> finish",
                 buckets=_LAT_BUCKETS).observe(
                     (req.finish_t - req.submit_t) * 1e3)
+        return delivered
 
     def _publish_gauges(self):
         self.registry.gauge("serving_queue_depth",
@@ -298,11 +377,19 @@ class ServingEngine:
         self.registry.gauge("serving_kv_occupancy",
                             "fraction of usable KV blocks allocated").set(
                                 self.cache.allocator.occupancy())
-        pre = self.registry.counter("serving_preemptions_total",
-                                    "evictions under block pressure")
-        delta = self.scheduler.preemptions_total - pre.value
-        if delta > 0:
-            pre.inc(delta)
+        for reason, total in self.scheduler.preemptions_by_reason.items():
+            # labeled by WHY the eviction happened (capacity_growth: a
+            # running slot needed a block and the pool was dry; admission
+            # is reserved for a future evict-to-admit policy), so the
+            # sinks carry preemption cause — recompute cost rides
+            # serving_recompute_tokens_total
+            pre = self.registry.counter(
+                "serving_preemptions_total",
+                "evictions under block pressure, by reason",
+                labels={"reason": reason})
+            delta = total - pre.value
+            if delta > 0:
+                pre.inc(delta)
 
     # ----------------------------------------------------------- collect
     def collect(self) -> List[RequestOutput]:
@@ -344,11 +431,18 @@ class ServingEngine:
             if idle > 1000:
                 # the scheduler guarantees forward progress (budget
                 # shrink-to-owned-capacity + admission-infeasibility
-                # failure); a long idle spin means that invariant broke
-                raise RuntimeError(
+                # failure); a long idle spin means that invariant broke.
+                # Attach the full serving report so the forensics that
+                # motivated this guard survive the crash.
+                report = self.serving_report()
+                raise ServingLivelockError(
                     "serving made no progress for 1000 iterations — "
                     f"waiting={self.scheduler.num_waiting} "
-                    f"active={self.scheduler.num_active}")
+                    f"active={self.scheduler.num_active} "
+                    f"kv_free={self.cache.allocator.num_free}/"
+                    f"{self.cache.allocator.num_usable} blocks "
+                    "(scheduler/slot/KV state dump attached as "
+                    ".report)", report=report)
             outputs.extend(self.collect())
             steps += 1
             if max_steps is not None and steps >= max_steps:
@@ -356,6 +450,81 @@ class ServingEngine:
         return outputs
 
     # -------------------------------------------------------- inspection
+    def _kv_fragmentation(self):
+        """Internal fragmentation of the live block tables: the fraction
+        of allocated KV positions no token has been written to (block
+        granularity over-allocation). 0.0 with nothing allocated."""
+        allocated = used = 0
+        for r in self.scheduler.slots:
+            if r is not None:
+                allocated += len(r.block_table) * self.cache.block_size
+                used += r.cached_len
+        return (1.0 - used / allocated) if allocated else 0.0
+
+    def _engine_state(self):
+        """Host-side scheduler/slot/KV dump — the forensics core of
+        ``serving_report()`` and the livelock exception."""
+        slots = []
+        for r in self.scheduler.slots:
+            slots.append(None if r is None else {
+                "req_id": r.req_id,
+                "state": r.state.value,
+                "prompt_len": len(r.prompt),
+                "generated": len(r.output_tokens),
+                "cached_len": r.cached_len,
+                "blocks": len(r.block_table),
+                "step_budget": r.step_budget,
+                "preemptions": r.preemptions,
+            })
+        alloc = self.cache.allocator
+        return {
+            "scheduler": {
+                "waiting": self.scheduler.num_waiting,
+                "active": self.scheduler.num_active,
+                "waiting_req_ids": [r.req_id for r in
+                                    list(self.scheduler.waiting)[:32]],
+                "slots": slots,
+                "preemptions_by_reason":
+                    dict(self.scheduler.preemptions_by_reason),
+            },
+            "kv": {
+                "block_size": self.cache.block_size,
+                "num_blocks": alloc.num_blocks,
+                "usable": alloc.num_usable,
+                "free": alloc.num_free,
+                "allocated": alloc.num_allocated,
+                "occupancy": round(alloc.occupancy(), 4),
+                "fragmentation": round(self._kv_fragmentation(), 4),
+                "pool_bytes": self.cache.pool_bytes(),
+            },
+            "compile": self.compile_stats(),
+        }
+
+    def serving_report(self, write=False):
+        """The structured serving forensics dict: the observatory report
+        (slot-step ledger, windows, SLO anomalies, per-request
+        timelines) plus the live scheduler/slot/KV dump under
+        ``engine_state``. With observability disabled the engine-state
+        dump is still returned — the livelock guard needs it either way.
+        ``write=True`` also snapshots it to the observatory's
+        ``SERVING_HEALTH.json`` path (observability on only)."""
+        if self.observatory is not None:
+            report = self.observatory.report()
+            if write:
+                self.observatory.write_snapshot(report=report, force=True)
+            return report
+        return {"schema": SERVING_HEALTH_SCHEMA, "enabled": False,
+                "engine_state": self._engine_state()}
+
+    def close(self):
+        """Teardown: force the observatory's final forensics snapshot.
+        Anomalies whose only firings landed inside the 5 s snapshot
+        throttle window would otherwise exit the process unexplained —
+        ``close()`` is what guarantees the last incident reaches
+        ``SERVING_HEALTH.json``."""
+        if self.observatory is not None:
+            self.observatory.close()
+
     def compile_stats(self):
         """Signature counts per compiled entry point (the 'one decode
         program' acceptance guard reads this)."""

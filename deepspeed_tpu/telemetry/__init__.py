@@ -21,7 +21,13 @@ Four pieces (see the per-module docstrings):
 * ``ledger`` — goodput ledger: wall-clock attribution into named
   categories that sum to elapsed time, input-stall / unattributed-
   residual rules, GOODPUT.json forensics and on-anomaly programmatic
-  profiler capture (``python -m deepspeed_tpu.telemetry.ledger``).
+  profiler capture (``python -m deepspeed_tpu.telemetry.ledger``);
+* ``serving_observatory`` — the serving-side counterpart: per-request
+  lifecycle timelines (per-slot Chrome-trace lanes), the slot-step
+  attribution ledger (categories sum to steps x max_batch x
+  decode_steps by construction), windowed SLO rules and
+  SERVING_HEALTH.json forensics
+  (``python -m deepspeed_tpu.telemetry.serving_observatory``).
 
 ``TelemetryManager`` (manager.py) wires them per engine run, behind the
 ``telemetry`` config block (see CONFIG.md). Everything is importable and
@@ -51,7 +57,11 @@ from deepspeed_tpu.telemetry.health import (BucketSpec, HealthMonitor,
                                             decode_nonfinite_mask)
 from deepspeed_tpu.telemetry.ledger import (GoodputIterator, GoodputLedger,
                                             get_ledger, set_ledger)
-from deepspeed_tpu.telemetry.manager import TelemetryManager
+from deepspeed_tpu.telemetry.serving_observatory import (RequestTimeline,
+                                                         ServingObservatory,
+                                                         SlotStepLedger)
+from deepspeed_tpu.telemetry.manager import (TelemetryManager, get_manager,
+                                             set_manager)
 
 __all__ = [
     "Tracer", "get_tracer", "set_tracer", "trace_span",
@@ -65,4 +75,6 @@ __all__ = [
     "BucketSpec", "HealthMonitor", "bucket_grad_stats",
     "build_bucket_spec", "decode_nonfinite_mask",
     "GoodputIterator", "GoodputLedger", "get_ledger", "set_ledger",
+    "RequestTimeline", "ServingObservatory", "SlotStepLedger",
+    "get_manager", "set_manager",
 ]

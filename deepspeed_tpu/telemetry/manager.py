@@ -92,6 +92,10 @@ class TelemetryManager:
         self._closed = False
         self._last_export_t = float("-inf")
         self._last_export_n = -1
+        # process-global handle, mirroring tracer/metrics/ledger: code
+        # that has no engine reference (the serving observatory's
+        # trace-flush escalation) reaches the live manager through it
+        set_manager(self)
         atexit.register(self.close)
 
     # ---------------------------------------------------------------- spans
@@ -160,4 +164,28 @@ class TelemetryManager:
             _ledger_mod.reset_ledger(if_current=self.goodput)
         self.flush(force=True)
         _cw.uninstall_global_listener()
+        reset_manager(if_current=self)
         atexit.unregister(self.close)
+
+
+# Process-global manager handle. ``None`` until an enabled
+# TelemetryManager installs itself; close() restores None (only if it is
+# still the installed one, so a newer engine's manager is not clobbered).
+_GLOBAL = None
+
+
+def get_manager():
+    return _GLOBAL
+
+
+def set_manager(manager):
+    """Install *manager* as the process-global handle; returns the old."""
+    global _GLOBAL
+    old, _GLOBAL = _GLOBAL, manager
+    return old
+
+
+def reset_manager(if_current=None):
+    global _GLOBAL
+    if if_current is None or _GLOBAL is if_current:
+        _GLOBAL = None

@@ -99,6 +99,29 @@ class Histogram(_Metric):
                 out.append(acc)
         return out
 
+    def quantile(self, q):
+        """Prometheus-style quantile estimate: linear interpolation
+        inside the bucket the rank falls into (the +Inf bucket clamps to
+        the last finite edge). ``None`` while the histogram is empty —
+        callers must handle it (e.g. a serving run whose decode_steps
+        covers every generation records no inter-token latencies)."""
+        cum = self.cumulative_counts()
+        total = self.count
+        if total == 0:
+            return None
+        rank = q * total
+        edges = [0.0] + [float(b) for b in self.buckets]
+        for i, c in enumerate(cum):
+            if c >= rank:
+                if i >= len(self.buckets):          # +Inf bucket
+                    return edges[-1]
+                lo = edges[i]
+                hi = float(self.buckets[i])
+                prev = cum[i - 1] if i else 0
+                frac = (rank - prev) / max(1, c - prev)
+                return lo + (hi - lo) * frac
+        return edges[-1]
+
 
 class MetricsRegistry:
     """Name+labels -> metric instance. ``get_or_create`` semantics so call

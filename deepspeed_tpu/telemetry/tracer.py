@@ -90,6 +90,19 @@ class Tracer:
                 return
             self._events.append(ev)
 
+    def emit(self, event):
+        """Append a pre-built Chrome-trace event dict verbatim. The
+        serving observatory synthesizes per-slot lane events with its
+        own pid/tid (and "M" metadata naming the lanes) — those cannot
+        go through span()/instant(), which stamp the CURRENT thread."""
+        if not self.enabled:
+            return
+        with self._lock:
+            if len(self._events) >= self.max_events:
+                self.dropped += 1
+                return
+            self._events.append(event)
+
     def instant(self, name, **args):
         """Zero-duration marker event (ph="i")."""
         if not self.enabled:

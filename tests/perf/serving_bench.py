@@ -18,11 +18,20 @@ equal max batch:
 Both sides are warmed first (XLA compile excluded from the timed run) and
 both count only USEFUL tokens (each request's own generation length).
 
+The serving side runs with the serving observatory's slot-step ledger
+armed, and the artifact carries the timed trace's slot-step attribution
+(decode_useful / prefill / recompute / frozen / idle in integer
+micro-units) — the instrument that would catch a regression back toward
+the static baseline's measured ~76% wasted slot-steps.
+
 Writes the committed SERVING_BENCH.json (schema-pinned in
 tests/unit/test_artifacts.py with floors that encode the acceptance
 criteria: strictly higher aggregate tok/s, exactly one compiled decode
-program, zero retraces) and REFUSES to write a regen where continuous
-batching does not win.
+program, zero retraces, slot-step categories summing EXACTLY to
+steps x max_batch x decode_steps, serving's wasted fraction below the
+baseline's) and REFUSES to write a regen where continuous batching does
+not win, the categories don't sum, or serving wastes as much as the
+static baseline.
 
 Run:  JAX_PLATFORMS=cpu python tests/perf/serving_bench.py        # laptop
       python tests/perf/serving_bench.py                          # TPU
@@ -45,27 +54,6 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 import numpy as np
 
 PROMPT_BUCKET = 32         # baseline pads prompts to this multiple
-
-
-def _percentile_from_hist(hist, q):
-    """Prometheus-style percentile from a registry Histogram (linear
-    interpolation inside the bucket)."""
-    cum = hist.cumulative_counts()
-    total = hist.count
-    if total == 0:
-        return None
-    rank = q * total
-    edges = [0.0] + [float(b) for b in hist.buckets]
-    for i, c in enumerate(cum):
-        if c >= rank:
-            if i >= len(hist.buckets):          # +Inf bucket
-                return edges[-1]
-            lo = edges[i]
-            hi = float(hist.buckets[i])
-            prev = cum[i - 1] if i else 0
-            frac = (rank - prev) / max(1, c - prev)
-            return lo + (hi - lo) * frac
-    return edges[-1]
 
 
 def _exact_percentile(values, q):
@@ -146,11 +134,13 @@ def run_serving(make_engine, trace):
     while srv.scheduler.has_work():
         srv.step()
     srv.collect()
-    # counter baselines: the artifact reports the TIMED trace's work, not
-    # the warm-up request's dispatches
+    # counter/ledger baselines: the artifact reports the TIMED trace's
+    # work, not the warm-up request's dispatches
     warm = {name: srv.registry.counter(name).value
             for name in ("serving_decode_steps_total",
                          "serving_prefill_chunks_total")}
+    warm_units, warm_steps = srv.observatory.ledger.totals()
+    warm["slot_units"], warm["slot_steps"] = warm_units, warm_steps
     t0 = time.perf_counter()
     rids = [srv.submit(r.prompt, max_new_tokens=r.gen) for r in trace]
     occ = []
@@ -211,15 +201,44 @@ def main():
                    "attention_impl": os.environ.get(
                        "SERVING_BENCH_ATTN", "gather"),
                    "decode_steps": int(os.environ.get(
-                       "SERVING_BENCH_DECODE_STEPS", "8"))}
+                       "SERVING_BENCH_DECODE_STEPS", "8")),
+                   # the slot-step ledger rides the timed run (pure host
+                   # bookkeeping); SLO thresholds parked high and the
+                   # snapshot parked in /tmp so a bench can never clobber
+                   # the committed SERVING_HEALTH.json demo artifact
+                   "observability": {
+                       "enabled": True, "window": 32,
+                       "ttft_slo_ms": 1e12, "preemption_thrash": 10 ** 9,
+                       "no_progress_steps": 10 ** 9,
+                       "trace_lanes": False,
+                       "snapshot_file": os.path.join(
+                           "/tmp", "serving_bench_health.json")}}
     srv, srv_s, srv_ttfts, occ, warm = run_serving(
         lambda: ServingEngine(eng, config=serving_cfg, registry=registry),
         trace)
 
     tok_hist = registry.histogram("serving_token_latency_ms")
     stats = srv.compile_stats()
+    # slot-step attribution of the TIMED trace (warm-up diffed out):
+    # integer micro-units, so the sums-to-total check is EXACT
+    units_all, steps_all = srv.observatory.ledger.totals()
+    units = {c: units_all[c] - warm["slot_units"][c] for c in units_all}
+    sched_steps = steps_all - warm["slot_steps"]
+    K = serving_cfg["decode_steps"]
+    total_units = sum(units.values())
+    wasted_units = units["idle"] + units["frozen"] + units["recompute"]
+    slot_steps = {
+        "steps": sched_steps,
+        "max_batch": max_batch,
+        "decode_steps": K,
+        "units": units,
+        "total_units": total_units,
+        "expected_units": sched_steps * max_batch * K,
+        "sums_exact": total_units == sched_steps * max_batch * K,
+        "wasted_frac": round(wasted_units / max(1, total_units), 4),
+    }
     doc = {
-        "schema": "deepspeed_tpu.serving_bench/1",
+        "schema": "deepspeed_tpu.serving_bench/2",
         "scenario": {
             "model": name, "n_embd": cfg.n_embd, "n_layer": cfg.n_layer,
             "backend": jax.default_backend(), "kv_cache": kv,
@@ -255,10 +274,11 @@ def main():
             "ttft_ms": {"p50": round(_exact_percentile(srv_ttfts, .5) * 1e3, 2),
                         "p99": round(_exact_percentile(srv_ttfts, .99) * 1e3, 2)},
             "token_latency_ms": {
-                "p50": _r(_percentile_from_hist(tok_hist, .5)),
-                "p99": _r(_percentile_from_hist(tok_hist, .99))},
+                "p50": _r(tok_hist.quantile(.5)),
+                "p99": _r(tok_hist.quantile(.99))},
             "kv_occupancy": {"mean": round(float(np.mean(occ)), 4),
                              "peak": round(float(np.max(occ)), 4)},
+            "slot_steps": slot_steps,
             "compile": stats,
         },
     }
@@ -274,6 +294,20 @@ def main():
     if stats["decode_signatures"] != 1 or stats["retraces"]:
         print("REFUSING to write artifact: decode-step program count "
               f"!= 1 ({stats})", file=sys.stderr)
+        sys.exit(1)
+    if not slot_steps["sums_exact"]:
+        print("REFUSING to write artifact: slot-step categories sum to "
+              f"{total_units} units but {sched_steps} steps x "
+              f"{max_batch} slots x K={K} is "
+              f"{slot_steps['expected_units']} — the by-construction "
+              "invariant broke", file=sys.stderr)
+        sys.exit(1)
+    if slot_steps["wasted_frac"] >= doc["baseline"]["wasted_decode_frac"]:
+        print("REFUSING to write artifact: serving wasted "
+              f"{slot_steps['wasted_frac']:.1%} of its slot-steps, not "
+              "below the static baseline's "
+              f"{doc['baseline']['wasted_decode_frac']:.1%} — continuous "
+              "batching stopped paying for itself", file=sys.stderr)
         sys.exit(1)
     out = os.environ.get("SERVING_BENCH_OUT") or os.path.join(
         os.path.dirname(__file__), "..", "..", "SERVING_BENCH.json")

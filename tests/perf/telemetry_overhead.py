@@ -25,7 +25,13 @@ Asserts:
 * ``data_prefetch``: a 20-step run through a prefetched deepspeed_io
   loader (host workers + device stage) adds exactly ZERO train-step
   compiles — background placement produces the same avals/shardings —
-  and ``engine.close()`` stops every pipeline thread.
+  and ``engine.close()`` stops every pipeline thread;
+* ``serving.observability``: the serving observatory is statically
+  host-only (no jax import outside its CLI demo — it CANNOT add device
+  syncs), an observability-on heterogeneous trace still runs exactly
+  ONE compiled decode program with zero retraces and zero extra backend
+  compiles, the slot-step ledger's integer categories sum to
+  steps x max_batch x decode_steps, and the disabled path is inert.
 
 Run manually:  python tests/perf/telemetry_overhead.py [iters] — not
 collected by pytest (no test_ prefix), like the other perf scripts here.
@@ -273,6 +279,130 @@ def check_prefetch_zero_extra_compiles(steps=20):
           f"teardown leak-free")
 
 
+def check_serving_obs_no_device_access():
+    """The serving observatory must stay PURE HOST bookkeeping — a module
+    that cannot reach jax cannot introduce a per-step device sync. The
+    guard is static: no jax import anywhere in the module outside the
+    CLI demo functions (which build a real engine on purpose)."""
+    import ast
+
+    import deepspeed_tpu.telemetry.serving_observatory as obs_mod
+    with open(obs_mod.__file__) as f:
+        tree = ast.parse(f.read())
+
+    def jax_imports(node):
+        found = []
+        for n in ast.walk(node):
+            if isinstance(n, ast.Import):
+                found += [a.name for a in n.names
+                          if a.name.split(".")[0] == "jax"]
+            elif isinstance(n, ast.ImportFrom) and \
+                    (n.module or "").split(".")[0] == "jax":
+                found.append(n.module)
+        return found
+
+    offenders = []
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name in ("_demo", "main"):
+            continue
+        offenders += jax_imports(node)
+    assert not offenders, (
+        f"serving_observatory imports jax outside its CLI demo "
+        f"({offenders}) — the observatory must stay host-only so it "
+        f"cannot add device syncs to the serving step")
+    print("serving observatory: statically host-only (no jax imports "
+          "outside the CLI demo)")
+
+
+def check_serving_obs_zero_extra_compiles():
+    """Acceptance guard: a heterogeneous serving trace with the FULL
+    observatory armed (timelines + slot ledger + SLO rules) still runs
+    ONE compiled decode program, one prefill program, zero retraces —
+    and after the programs exist, a second differently-shaped wave adds
+    exactly zero backend compiles. The slot-step ledger's categories sum
+    to steps x max_batch x decode_steps exactly (integers, by
+    construction)."""
+    import tempfile
+
+    import numpy as np
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+    from deepspeed_tpu.serving.server import ServingEngine
+    from deepspeed_tpu.telemetry import compile_watch
+    from deepspeed_tpu.telemetry.metrics import MetricsRegistry
+    from deepspeed_tpu.utils import groups
+    groups.destroy()
+    groups.initialize()
+    cfg = GPT2Config(vocab_size=256, n_positions=64, n_embd=32,
+                     n_layer=2, n_head=2)
+    model = GPT2LMHeadModel(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        {"input_ids": jnp.zeros((1, 8), jnp.int32)})["params"]
+    eng = deepspeed_tpu.init_inference(model, params=params,
+                                       dtype=jnp.float32)
+    registry = MetricsRegistry()
+    snap_path = os.path.join(tempfile.mkdtemp(prefix="ds_srv_obs_"),
+                             "SERVING_HEALTH.json")
+    srv = ServingEngine(eng, config={
+        "max_batch": 3, "block_size": 8, "prefill_chunk": 6,
+        "decode_steps": 2,
+        "observability": {"enabled": True, "window": 4,
+                          "snapshot_file": snap_path}},
+        registry=registry)
+    assert srv.observatory is not None
+
+    def backend_compiles():
+        return sum(m.value for ms in registry.collect().values()
+                   for m in ms if m.name == "xla_backend_compiles_total")
+
+    compile_watch.install_global_listener(registry)
+    try:
+        rng = np.random.default_rng(3)
+        for plen, gen in ((9, 5), (3, 7), (17, 4)):     # warm both programs
+            srv.submit(rng.integers(0, cfg.vocab_size, (plen,)), gen)
+        srv.serve_forever()
+        after_warm = backend_compiles()
+        for plen, gen in ((13, 6), (2, 3), (27, 8), (5, 5)):
+            srv.submit(rng.integers(0, cfg.vocab_size, (plen,)), gen)
+        outs = srv.serve_forever()
+        assert len(outs) == 4
+        assert backend_compiles() == after_warm, (
+            "observability-on serving recompiled in steady state — the "
+            "observatory must never change program shapes")
+    finally:
+        compile_watch.uninstall_global_listener()
+    stats = srv.compile_stats()
+    assert stats == {"decode_signatures": 1, "prefill_signatures": 1,
+                     "retraces": 0}, stats
+    led = srv.observatory.ledger
+    units, steps = led.totals()
+    assert sum(units.values()) == steps * led.max_batch * led.K, (
+        f"slot-step ledger lost units: {units} over {steps} steps")
+
+    # disabled path: no observatory object, no observatory metrics, the
+    # scheduler runs without an observer
+    reg2 = MetricsRegistry()
+    srv2 = ServingEngine(eng, config={"max_batch": 2, "block_size": 8},
+                         registry=reg2)
+    assert srv2.observatory is None and srv2.scheduler.observer is None
+    srv2.submit(rng.integers(0, cfg.vocab_size, (7,)), 3)
+    srv2.serve_forever()
+    snap = reg2.snapshot()
+    for name in ("serving_slot_units_total", "serving_window_wasted_frac",
+                 "serving_anomalies_total", "serving_kv_fragmentation"):
+        assert name not in snap, f"unexpected metric {name} while disabled"
+    print(f"serving observatory: 1 decode program, 0 retraces, 0 extra "
+          f"backend compiles with observability on; ledger "
+          f"{sum(units.values())} units == {steps} steps x "
+          f"{led.max_batch} x K={led.K}; disabled path inert")
+
+
 def check_goodput_disabled_inert(steps=3):
     """goodput off => no ledger object, no goodput metrics, the global
     ledger stays the disabled singleton, and a disabled ledger's
@@ -330,6 +460,8 @@ def main(iters=200_000):
     check_goodput_full_stack_one_compile()
     check_goodput_disabled_inert()
     check_prefetch_zero_extra_compiles()
+    check_serving_obs_no_device_access()
+    check_serving_obs_zero_extra_compiles()
     print("OK")
 
 

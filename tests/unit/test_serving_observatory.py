@@ -1,0 +1,632 @@
+"""Serving-observatory tests — timelines, slot-step ledger, SLO rules.
+
+Host-side invariants run with no device programs at all (the observatory
+is pure bookkeeping: a synthetic step loop drives ``end_step`` /
+``record_*`` directly): the slot-step ledger's sums-by-construction, rule
+arming after warmup, warn-once escalation with the throttled snapshot,
+and the exact per-step no-progress streak. The end-to-end tests drive a
+real ServingEngine with observability armed and pin the acceptance
+behaviours: lifecycle event ordering across preemption/resume, exact
+ledger sums on the real step loop (including multi-step decode), greedy
+parity and EXACTLY one compiled decode program with observability on,
+the livelock exception carrying the forensics report, and the
+preemption-reason / recompute-token satellites flowing through the
+registry.
+"""
+
+import json
+import time
+import types
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+from deepspeed_tpu.runtime.config import (DeepSpeedConfigError,
+                                          DeepSpeedServingConfig)
+from deepspeed_tpu.serving.server import (ServingEngine,
+                                          ServingLivelockError)
+from deepspeed_tpu.telemetry.metrics import MetricsRegistry
+from deepspeed_tpu.telemetry.serving_observatory import (SLOT_CATEGORIES,
+                                                         ServingObservatory,
+                                                         SlotStepLedger)
+from deepspeed_tpu.utils import groups
+
+
+def _obs(tmp_path, max_batch=2, decode_steps=1, **kw):
+    logs = []
+    kw.setdefault("window", 4)
+    kw.setdefault("warmup_windows", 1)
+    ob = ServingObservatory(
+        max_batch=max_batch, decode_steps=decode_steps,
+        snapshot_path=str(tmp_path / "SERVING_HEALTH.json"),
+        registry=MetricsRegistry(), on_escalate=lambda: None,
+        log_fn=lambda msg, *a: logs.append(msg % a), **kw)
+    ob._test_logs = logs
+    return ob
+
+
+def _step(ob, acts=None, occupied=(), queue=0, active=0, occ=0.0,
+          frag=0.0, progress=True):
+    ob.end_step(acts or {}, set(occupied), queue_depth=queue,
+                active=active, kv_occupancy=occ, kv_fragmentation=frag,
+                progress=progress)
+
+
+def _req(req_id=1, slot=0):
+    return types.SimpleNamespace(
+        req_id=req_id, slot=slot, prompt=[1, 2, 3], max_new_tokens=8,
+        preemptions=0, output_tokens=[], block_table=[], submit_t=0.0)
+
+
+# ------------------------------------------------------- slot-step ledger
+def test_ledger_sums_by_construction():
+    led = SlotStepLedger(max_batch=3, decode_steps=4)
+    led.account({0: ("decode", 3), 1: ("prefill", 16)}, occupied={0, 1})
+    led.account({0: ("decode", 4)}, occupied={0, 2})   # slot 2 frozen
+    led.account({}, occupied=set())                    # all idle
+    units, steps = led.totals()
+    assert steps == 3
+    assert sum(units.values()) == steps * 3 * 4        # EXACT, integers
+    # step 1: slot0 3 useful + 1 frozen, slot1 4 prefill, slot2 idle;
+    # step 2: slot0 4 useful, slot1 idle, slot2 frozen; step 3: 12 idle
+    assert units == {"decode_useful": 7, "prefill": 4, "recompute": 0,
+                     "frozen": 5, "idle": 20}
+    assert led.wasted_fraction() == (5 + 20) / 36
+
+
+def test_ledger_recompute_and_clamps():
+    led = SlotStepLedger(max_batch=1, decode_steps=2)
+    led.account({0: ("recompute", 8)}, occupied={0})
+    led.account({0: ("decode", 99)}, occupied={0})     # clamped to K
+    units, steps = led.totals()
+    assert units["recompute"] == 2 and units["decode_useful"] == 2
+    assert sum(units.values()) == steps * 1 * 2
+
+
+# ------------------------------------------------------- rules and arming
+def test_ttft_rule_armed_after_warmup(tmp_path):
+    ob = _obs(tmp_path, window=2, warmup_windows=1, ttft_slo_ms=10.0,
+              ttft_breach_frac=0.5)
+    r = _req()
+    # window 1 (warmup): every first token breaches, but no rule yet
+    ob.record_first_token(r, 50.0)
+    _step(ob)
+    _step(ob)
+    assert ob.windows_closed == 1 and not ob.rule_counts
+    # window 2: armed — fires
+    ob.record_first_token(r, 60.0)
+    _step(ob)
+    _step(ob)
+    assert ob.rule_counts == {"ttft_slo_breach": 1}
+    assert ob.verdict() == "warning"
+    counter = ob.registry.counter("serving_anomalies_total",
+                                  labels={"rule": "ttft_slo_breach"})
+    assert counter.value == 1
+
+
+def test_ttft_rule_respects_breach_fraction(tmp_path):
+    ob = _obs(tmp_path, window=1, warmup_windows=0, ttft_slo_ms=10.0,
+              ttft_breach_frac=0.5)
+    r = _req()
+    for ttft in (5.0, 6.0, 50.0):        # 1/3 over SLO < 0.5 threshold
+        ob.record_first_token(r, ttft)
+    _step(ob)
+    assert not ob.rule_counts
+    # the boundary is reachable: breach_frac=1.0 ("every first token
+    # breaches") must be able to fire — the rule compares >=, not >
+    ob2 = _obs(tmp_path, window=1, warmup_windows=0, ttft_slo_ms=10.0,
+               ttft_breach_frac=1.0)
+    ob2.record_first_token(_req(), 50.0)
+    _step(ob2)
+    assert ob2.rule_counts.get("ttft_slo_breach") == 1
+
+
+def test_admission_fail_books_finish(tmp_path):
+    """A capacity failure IS a finish: the report's counters must agree
+    with the server's serving_requests_finished_total{reason='capacity'}."""
+    ob = _obs(tmp_path)
+    r = _req()
+    ob.record_submit(r)
+    ob.on_admission_fail(r)
+    assert ob.requests_finished == {"capacity": 1}
+    rep = ob.report()
+    assert rep["counters"]["requests_finished"] == {"capacity": 1}
+    tl = rep["timelines"]["recent"][0]
+    assert tl["finish_reason"] == "capacity"
+    assert tl["events"][-1]["event"] == "failed"
+
+
+def test_queue_growth_rule(tmp_path):
+    ob = _obs(tmp_path, window=1, warmup_windows=0, queue_growth_windows=3)
+    for q in (1, 2, 3):                  # 3 windows, but deque needs 4
+        _step(ob, queue=q)
+    assert "queue_growth" not in ob.rule_counts
+    _step(ob, queue=5)                   # 4th strictly-increasing window
+    assert ob.rule_counts.get("queue_growth") == 1
+    # a drain resets the monotone run
+    _step(ob, queue=2)
+    _step(ob, queue=3)
+    assert ob.rule_counts.get("queue_growth") == 1
+
+
+def test_preemption_thrash_rule_and_recompute_detail(tmp_path):
+    ob = _obs(tmp_path, window=2, warmup_windows=0, preemption_thrash=2)
+    r = _req()
+    ob.on_preempt(r, "capacity_growth", evicted_tokens=12)
+    ob.on_preempt(r, "capacity_growth", evicted_tokens=4)
+    _step(ob)
+    _step(ob)
+    assert ob.rule_counts.get("preemption_thrash") == 1
+    assert ob.preemptions_by_reason == {"capacity_growth": 2}
+    a = [x for x in ob.anomalies if x["rule"] == "preemption_thrash"][0]
+    assert "recompute" in a["detail"]
+
+
+def test_decode_stall_rule_fires_only_when_occupied_and_stuck(tmp_path):
+    ob = _obs(tmp_path, window=2, warmup_windows=0)
+    # occupied slots, zero forward units -> stall
+    _step(ob, occupied={0, 1}, active=2)
+    _step(ob, occupied={0, 1}, active=2)
+    assert ob.rule_counts.get("decode_stall") == 1
+    assert ob.verdict() == "critical"
+    # an idle window (nothing occupied) must NOT fire
+    ob2 = _obs(tmp_path, window=2, warmup_windows=0)
+    _step(ob2)
+    _step(ob2)
+    assert not ob2.rule_counts
+
+
+def test_no_progress_streak_exact(tmp_path):
+    ob = _obs(tmp_path, window=10 ** 6, no_progress_steps=3)
+    _step(ob, progress=False)
+    _step(ob, progress=False)
+    assert not ob.rule_counts
+    _step(ob, progress=False)            # streak hits threshold exactly
+    assert ob.rule_counts.get("no_progress") == 1
+    _step(ob, progress=False)            # past threshold: no re-fire
+    assert ob.rule_counts.get("no_progress") == 1
+    _step(ob, progress=True)
+    assert ob.no_progress_streak == 0
+    assert ob.max_no_progress_streak == 4
+
+
+def test_escalation_warn_once_and_snapshot_throttle(tmp_path):
+    ob = _obs(tmp_path, window=1, warmup_windows=0, ttft_slo_ms=1.0,
+              ttft_breach_frac=0.1)
+    r = _req()
+    for _ in range(4):                   # same rule fires 4 windows
+        ob.record_first_token(r, 99.0)
+        _step(ob)
+    assert ob.rule_counts["ttft_slo_breach"] == 4
+    assert len(ob._test_logs) == 1       # warn-once per rule
+    # first firing force-writes; repeats ride the 5s throttle
+    assert ob._snapshots_written == 1
+    assert (tmp_path / "SERVING_HEALTH.json").exists()
+    # a NEW rule force-writes again despite the throttle
+    _step(ob, occupied={0}, active=1)
+    assert "decode_stall" in ob.rule_counts
+    assert ob._snapshots_written == 2
+    assert len(ob._test_logs) == 2
+
+
+def test_escalation_snapshot_has_no_duplicate_window(tmp_path):
+    """A first-time rule firing snapshots from INSIDE the window close;
+    the just-closed accumulators must already be reset or report()'s
+    forced close re-appends the same window as a duplicate (the ring
+    would over-count units and _window_seq would skip)."""
+    ob = _obs(tmp_path, window=2, warmup_windows=0, ttft_slo_ms=1.0,
+              ttft_breach_frac=0.1)
+    ob.record_first_token(_req(), 99.0)
+    _step(ob, acts={0: ("decode", 1)}, occupied={0}, active=1)
+    _step(ob, acts={0: ("decode", 1)}, occupied={0}, active=1)
+    assert ob.rule_counts.get("ttft_slo_breach") == 1
+    wins = list(ob.windows)
+    assert [w["index"] for w in wins] == [0]
+    assert not wins[0].get("forced")
+    # the ring covers the ledger exactly once — no double-booked units
+    assert wins[0]["slot_units"]["decode_useful"] == \
+        ob.ledger.units["decode_useful"] == 2
+    with open(tmp_path / "SERVING_HEALTH.json") as f:
+        doc = json.load(f)
+    assert [w["index"] for w in doc["windows"]] == [0]
+
+
+def test_no_progress_on_window_boundary_keeps_cadence_close(tmp_path):
+    """A no_progress escalation landing on a window-boundary step must
+    not swallow the cadence close: the window's own rules (here a TTFT
+    breach) still run, it lands in the ring unforced, and its metrics
+    publish."""
+    ob = _obs(tmp_path, window=4, warmup_windows=0, no_progress_steps=4,
+              ttft_slo_ms=1.0, ttft_breach_frac=0.1)
+    ob.record_first_token(_req(), 99.0)
+    for _ in range(4):
+        _step(ob, progress=False)
+    assert ob.windows_closed == 1
+    wins = list(ob.windows)
+    assert [w["index"] for w in wins] == [0]
+    assert not wins[0].get("forced")
+    assert ob.rule_counts.get("ttft_slo_breach") == 1
+    assert ob.rule_counts.get("no_progress") == 1
+
+
+def test_close_flushes_final_forensics(tmp_path):
+    """Anomalies whose repeat firings all landed inside the snapshot
+    throttle window must still reach disk at teardown — close() is the
+    guarantee."""
+    ob = _obs(tmp_path, window=1, warmup_windows=0, ttft_slo_ms=1.0,
+              ttft_breach_frac=0.1)
+    ob.record_first_token(_req(), 99.0)
+    _step(ob)                       # first firing force-writes
+    assert ob._snapshots_written == 1
+    ob.record_first_token(_req(), 99.0)
+    _step(ob)                       # repeat rides the 5s throttle
+    assert ob.rule_counts["ttft_slo_breach"] == 2
+    assert ob._snapshots_written == 1
+    ob.close()                      # teardown forces the last state out
+    assert ob._snapshots_written == 2
+    # nothing to explain -> close writes nothing
+    ob2 = _obs(tmp_path / "clean")
+    _step(ob2)
+    ob2.close()
+    assert ob2._snapshots_written == 0
+
+
+def test_requeue_wait_lane_measured_from_requeue(tmp_path):
+    """The queue-wait lane of a re-admitted request spans requeue ->
+    re-admission — not zero (the old behavior) and not the whole
+    lifetime since submit()."""
+    from deepspeed_tpu.telemetry.tracer import Tracer, set_tracer
+    tracer = Tracer(enabled=True)
+    old = set_tracer(tracer)
+    try:
+        ob = _obs(tmp_path)
+        r = _req()
+        ob.record_submit(r)
+        time.sleep(0.1)
+        ob.on_admit(r)
+        ob.on_preempt(r, "capacity_growth", evicted_tokens=3)
+        time.sleep(0.005)
+        r.preemptions = 1
+        ob.on_admit(r)
+    finally:
+        set_tracer(old)
+    spans = [e for e in tracer.events()
+             if e.get("ph") == "X" and e["name"] == "req1 queued"]
+    assert len(spans) == 2
+    assert spans[0]["dur"] >= 90_000          # us: the full submit wait
+    # re-admission: measured from the REQUEUE (~5ms), not pinned to 0
+    # and not restarted from submit (which would re-count the ~100ms)
+    assert 4_000 <= spans[1]["dur"] < 90_000
+
+
+def test_report_closes_partial_window_as_forced(tmp_path):
+    ob = _obs(tmp_path, window=8, warmup_windows=0)
+    _step(ob, acts={0: ("decode", 1)}, occupied={0}, active=1)
+    rep = ob.report()
+    assert ob.windows_closed == 0        # forced close is not a cadence tick
+    assert rep["windows"] and rep["windows"][-1]["forced"] is True
+    assert rep["windows"][-1]["slot_units"]["decode_useful"] == 1
+    led = rep["slot_ledger"]
+    assert led["total_units"] == led["steps"] * led["max_batch"] \
+        * led["decode_steps"]
+
+
+def test_snapshot_is_strict_json(tmp_path):
+    ob = _obs(tmp_path, window=1, warmup_windows=0, ttft_slo_ms=1.0,
+              ttft_breach_frac=0.1)
+    ob.record_first_token(_req(), 99.0)
+    _step(ob)
+    path = tmp_path / "SERVING_HEALTH.json"
+    with open(path) as f:
+        doc = json.load(f, parse_constant=lambda tok: pytest.fail(
+            f"snapshot carries bare {tok!r}"))
+    assert doc["schema"] == "deepspeed_tpu.serving_health/1"
+    assert doc["anomalies"]
+
+
+# -------------------------------------------------------------- config
+def test_observability_config_parse_and_validation():
+    c = DeepSpeedServingConfig({"serving": {"observability": {
+        "enabled": True, "window": 16, "ttft_slo_ms": 250,
+        "preemption_thrash": 4}}})
+    o = c.observability
+    assert o.enabled and o.window == 16 and o.ttft_slo_ms == 250.0
+    assert o.preemption_thrash == 4
+    assert o.warmup_windows == 1 and o.trace_lanes is True
+    assert DeepSpeedServingConfig({}).observability.enabled is False
+    for bad in ({"window": 0}, {"ttft_breach_frac": 0},
+                {"ttft_breach_frac": 1.5}, {"no_progress_steps": 0},
+                {"warmup_windows": -1}, {"queue_growth_windows": 0},
+                # thrash threshold 0 would fire on EVERY window (the
+                # rule is >=, and every window has >= 0 preemptions)
+                {"preemption_thrash": 0}, {"ttft_slo_ms": 0}):
+        with pytest.raises(DeepSpeedConfigError):
+            DeepSpeedServingConfig({"serving": {"observability": bad}})
+
+
+def test_observability_env_override(monkeypatch):
+    monkeypatch.setenv("DS_SERVING_OBS", "1")
+    assert DeepSpeedServingConfig({}).observability.enabled is True
+    monkeypatch.setenv("DS_SERVING_OBS", "0")
+    assert DeepSpeedServingConfig(
+        {"serving": {"observability": {"enabled": True}}}
+    ).observability.enabled is False
+
+
+# ------------------------------------------------------------ end-to-end
+@pytest.fixture(scope="module")
+def obs_serving(tmp_path_factory):
+    groups.destroy()
+    groups.initialize()
+    cfg = GPT2Config(vocab_size=256, n_positions=64, n_embd=32,
+                     n_layer=2, n_head=2)
+    model = GPT2LMHeadModel(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        {"input_ids": jnp.zeros((1, 8), jnp.int32)})["params"]
+    eng = deepspeed_tpu.init_inference(model, params=params,
+                                       dtype=jnp.float32)
+    tmp = tmp_path_factory.mktemp("obs")
+    return cfg, eng, tmp
+
+
+def _mk(eng, tmp, registry=None, **serving_cfg):
+    serving_cfg.setdefault("max_batch", 2)
+    serving_cfg.setdefault("block_size", 8)
+    obs = serving_cfg.setdefault("observability", {})
+    obs.setdefault("enabled", True)
+    obs.setdefault("window", 4)
+    # NEVER default into the repo root: an escalating unit test must not
+    # clobber the committed SERVING_HEALTH.json (the PR-4 GOODPUT lesson)
+    obs.setdefault("snapshot_file", str(tmp / "SERVING_HEALTH.json"))
+    return ServingEngine(eng, config=serving_cfg,
+                         registry=registry or MetricsRegistry())
+
+
+def _baseline(eng, prompt, n_new):
+    out = eng.generate(jnp.asarray(prompt, jnp.int32)[None],
+                       max_new_tokens=n_new)
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+def test_e2e_timeline_ordering_across_preemption(obs_serving):
+    cfg, eng, tmp = obs_serving
+    srv = _mk(eng, tmp, num_blocks=7)    # 6 usable blocks force eviction
+    rng = np.random.default_rng(5)
+    prompts = [rng.integers(0, 256, (15,)).astype(np.int32)
+               for _ in range(2)]
+    rids = [srv.submit(p, max_new_tokens=20) for p in prompts]
+    outs = {o.req_id: o for o in srv.serve_forever()}
+    assert srv.scheduler.preemptions_total >= 1
+    for rid, p in zip(rids, prompts):    # parity with observability ON
+        assert outs[rid].tokens == _baseline(eng, p, 20)
+    rep = srv.serving_report()
+    assert not rep["timelines"]["active"]
+    tls = {t["req_id"]: t for t in rep["timelines"]["recent"]}
+    assert set(tls) == set(rids)
+    pre = next(t for t in tls.values()
+               if any(e["event"] == "preempted" for e in t["events"]))
+    names = [e["event"] for e in pre["events"]]
+    # the lifecycle reads in order: queued -> admitted -> ... ->
+    # preempted -> requeued -> admitted (recompute re-prefill) -> finished
+    assert names[0] == "queued"
+    i_pre = names.index("preempted")
+    assert names[i_pre + 1] == "requeued"
+    assert "admitted" in names[i_pre + 2:], "resume must re-admit"
+    i_re = i_pre + 2 + names[i_pre + 2:].index("admitted")
+    re_chunks = [e for e in pre["events"][i_re:]
+                 if e["event"] == "prefill_chunk"]
+    assert re_chunks and re_chunks[0]["recompute"] > 0, (
+        "the resume prefill must be booked as recompute")
+    assert names[-1] == "finished"
+    assert names.count("first_token") == 1
+    ts = [e["t_ms"] for e in pre["events"]]
+    assert ts == sorted(ts), "timeline timestamps must be monotonic"
+    # preemption carries its cost
+    ev_pre = pre["events"][i_pre]
+    assert ev_pre["reason"] == "capacity_growth"
+    assert ev_pre["evicted_tokens"] > 0
+
+
+def test_e2e_ledger_sums_and_report(obs_serving):
+    cfg, eng, tmp = obs_serving
+    srv = _mk(eng, tmp, max_batch=3, prefill_chunk=6)
+    rng = np.random.default_rng(7)
+    for plen, gen in ((1, 5), (11, 3), (30, 9), (7, 5), (19, 2)):
+        srv.submit(rng.integers(0, cfg.vocab_size, (plen,)), gen)
+    srv.serve_forever()
+    rep = srv.serving_report()
+    led = rep["slot_ledger"]
+    assert set(led["units"]) == set(SLOT_CATEGORIES)
+    assert led["total_units"] == \
+        led["steps"] * led["max_batch"] * led["decode_steps"]
+    assert led["units"]["decode_useful"] == 5 + 3 + 9 + 5 + 2, (
+        "every kept token is exactly one decode_useful unit at K=1")
+    assert rep["counters"]["tokens_delivered"] == 24
+    assert rep["counters"]["requests_finished"] == {"max_tokens": 5}
+    assert rep["engine_state"]["scheduler"]["active"] == 0
+    assert rep["engine_state"]["kv"]["allocated"] == 0
+    # every cadence window is internally exact too
+    for w in rep["windows"]:
+        if not w.get("forced"):
+            assert sum(w["slot_units"].values()) == \
+                w["steps"] * led["max_batch"] * led["decode_steps"]
+
+
+def test_e2e_multistep_decode_ledger(obs_serving):
+    """decode_steps=4: budget-exhausted micro-steps book as frozen, kept
+    tokens as decode_useful, and the sums stay exact."""
+    cfg, eng, tmp = obs_serving
+    srv = _mk(eng, tmp, decode_steps=4)
+    rng = np.random.default_rng(9)
+    srv.submit(rng.integers(0, 256, (9,)), max_new_tokens=5)
+    srv.submit(rng.integers(0, 256, (4,)), max_new_tokens=7)
+    srv.serve_forever()
+    led = srv.serving_report()["slot_ledger"]
+    assert led["decode_steps"] == 4
+    assert led["total_units"] == led["steps"] * 2 * 4
+    assert led["units"]["decode_useful"] == 12
+    # 5 = 4+1 and 7 = 4+3: the short final dispatches freeze 3+1 slots
+    assert led["units"]["frozen"] >= 4
+
+
+def test_e2e_one_decode_program_with_observability_on(obs_serving):
+    cfg, eng, tmp = obs_serving
+    registry = MetricsRegistry()
+    srv = _mk(eng, tmp, max_batch=3, prefill_chunk=6, registry=registry)
+    rng = np.random.default_rng(11)
+    for plen, gen in ((13, 4), (2, 6), (27, 3), (9, 5)):
+        srv.submit(rng.integers(0, cfg.vocab_size, (plen,)), gen)
+    srv.serve_forever()
+    assert srv.compile_stats() == {"decode_signatures": 1,
+                                   "prefill_signatures": 1, "retraces": 0}
+
+
+def test_e2e_preemption_reason_and_recompute_counters(obs_serving):
+    """Satellite: serving_preemptions_total is split by reason and the
+    recompute tokens burned by preemption are a first-class counter."""
+    cfg, eng, tmp = obs_serving
+    registry = MetricsRegistry()
+    srv = _mk(eng, tmp, num_blocks=7, registry=registry)
+    rng = np.random.default_rng(5)
+    for _ in range(2):
+        srv.submit(rng.integers(0, 256, (15,)), max_new_tokens=20)
+    srv.serve_forever()
+    assert srv.scheduler.preemptions_total >= 1
+    snap = registry.snapshot()
+    rows = {tuple(sorted(r["labels"].items())): r["value"]
+            for r in snap["serving_preemptions_total"]}
+    assert rows == {(("reason", "capacity_growth"),):
+                    float(srv.scheduler.preemptions_total)}
+    burned = registry.counter("serving_recompute_tokens_total").value
+    assert burned > 0
+    assert burned == srv.observatory.recompute_tokens
+    from deepspeed_tpu.telemetry.sinks import render_prometheus
+    text = render_prometheus(registry)
+    assert 'serving_preemptions_total{reason="capacity_growth"}' in text
+    assert "serving_recompute_tokens_total" in text
+
+
+def test_e2e_engine_close_writes_final_snapshot(obs_serving):
+    """ServingEngine.close() is the observatory's teardown wiring: the
+    final forensics snapshot lands even when the last firings rode the
+    throttle."""
+    cfg, eng, tmp = obs_serving
+    srv = _mk(eng, tmp, num_blocks=7,
+              observability={"enabled": True, "window": 2,
+                             "warmup_windows": 0, "preemption_thrash": 1,
+                             "snapshot_file": str(tmp / "close_out.json")})
+    rng = np.random.default_rng(5)
+    for _ in range(2):
+        srv.submit(rng.integers(0, 256, (15,)), max_new_tokens=20)
+    srv.serve_forever()
+    assert srv.observatory.anomalies, "undersized pool must thrash"
+    before = srv.observatory._snapshots_written
+    srv.close()
+    assert srv.observatory._snapshots_written == before + 1
+    # close() is safe with observability disabled too
+    ServingEngine(eng, config={"max_batch": 2, "block_size": 8},
+                  registry=MetricsRegistry()).close()
+
+
+def test_e2e_trace_lanes_exported(obs_serving):
+    """With the PR-1 tracer live, the observatory exports per-slot lanes:
+    named synthetic tids carrying prefill/decode spans and lifecycle
+    instants."""
+    from deepspeed_tpu.telemetry.serving_observatory import _LANE_TID_BASE
+    from deepspeed_tpu.telemetry.tracer import Tracer, set_tracer
+    cfg, eng, tmp = obs_serving
+    tracer = Tracer(enabled=True)
+    old = set_tracer(tracer)
+    try:
+        srv = _mk(eng, tmp)
+        rng = np.random.default_rng(3)
+        srv.submit(rng.integers(0, 256, (9,)), max_new_tokens=3)
+        srv.serve_forever()
+    finally:
+        set_tracer(old)
+    lanes = [e for e in tracer.events()
+             if e.get("tid", 0) >= _LANE_TID_BASE]
+    names = {e["name"] for e in lanes}
+    assert "decode" in names and "prefill" in names
+    meta = [e for e in lanes if e.get("ph") == "M"]
+    assert {"serving slot 0", "serving slot 1", "serving queue"} <= \
+        {e["args"]["name"] for e in meta}
+    assert any(e["name"].endswith("finished") for e in lanes)
+
+
+def test_e2e_livelock_error_carries_report(obs_serving):
+    """Satellite: the serve_forever no-progress guard attaches the
+    scheduler/slot/KV forensics to the exception."""
+    cfg, eng, tmp = obs_serving
+    srv = _mk(eng, tmp)
+    rng = np.random.default_rng(1)
+    srv.submit(rng.integers(0, 256, (5,)), max_new_tokens=2)
+    # break the forward-progress invariant artificially
+    srv.step = lambda: False
+    with pytest.raises(ServingLivelockError) as ei:
+        srv.serve_forever()
+    err = ei.value
+    assert "no progress" in str(err) and ".report" in str(err)
+    assert err.report["schema"] == "deepspeed_tpu.serving_health/1"
+    st = err.report["engine_state"]["scheduler"]
+    assert st["waiting"] == 1 and st["waiting_req_ids"]
+    assert "kv" in err.report["engine_state"]
+    assert "compile" in err.report["engine_state"]
+
+
+def test_e2e_livelock_report_without_observability(obs_serving):
+    """The forensics dump must exist even with observability disabled —
+    the livelock guard predates the observatory."""
+    cfg, eng, tmp = obs_serving
+    srv = ServingEngine(eng, config={"max_batch": 2, "block_size": 8},
+                        registry=MetricsRegistry())
+    assert srv.observatory is None
+    rng = np.random.default_rng(1)
+    srv.submit(rng.integers(0, 256, (5,)), max_new_tokens=2)
+    srv.step = lambda: False
+    with pytest.raises(ServingLivelockError) as ei:
+        srv.serve_forever()
+    rep = ei.value.report
+    assert rep["enabled"] is False
+    assert rep["engine_state"]["scheduler"]["waiting"] == 1
+
+
+def test_e2e_disabled_path_inert(obs_serving):
+    cfg, eng, tmp = obs_serving
+    registry = MetricsRegistry()
+    srv = ServingEngine(eng, config={"max_batch": 2, "block_size": 8},
+                        registry=registry)
+    assert srv.observatory is None
+    assert srv.scheduler.observer is None
+    rng = np.random.default_rng(2)
+    srv.submit(rng.integers(0, 256, (7,)), max_new_tokens=3)
+    srv.serve_forever()
+    snap = registry.snapshot()
+    for name in ("serving_slot_units_total", "serving_window_wasted_frac",
+                 "serving_anomalies_total", "serving_kv_fragmentation"):
+        assert name not in snap, f"unexpected metric {name} while disabled"
+    rep = srv.serving_report()
+    assert rep["enabled"] is False and "engine_state" in rep
+
+
+def test_e2e_serving_report_write_is_strict_json(obs_serving):
+    cfg, eng, tmp = obs_serving
+    path = tmp / "report_out.json"
+    srv = _mk(eng, tmp, observability={"enabled": True,
+                                       "snapshot_file": str(path)})
+    rng = np.random.default_rng(4)
+    srv.submit(rng.integers(0, 256, (6,)), max_new_tokens=2)
+    srv.serve_forever()
+    srv.serving_report(write=True)
+    with open(path) as f:
+        doc = json.load(f, parse_constant=lambda tok: pytest.fail(
+            f"report carries bare {tok!r}"))
+    led = doc["slot_ledger"]
+    assert sum(led["units"].values()) == \
+        led["steps"] * led["max_batch"] * led["decode_steps"]
+    assert doc["engine_state"]["compile"]["decode_signatures"] == 1
