@@ -84,7 +84,22 @@ def telemetry_report():
     row("goodput ledger (wall-clock)", True,
         "(telemetry.goodput block; GOODPUT.json forensics)")
     row("async input prefetch", True,
-        "(data_prefetch block; host workers + device double-buffering)")
+        "(data_prefetch block; host workers + device double-buffering, "
+        "multi-process device stage included)")
+    try:
+        from deepspeed_tpu.runtime.comm_overlap import (
+            check_scheduler_flags, overlap_xla_flags)
+        import jax as _jax
+        backend = _jax.default_backend()
+        armed = check_scheduler_flags(backend)
+        row("comm overlap (bucketed psum)", True,
+            "(comm_overlap block; DS_COMM_OVERLAP=1; latency-hiding "
+            + ("flags armed" if armed and overlap_xla_flags(backend)
+               else ("no flags needed on " + backend if armed
+                     else "flags NOT armed — set XLA_FLAGS at launch"))
+            + ")")
+    except Exception:
+        row("comm overlap (bucketed psum)", False)
     row("serving engine (paged KV)", True,
         "(serving block; continuous batching + chunked prefill + top-p)")
     row("serving observatory", True,

@@ -15,6 +15,7 @@ chunks. Exposed two ways:
 """
 
 import functools
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -121,6 +122,172 @@ def fused_adam(b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.0,
         return updates, optim_lib.AdamState(step=step, mu=mu, nu=nu)
 
     return optim_lib.Optimizer(init, update)
+
+
+_SWEEP_PAD = _BLOCK_ROWS * _LANES
+
+
+def _adam_sweep_kernel(s_ref, p_ref, g_ref, m_ref, v_ref,
+                       u_ref, mo_ref, vo_ref, *rest, b1, b2, eps,
+                       weight_decay, adam_w_mode, has_cast):
+    """One block of the whole-state sweep: clip (scalar coefficient) +
+    Adam + optional compute-dtype cast of the updated param, all from a
+    single read of (p, g, m, v)."""
+    lr, bc1, bc2, cc = (s_ref[0, 0], s_ref[0, 1], s_ref[0, 2], s_ref[0, 3])
+    p = p_ref[:].astype(jnp.float32)
+    g = g_ref[:].astype(jnp.float32) * cc
+    if not adam_w_mode and weight_decay > 0.0:
+        g = g + weight_decay * p
+    m = b1 * m_ref[:] + (1.0 - b1) * g
+    v = b2 * v_ref[:] + (1.0 - b2) * g * g
+    u = -lr * (m / bc1) / (jnp.sqrt(v / bc2) + eps)
+    if adam_w_mode and weight_decay > 0.0:
+        u = u - lr * weight_decay * p
+    u_ref[:] = u.astype(u_ref.dtype)
+    mo_ref[:] = m
+    vo_ref[:] = v
+    if has_cast:
+        c_ref = rest[0]
+        c_ref[:] = (p + u).astype(c_ref.dtype)
+
+
+def adam_sweep_apply(p, g, m, v, lr, bc1, bc2, clip_coef=1.0, *, b1=0.9,
+                     b2=0.999, eps=1e-8, weight_decay=0.0,
+                     adam_w_mode=True, cast_dtype=None, use_pallas=None):
+    """ONE fused pass over the whole flattened state: global-norm clip
+    (``g * clip_coef``), the Adam update, and — when ``cast_dtype`` is
+    given — the fp32 -> compute-dtype cast of the updated params, from a
+    single HBM read of (p, g, m, v). Inputs are FLAT fp32 vectors whose
+    length is a multiple of ``_SWEEP_PAD`` (``runtime/optim.flatten_tree``
+    with ``pad_to=fused_adam.sweep_pad()`` produces them); lr/bc1/bc2/
+    clip_coef are traced scalars. Returns ``(u, m_new, v_new, cast)``
+    with ``cast = (p + u).astype(cast_dtype)`` or ``None``.
+
+    ``use_pallas=None`` auto-selects: the Pallas kernel on TPU, the
+    bit-identical jnp chain elsewhere — interpreted Pallas is a
+    correctness emulator, not a perf path, and XLA fuses the flat chain
+    into one loop over contiguous state anyway (which is the whole
+    point: the per-tensor :func:`fused_adam_update` lost to XLA as a
+    per-bucket dispatch — one launch per leaf)."""
+    if use_pallas is None:
+        use_pallas = not _interpret()
+    cc = jnp.asarray(clip_coef, jnp.float32)
+    if not use_pallas:
+        gg = g.astype(jnp.float32) * cc
+        # p is only touched for weight decay / the cast output — with
+        # both off the sweep never reads the params at all (callers may
+        # pass a placeholder; see fused_adam_sweep)
+        if not adam_w_mode and weight_decay > 0.0:
+            gg = gg + weight_decay * p.astype(jnp.float32)
+        m_new = b1 * m + (1.0 - b1) * gg
+        v_new = b2 * v + (1.0 - b2) * gg * gg
+        u = -lr * (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
+        if adam_w_mode and weight_decay > 0.0:
+            u = u - lr * weight_decay * p.astype(jnp.float32)
+        cast = (p.astype(jnp.float32) + u).astype(cast_dtype) \
+            if cast_dtype is not None else None
+        return u.astype(p.dtype), m_new, v_new, cast
+
+    n = p.size
+    assert n % _SWEEP_PAD == 0, (
+        f"adam_sweep_apply: flat length {n} must be a multiple of "
+        f"{_SWEEP_PAD} (flatten_tree(pad_to=sweep_pad()))")
+    rows = n // _LANES
+    scal = jnp.stack([jnp.asarray(lr, jnp.float32),
+                      jnp.asarray(bc1, jnp.float32),
+                      jnp.asarray(bc2, jnp.float32), cc]).reshape(1, 4)
+    kernel = functools.partial(
+        _adam_sweep_kernel, b1=b1, b2=b2, eps=eps,
+        weight_decay=weight_decay, adam_w_mode=adam_w_mode,
+        has_cast=cast_dtype is not None)
+    blk = pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0))
+    out_shape = [jax.ShapeDtypeStruct((rows, _LANES), p.dtype),
+                 jax.ShapeDtypeStruct((rows, _LANES), jnp.float32),
+                 jax.ShapeDtypeStruct((rows, _LANES), jnp.float32)]
+    out_specs = [blk, blk, blk]
+    if cast_dtype is not None:
+        out_shape.append(jax.ShapeDtypeStruct((rows, _LANES), cast_dtype))
+        out_specs.append(blk)
+    two_d = lambda x: x.reshape(-1, _LANES)
+    out = pl.pallas_call(
+        kernel,
+        grid=(rows // _BLOCK_ROWS,),
+        in_specs=[pl.BlockSpec((1, 4), lambda i: (0, 0)),
+                  blk, blk, blk, blk],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=_interpret(),
+    )(scal, two_d(p), two_d(g), two_d(m), two_d(v))
+    flat = lambda x: jnp.ravel(x)
+    cast = flat(out[3]) if cast_dtype is not None else None
+    return flat(out[0]), flat(out[1]), flat(out[2]), cast
+
+
+def sweep_pad():
+    """Flat-vector padding quantum the sweep kernel's blocking needs."""
+    return _SWEEP_PAD
+
+
+class AdamSweepState(NamedTuple):
+    """Whole-state sweep moments: ONE contiguous fp32 vector each, padded
+    to the kernel's block quantum — the layout that makes the optimizer
+    step a single pass instead of a per-leaf dispatch. ZeRO-1 shards the
+    flat vectors over the data axis with perfect balance."""
+    step: jnp.ndarray
+    mu: jnp.ndarray
+    nu: jnp.ndarray
+
+
+def fused_adam_sweep(b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.0,
+                     adam_w_mode=True, bias_correction=True,
+                     use_pallas=None):
+    """Adam as ONE whole-state sweep (config ``optimizer.params.sweep``:
+    true). The per-tensor Pallas :func:`fused_adam` measured SLOWER than
+    XLA's fused jnp chain because it dispatches one kernel per leaf;
+    this variant flattens params/grads/moments into single contiguous
+    vectors (``runtime/optim.flatten_tree``) and fuses global-norm clip
+    (``clip_coef`` from the engine's epilogue) + Adam into one pass.
+    ``fuses_clip`` is set so the engine skips its separate clip sweep
+    over the grad tree. The kernel's fused fp32 -> compute-dtype cast
+    output (:func:`adam_sweep_apply` ``cast_dtype=``) is NOT exposed
+    here: the ``Optimizer(init, update)`` contract has no consumer for
+    it — wiring it through the engine's forward means a TrainState /
+    custom_vjp refactor (PERF.md), and computing an output nothing
+    reads would be a wasted HBM write per step.
+
+    Parity: bit-identical moments/updates vs :func:`optim_lib.adam` up
+    to the association of the flatten (same fp32 chain, same constants);
+    pinned in tests/unit/test_fused_ops.py and engine-level at
+    fp32/bf16/fp16 in tests/unit/test_comm_overlap.py."""
+
+    def init(params):
+        vec, _ = optim_lib.flatten_tree(params, pad_to=_SWEEP_PAD)
+        zeros = jnp.zeros_like(vec, jnp.float32)
+        return AdamSweepState(step=jnp.zeros([], jnp.int32),
+                              mu=zeros, nu=zeros)
+
+    def update(grads, state, params, lr, clip_coef=None):
+        step = state.step + 1
+        if bias_correction:
+            bc1 = 1.0 - b1 ** step.astype(jnp.float32)
+            bc2 = 1.0 - b2 ** step.astype(jnp.float32)
+        else:
+            bc1 = bc2 = jnp.float32(1.0)
+        flat_g, spec = optim_lib.flatten_tree(grads, pad_to=_SWEEP_PAD)
+        # the params only feed weight decay; with it off, skip their
+        # whole flatten pass (the grads stand in as a never-read
+        # placeholder — DCE'd by XLA)
+        flat_p = (optim_lib.flatten_tree(params, pad_to=_SWEEP_PAD)[0]
+                  if weight_decay > 0.0 else flat_g)
+        cc = jnp.float32(1.0) if clip_coef is None else clip_coef
+        u, mu, nu, _ = adam_sweep_apply(
+            flat_p, flat_g, state.mu, state.nu, lr, bc1, bc2, cc,
+            b1=b1, b2=b2, eps=eps, weight_decay=weight_decay,
+            adam_w_mode=adam_w_mode, use_pallas=use_pallas)
+        updates = optim_lib.unflatten_tree(u, spec)
+        return updates, AdamSweepState(step=step, mu=mu, nu=nu)
+
+    return optim_lib.Optimizer(init, update, fuses_clip=True)
 
 
 class FusedAdam:

@@ -1,0 +1,227 @@
+"""Bucketed gradient-collective overlap (the ``comm_overlap`` block).
+
+PERF.md's round-5 ablation left multi-chip gradient reductions firing
+only at the step boundary: under plain GSPMD jit the partitioner emits
+one all-reduce per grad leaf (the NORTHSTAR gpt2-xl program carries 586
+of them) and the default scheduler parks them on the critical tail of
+the backward. This module is the reference's ZeRO bucketed
+``allreduce_bucket`` discipline (PAPER.md §2; Megatron-style grad
+bucketing in the scheduling literature) rebuilt for the shard_map world:
+
+* :func:`build_grad_bucket_spec` groups the grad leaves into
+  size-targeted buckets **in reverse tree order** — the vjp produces the
+  LAST layer's grads first, so the bucket holding layer N's grads is
+  complete while layer N-1's backward is still running;
+* :func:`bucketed_pmean` issues ONE ``lax.pmean`` per bucket (leaves
+  flattened into a contiguous vector) instead of one per leaf. Each
+  bucket's psum depends only on its own leaves, so the scheduler is
+  free to issue it as soon as the bucket's grads exist — with the
+  latency-hiding scheduler armed (:func:`overlap_xla_flags`) the
+  collectives become async ``-start``/``-done`` pairs hoisted into the
+  backward instead of a serialized tail;
+* the engine selects the bucketed value_and_grad variant
+  (``engine._make_overlap_vg``) BEFORE the first lower, like the health
+  stats variant, so a comm_overlap run still compiles exactly one
+  train-step program (guarded in ``tests/perf/telemetry_overhead.py``).
+
+Even without async collectives (CPU, older TPUs) the bucketing is a
+measured win by itself: B bucket-sized reductions replace hundreds of
+per-leaf dispatches (``tests/perf/overlap_bench.py`` /
+``OVERLAP_BENCH.json`` is the committed proof, with the PR-2 HLO census
+as the structural evidence — grad all-reduce count collapses to the
+bucket count, and the collective positions spread off the program
+tail).
+
+``XLA_FLAGS`` must be set at process start (PR-2 lesson:
+``clear_backends`` cannot re-read it), so the engine cannot arm the
+scheduler flags itself mid-process — launchers/benches prepend
+:func:`overlap_xla_flags` before importing jax; the engine logs the
+exact line once when it detects the flags missing on a TPU backend.
+"""
+
+from typing import NamedTuple, Tuple
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class GradBucketSpec(NamedTuple):
+    """Static assignment of grad-tree leaves to reduction buckets.
+
+    ``buckets[b]`` holds the ORIGINAL ``jax.tree.leaves`` indices of
+    bucket ``b``'s leaves, ordered so bucket 0 is the one the backward
+    finishes FIRST (reverse tree order). Built once at engine init from
+    the param tree's structure — the traced reduction is a fixed set of
+    per-bucket collectives, no dynamic shapes."""
+    buckets: Tuple[Tuple[int, ...], ...]
+    bucket_bytes: Tuple[int, ...]
+    n_leaves: int
+
+    @property
+    def n_buckets(self) -> int:
+        return len(self.buckets)
+
+
+def build_grad_bucket_spec(params, bucket_bytes: int) -> GradBucketSpec:
+    """Group the param/grad leaves into size-targeted reduction buckets.
+
+    Leaves are walked in REVERSE ``jax.tree.leaves`` order (backward
+    produces the deepest layers' grads first) and greedily packed until
+    a bucket reaches ``bucket_bytes``; the tail forms a remainder bucket.
+    A leaf larger than the target gets a bucket of its own (it is never
+    split — the collective is already one op). Float leaves may share a
+    bucket regardless of width (the flattened vector reduces in fp32 and
+    each leaf is cast back on split); non-float leaves never share.
+    ``params`` may be arrays or ShapeDtypeStructs — only shape/dtype are
+    read."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    bucket_bytes = max(1, int(bucket_bytes))
+    leaves = jax.tree.leaves(params)
+    if not leaves:
+        return GradBucketSpec((), (), 0)
+
+    def _is_float(dt):
+        # numpy's kind is "V" for ml_dtypes extended floats (bf16, fp8) —
+        # jnp.issubdtype sees through them
+        return dt.kind == "f" or jnp.issubdtype(dt, jnp.floating)
+
+    def leaf_bytes(x):
+        size = int(np.prod(x.shape)) if getattr(x, "shape", ()) else 1
+        # grads of floating params reduce in fp32 regardless of the
+        # master dtype (bucketed_pmean upcasts before the collective);
+        # non-float leaves keep their own itemsize
+        dt = np.dtype(getattr(x, "dtype", np.float32))
+        itemsize = 4 if _is_float(dt) else dt.itemsize
+        return size * itemsize
+
+    buckets, sizes = [], []
+    cur, cur_bytes = [], 0
+    for idx in range(len(leaves) - 1, -1, -1):
+        x = leaves[idx]
+        if not _is_float(np.dtype(getattr(x, "dtype", np.float32))):
+            # non-float leaves never share a bucket: the multi-leaf path
+            # flattens in fp32, which would corrupt them. Can't occur in
+            # a real grad tree (value_and_grad rejects integer params) —
+            # kept as a safe fallback for exotic specs.
+            if cur:
+                buckets.append(tuple(cur))
+                sizes.append(cur_bytes)
+                cur, cur_bytes = [], 0
+            buckets.append((idx,))
+            sizes.append(leaf_bytes(x))
+            continue
+        b = leaf_bytes(x)
+        if cur and cur_bytes + b > bucket_bytes:
+            buckets.append(tuple(cur))
+            sizes.append(cur_bytes)
+            cur, cur_bytes = [], 0
+        cur.append(idx)
+        cur_bytes += b
+    if cur:
+        buckets.append(tuple(cur))
+        sizes.append(cur_bytes)
+    return GradBucketSpec(tuple(buckets), tuple(sizes), len(leaves))
+
+
+def bucketed_pmean(spec: GradBucketSpec, grads, axis: str):
+    """Mean-reduce a grad pytree over ``axis`` with ONE collective per
+    bucket. Traced inside a ``shard_map`` body: each bucket's leaves are
+    flattened into one contiguous fp32 vector, ``lax.pmean``-ed, and
+    split back — a single-leaf bucket skips the flatten entirely (big
+    tensors that fill a bucket alone pay no copy). The reduction is
+    arithmetically the per-leaf ``pmean`` (sum over ranks / world), so
+    loss trajectories match the unbucketed path to float tolerance."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.runtime.optim import flatten_leaves
+    flat, treedef = jax.tree_util.tree_flatten(grads)
+    assert len(flat) == spec.n_leaves, (
+        f"bucket spec built for {spec.n_leaves} leaves but the grad tree "
+        f"has {len(flat)} — spec and tree diverged")
+    out: list = [None] * len(flat)
+    for idxs in spec.buckets:
+        if len(idxs) == 1:
+            # same fp32-reduction invariant as the multi-leaf path (and as
+            # build_grad_bucket_spec's 4 B/elem float accounting): upcast
+            # float leaves for the collective, cast back after
+            i = idxs[0]
+            leaf = flat[i]
+            if jnp.issubdtype(leaf.dtype, jnp.floating):
+                out[i] = jax.lax.pmean(
+                    leaf.astype(jnp.float32), axis).astype(leaf.dtype)
+            else:
+                out[i] = jax.lax.pmean(leaf, axis)
+            continue
+        vec = jax.lax.pmean(
+            flatten_leaves([flat[i] for i in idxs]), axis)
+        off = 0
+        for i in idxs:
+            n = flat[i].size
+            out[i] = vec[off:off + n].reshape(
+                flat[i].shape).astype(flat[i].dtype)
+            off += n
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+# Latency-hiding scheduler flag set (MaxText/AotC lineage): converts the
+# per-bucket sync collectives into async -start/-done pairs and lets the
+# scheduler hoist the starts into the backward. TPU-only spellings —
+# unknown --xla_tpu_* flags are a hard error on non-TPU backends, so the
+# helper gates on the backend.
+_TPU_OVERLAP_FLAGS = (
+    "--xla_tpu_enable_latency_hiding_scheduler=true",
+    "--xla_tpu_enable_async_collective_fusion=true",
+    "--xla_tpu_enable_async_collective_fusion_fuse_all_gather=true",
+    "--xla_tpu_enable_async_collective_fusion_multiple_steps=true",
+    "--xla_tpu_overlap_compute_collective_tc=true",
+)
+
+
+def overlap_xla_flags(backend: str = "tpu") -> Tuple[str, ...]:
+    """The XLA flag line that arms async-collective overlap on ``backend``
+    (empty on backends with no known spelling). Must be in ``XLA_FLAGS``
+    BEFORE jax initialises its backend — prepend at launch:
+
+        XLA_FLAGS="$(python -c 'from deepspeed_tpu.runtime.comm_overlap \
+import overlap_xla_flags; print(" ".join(overlap_xla_flags()))') \
+$XLA_FLAGS" python train.py
+    """
+    if backend == "tpu":
+        return _TPU_OVERLAP_FLAGS
+    return ()
+
+
+def check_scheduler_flags(backend: str) -> bool:
+    """True when the overlap flags are already armed for ``backend`` (or
+    the backend has none to arm). Pure env inspection — callable after
+    backend init, unlike setting the flags. Parses XLA_FLAGS into
+    name=value pairs: a flag explicitly set to ``false`` (or a merely
+    prefix-colliding name) must NOT count as armed — this is the one
+    diagnostic that catches a mis-armed TPU launch. All absl truthy
+    spellings count as armed: bare ``--flag``, ``=true``, ``=1``,
+    ``=t``, ``=yes`` (any case)."""
+    import os
+    want = overlap_xla_flags(backend)
+    if not want:
+        return True
+    truthy = {"", "true", "1", "t", "y", "yes"}
+    have = {}
+    for tok in os.environ.get("XLA_FLAGS", "").split():
+        name, _, value = tok.partition("=")
+        have[name] = value.lower() in truthy
+    return all(have.get(f.partition("=")[0], False) for f in want)
+
+
+def log_scheduler_flags_hint(backend: str) -> None:
+    """One engine-init line naming the exact flags a TPU launch should
+    set for the async-overlap half of comm_overlap (the bucketing half
+    works regardless)."""
+    if check_scheduler_flags(backend):
+        return
+    logger.info(
+        "[comm_overlap] latency-hiding scheduler flags are not set; the "
+        "per-bucket collectives stay synchronous (bucketing still "
+        "applies). Arm them at process start with XLA_FLAGS=\"%s\"",
+        " ".join(overlap_xla_flags(backend)))

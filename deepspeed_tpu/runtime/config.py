@@ -238,6 +238,38 @@ class DeepSpeedDataPrefetchConfig(DeepSpeedConfigObject):
                 f"data_prefetch.depth must be >= 1, got {self.depth}")
 
 
+class DeepSpeedCommOverlapConfig(DeepSpeedConfigObject):
+    """``comm_overlap`` block (runtime/comm_overlap.py): bucketed
+    gradient-collective overlap — the train step reduces gradients with
+    one psum per size-targeted bucket (issued as the backward produces
+    each bucket's grads) instead of one GSPMD all-reduce per grad leaf
+    at the step tail. The engine falls back (warn once) outside the
+    supported envelope: dp > 1, zero stage <= 1, mp/ep/pp == 1, dense
+    grads, default batch sharding.
+
+    Env override (sweep ergonomics): ``DS_COMM_OVERLAP`` = 1/0
+    force-toggles ``enabled`` after JSON parsing."""
+
+    def __init__(self, param_dict):
+        o = param_dict.get(C.COMM_OVERLAP, {}) or {}
+        self.enabled = o.get(C.COMM_OVERLAP_ENABLED,
+                             C.COMM_OVERLAP_ENABLED_DEFAULT)
+        self.bucket_mb = float(o.get(C.COMM_OVERLAP_BUCKET_MB,
+                                     C.COMM_OVERLAP_BUCKET_MB_DEFAULT))
+        self.scheduler_flags = o.get(C.COMM_OVERLAP_SCHEDULER_FLAGS,
+                                     C.COMM_OVERLAP_SCHEDULER_FLAGS_DEFAULT)
+        env = os.environ.get("DS_COMM_OVERLAP")
+        if env is not None:
+            self.enabled = env.lower() in ("1", "true", "yes", "on")
+        if self.bucket_mb <= 0:
+            raise DeepSpeedConfigError(
+                f"comm_overlap.bucket_mb must be > 0, got {self.bucket_mb}")
+
+    @property
+    def bucket_bytes(self):
+        return int(self.bucket_mb * (1 << 20))
+
+
 class DeepSpeedServingObservabilityConfig(DeepSpeedConfigObject):
     """``serving.observability`` sub-block
     (telemetry/serving_observatory.py): per-request lifecycle timelines
@@ -767,6 +799,7 @@ class DeepSpeedConfig:
         # is an eager-mode luxury; an EXPLICIT false is still honored.
         self.dataloader_drop_last = pd.get(C.DATALOADER_DROP_LAST, None)
         self.data_prefetch = DeepSpeedDataPrefetchConfig(pd)
+        self.comm_overlap = DeepSpeedCommOverlapConfig(pd)
         self.serving = DeepSpeedServingConfig(pd)
         self.autotuning = DeepSpeedAutotuningConfig(pd)
         self.autotuning_enabled = self.autotuning.enabled

@@ -374,9 +374,12 @@ DATALOADER_DROP_LAST_DEFAULT = False
 # data_prefetch: asynchronous input pipeline (runtime/prefetch.py).
 # When enabled, deepspeed_io-built loaders (and iterators handed to
 # train_batch) are wrapped in a bounded background pipeline: host worker
-# thread(s) pull + collate the next `depth` batches, and — single-process
-# runs only — a device stage issues _globalize_batch/device_put for batch
-# N+1 while step N computes, so the H2D copy overlaps device execution.
+# thread(s) pull + collate the next `depth` batches, and a device stage
+# issues _globalize_batch/device_put for batch N+1 while step N computes,
+# so the H2D copy overlaps device execution. The device stage runs on
+# multi-process meshes too: background placement is collective-free
+# (verify=False) and the cross-process verification collectives run on
+# the main thread at consumption.
 # `num_local_io_workers` (deepspeed_io argument) sets the host-stage
 # worker count. DS_DATA_PREFETCH=1/0 force-toggles `enabled`.
 DATA_PREFETCH = "data_prefetch"
@@ -386,6 +389,25 @@ DATA_PREFETCH_DEPTH = "depth"               # max batches in the pipeline
 DATA_PREFETCH_DEPTH_DEFAULT = 2
 DATA_PREFETCH_TO_DEVICE = "to_device"       # arm the device stage
 DATA_PREFETCH_TO_DEVICE_DEFAULT = True
+
+# comm_overlap: bucketed gradient-collective overlap
+# (runtime/comm_overlap.py). When enabled (and the config is in the
+# supported envelope: dp > 1, zero stage <= 1, mp/ep/pp == 1, dense
+# grads), the train step computes gradients under shard_map and reduces
+# them with ONE psum per size-targeted bucket — issued per-bucket as the
+# backward produces each bucket's grads — instead of GSPMD's one
+# all-reduce per grad leaf parked on the step tail. `bucket_mb` sets the
+# flattened bucket target; `scheduler_flags` logs the XLA latency-hiding
+# scheduler flag line when it is missing on a TPU backend (XLA_FLAGS is
+# read once at process start, so the engine cannot arm it itself).
+# DS_COMM_OVERLAP=1/0 force-toggles `enabled`.
+COMM_OVERLAP = "comm_overlap"
+COMM_OVERLAP_ENABLED = "enabled"
+COMM_OVERLAP_ENABLED_DEFAULT = False
+COMM_OVERLAP_BUCKET_MB = "bucket_mb"        # flattened bucket target, MiB
+COMM_OVERLAP_BUCKET_MB_DEFAULT = 4.0
+COMM_OVERLAP_SCHEDULER_FLAGS = "scheduler_flags"
+COMM_OVERLAP_SCHEDULER_FLAGS_DEFAULT = True
 
 # serving: continuous-batching inference server (serving/). Paged KV
 # cache of `block_size`-token blocks (`num_blocks` 0 -> sized so

@@ -369,6 +369,15 @@ class DeepSpeedEngine:
                 raise ValueError("sparse_gradients is incompatible with: "
                                  + "; ".join(bad))
 
+        # ---- comm overlap (runtime/comm_overlap.py) -----------------------
+        # bucketed gradient reduction: resolved in _build_step_fns (after
+        # the sparse mask can still fall back to dense) so the variant is
+        # selected BEFORE the first lower, like the health stats variant
+        self._comm_overlap_cfg = self.config.comm_overlap
+        self._comm_overlap_on = False
+        self._overlap_spec = None
+        self._warned_comm_overlap = False
+
         # ---- lr schedule (reference _configure_lr_scheduler, :790) --------
         self.lr_scheduler, self._lr_fn, self._base_lr = self._configure_lr_scheduler()
 
@@ -653,6 +662,13 @@ class DeepSpeedEngine:
         # "fused": use the Pallas kernel path (ops/adam, ops/lamb) instead
         # of the XLA-fused jnp update; both are bit-compatible.
         use_fused = params.pop("fused", False)
+        # "sweep": the whole-state flattened one-pass Adam (clip + update
+        # [+ cast] fused over contiguous state — ops/adam fused_adam_sweep)
+        use_sweep = params.pop("sweep", False)
+        if use_sweep and name not in (ADAM_OPTIMIZER, ADAMW_OPTIMIZER):
+            raise ValueError(
+                f"optimizer.params.sweep is the whole-state fused-Adam "
+                f"path; it does not apply to optimizer {name!r}")
 
         if name == ONEBIT_ADAM_OPTIMIZER:
             kw = dict(
@@ -700,6 +716,10 @@ class DeepSpeedEngine:
                       weight_decay=params.get("weight_decay", 0.0),
                       adam_w_mode=adam_w_mode,
                       bias_correction=params.get("bias_correction", True))
+            if use_sweep:
+                from deepspeed_tpu.ops.adam.fused_adam import \
+                    fused_adam_sweep
+                return fused_adam_sweep(**kw)
             if use_fused:
                 from deepspeed_tpu.ops.adam.fused_adam import fused_adam
                 return fused_adam(**kw)
@@ -1078,8 +1098,93 @@ class DeepSpeedEngine:
         return smap(body, in_specs=(P(), P(axis), P(), P(), P()),
                     out_specs=(P(), P()), **smap_kw)
 
+    def _resolve_comm_overlap(self):
+        """Arm the bucketed-reduction variant when the config asks for it
+        AND the engine is inside the supported envelope. Outside it the
+        engine falls back to the plain GSPMD reduction with ONE warning —
+        comm_overlap is a perf knob, not a semantic switch, so a config
+        that composes it with an unsupported feature should still train."""
+        cfg = self._comm_overlap_cfg
+        if not getattr(cfg, "enabled", False):
+            return False
+        # (_onebit_dist never reaches here: _build_step_fns routes that
+        # case to _build_onebit_step_fns with its own warning first)
+        bad = []
+        if self.dp_world_size < 2:
+            bad.append("data-parallel world size 1 (nothing to reduce)")
+        if self._sparse_grads:
+            bad.append("sparse_gradients (its shard_map owns the "
+                       "reduction)")
+        if self.zero_stage >= 2:
+            bad.append(f"zero stage {self.zero_stage} (grads live "
+                       "reduce-scattered; re-replicating them through a "
+                       "bucketed psum would undo the partitioning)")
+        if self.mp_world_size != 1:
+            bad.append("model parallelism (params sharded over the "
+                       "model axis; shard_map here maps the data axis "
+                       "with replicated params)")
+        if groups.get_expert_parallel_world_size() != 1:
+            bad.append("expert parallelism")
+        if groups.get_pipe_parallel_world_size() != 1:
+            bad.append("pipeline parallelism")
+        if self._batch_spec is not None:
+            bad.append("custom batch_spec (the batch dim must shard "
+                       "over the data axis)")
+        if bad:
+            if not self._warned_comm_overlap:
+                self._warned_comm_overlap = True
+                logger.warning(
+                    "comm_overlap is enabled but falls back to the plain "
+                    "GSPMD gradient reduction — incompatible with: "
+                    + "; ".join(bad))
+            return False
+        if getattr(cfg, "scheduler_flags", True):
+            from deepspeed_tpu.runtime.comm_overlap import \
+                log_scheduler_flags_hint
+            log_scheduler_flags_hint(jax.default_backend())
+        return True
+
+    def _make_overlap_vg(self):
+        """(params, batch, rng, theta, scale) -> (scaled_loss, grads) with
+        EXPLICIT bucketed DP reduction under shard_map: each rank computes
+        grads from its own batch shard and every size-targeted bucket is
+        mean-reduced by ONE psum, issued as soon as the backward has
+        produced that bucket's grads (reverse-layer bucket order —
+        runtime/comm_overlap.py). Arithmetically identical to the GSPMD
+        per-leaf pmean; structurally B collectives instead of one per
+        leaf, which is what the latency-hiding scheduler can overlap."""
+        import functools
+
+        from deepspeed_tpu.runtime.comm_overlap import bucketed_pmean
+        from deepspeed_tpu.utils.jax_compat import get_shard_map
+        shard_map, smap_kw = get_shard_map()
+        axis = groups.DATA_AXIS
+        spec = self._overlap_spec
+
+        def body(params, batch, rng, theta, scale):
+            rrng = jax.random.fold_in(rng, jax.lax.axis_index(axis))
+
+            def scaled_loss(p):
+                loss = self._compute_loss(p, batch, rrng, theta)
+                return loss * scale
+
+            sloss, grads = jax.value_and_grad(scaled_loss)(params)
+            grads = bucketed_pmean(spec, grads, axis)
+            return jax.lax.pmean(sloss, axis), grads
+
+        smap = functools.partial(shard_map, mesh=self.mesh)
+        return smap(body, in_specs=(P(), P(axis), P(), P(), P()),
+                    out_specs=(P(), P()), **smap_kw)
+
     def _build_step_fns(self):
         if self._onebit_dist:
+            if getattr(self._comm_overlap_cfg, "enabled", False) \
+                    and not self._warned_comm_overlap:
+                self._warned_comm_overlap = True
+                logger.warning(
+                    "comm_overlap has no effect with the compressed 1-bit "
+                    "optimizers (grads are rank-local by design); "
+                    "disabled for this engine")
             self._build_onebit_step_fns()
             return
         gas = self.gradient_accumulation_steps()
@@ -1106,8 +1211,27 @@ class DeepSpeedEngine:
             self._wire_health_monitor()
             hspec = self._health_spec
 
+        self._comm_overlap_on = self._resolve_comm_overlap()
+        if self._comm_overlap_on:
+            from deepspeed_tpu.runtime.comm_overlap import \
+                build_grad_bucket_spec
+            self._overlap_spec = build_grad_bucket_spec(
+                self.state.params, self._comm_overlap_cfg.bucket_bytes)
+            log_dist(
+                f"comm_overlap: {self._overlap_spec.n_leaves} grad "
+                f"leaves -> {self._overlap_spec.n_buckets} reduction "
+                f"buckets (target "
+                f"{self._comm_overlap_cfg.bucket_mb:g} MiB)", ranks=[0])
+            if self.telemetry.enabled:
+                self.telemetry.registry.gauge(
+                    "comm_overlap_buckets",
+                    "gradient reduction buckets per step").set(
+                        self._overlap_spec.n_buckets)
+
         if self._sparse_grads:
             value_and_grad = self._make_sparse_vg()
+        elif self._comm_overlap_on:
+            value_and_grad = self._make_overlap_vg()
         else:
             def value_and_grad(params, batch, rng, theta, scale):
                 def scaled_loss(p):
@@ -1135,14 +1259,24 @@ class DeepSpeedEngine:
         need_norm = bool(cfg.fp16_enabled or cfg.gradient_clipping > 0
                          or health)
         self._need_norm = need_norm
+        # whole-state sweep optimizer: the global-norm clip rides INSIDE
+        # its one fused pass (update(clip_coef=...)), so the epilogue must
+        # not also scale the grad tree — a separate full read+write of it.
+        # The offloaded step applies its update host-side and never sees
+        # clip_coef, so there the epilogue clip stays.
+        fuse_clip = (bool(getattr(self.optimizer, "fuses_clip", False))
+                     and not self._offload)
 
         def grad_epilogue(state, grads):
             """Shared end-of-accumulation math on an UNSCALED-pending grad
             tree: unscale, overflow check, norm + clip, scale-state update.
-            Returns (state-with-new-scale, grads, grad_norm, finite, aux);
-            ``aux`` holds the health bucket stats (empty dict when off) —
-            computed on the unscaled PRE-clip grads, so a clip cannot mask
-            an explosion and the provenance bitmask sees the raw values."""
+            Returns (state-with-new-scale, grads, grad_norm, finite,
+            clip_coef, aux); ``aux`` holds the health bucket stats (empty
+            dict when off) — computed on the unscaled PRE-clip grads, so a
+            clip cannot mask an explosion and the provenance bitmask sees
+            the raw values. ``clip_coef`` is the torch-semantics global
+            clip coefficient (1.0 when clipping is off); a clip-fusing
+            sweep optimizer consumes it instead of the tree-map below."""
             inv_scale = 1.0 / state.scale.loss_scale
             grads = jax.tree.map(lambda g: g * inv_scale, grads)
             finite = jnp.array(True)
@@ -1155,9 +1289,15 @@ class DeepSpeedEngine:
             if health:
                 norms, mask = bucket_grad_stats(hspec, grads)
                 aux = {"bucket_norms": norms, "nonfinite_mask": mask}
+            clip_coef = jnp.float32(1.0)
             if cfg.gradient_clipping > 0:
-                grads, _ = optim_lib.clip_by_global_norm(
-                    grads, cfg.gradient_clipping)
+                # same coefficient clip_by_global_norm computes (the norm
+                # is the grad_norm above — XLA CSEs the reduction)
+                clip_coef = jnp.minimum(
+                    cfg.gradient_clipping / (grad_norm + 1e-6),
+                    jnp.float32(1.0))
+                if not fuse_clip:
+                    grads = jax.tree.map(lambda g: g * clip_coef, grads)
             new_scale = update_scale(
                 state.scale, ~finite,
                 dynamic=self._dynamic_scale,
@@ -1165,7 +1305,7 @@ class DeepSpeedEngine:
                 min_scale=cfg.fp16.min_loss_scale,
                 delayed_shift=cfg.fp16.hysteresis)
             return (state._replace(scale=new_scale), grads, grad_norm,
-                    finite, aux)
+                    finite, clip_coef, aux)
 
         def grad_prologue(state):
             """grad_epilogue over the accumulation buffer, which it resets."""
@@ -1174,15 +1314,21 @@ class DeepSpeedEngine:
             zeros = jax.tree.map(jnp.zeros_like, state.acc_grads)
             return grad_epilogue(state._replace(acc_grads=zeros), acc)
 
-        def optimizer_update(state, grads, finite):
+        def optimizer_update(state, grads, finite, clip_coef):
             """Returns (state, update_norm); the norm is a constant 0 when
-            health is off (dead output, DCE'd by XLA)."""
+            health is off (dead output, DCE'd by XLA). ``clip_coef`` only
+            reaches a clip-fusing sweep optimizer — everyone else already
+            received clipped grads from the epilogue."""
             lr = self._lr_fn_traced(state.step)
 
             def do_update(operand):
-                st, g = operand
-                updates, new_opt = self.optimizer.update(
-                    g, st.opt_state, st.params, lr)
+                st, g, cc = operand
+                if fuse_clip:
+                    updates, new_opt = self.optimizer.update(
+                        g, st.opt_state, st.params, lr, clip_coef=cc)
+                else:
+                    updates, new_opt = self.optimizer.update(
+                        g, st.opt_state, st.params, lr)
                 new_params = jax.tree.map(jnp.add, st.params, updates)
                 un = (optim_lib.global_norm(updates) if health
                       else jnp.float32(0.0))
@@ -1190,11 +1336,11 @@ class DeepSpeedEngine:
                                    opt_state=new_opt), un
 
             def skip_update(operand):
-                st, _ = operand
+                st, _, _ = operand
                 return st, jnp.float32(0.0)
 
             return jax.lax.cond(finite, do_update, skip_update,
-                                (state, grads))
+                                (state, grads, clip_coef))
 
         def pack_stats(state, grad_norm, finite, upd_norm, aux):
             """The static-shaped in-step stats pytree (health only). The
@@ -1216,8 +1362,10 @@ class DeepSpeedEngine:
             }
 
         def apply_step(state):
-            state, grads, grad_norm, finite, aux = grad_prologue(state)
-            state, upd_norm = optimizer_update(state, grads, finite)
+            (state, grads, grad_norm, finite, clip_coef,
+             aux) = grad_prologue(state)
+            state, upd_norm = optimizer_update(state, grads, finite,
+                                               clip_coef)
             if health:
                 return (state, grad_norm, ~finite,
                         pack_stats(state, grad_norm, finite, upd_norm, aux))
@@ -1235,8 +1383,10 @@ class DeepSpeedEngine:
                 state.scale.loss_scale)
             grads = self._grad_constraint(grads)
             loss = sloss / state.scale.loss_scale
-            state, grads, grad_norm, finite, aux = grad_epilogue(state, grads)
-            state, upd_norm = optimizer_update(state, grads, finite)
+            (state, grads, grad_norm, finite, clip_coef,
+             aux) = grad_epilogue(state, grads)
+            state, upd_norm = optimizer_update(state, grads, finite,
+                                               clip_coef)
             if health:
                 return (state, loss, grad_norm, ~finite,
                         pack_stats(state, grad_norm, finite, upd_norm, aux))
@@ -1244,8 +1394,9 @@ class DeepSpeedEngine:
 
         def offload_pre_step(state):
             """Device half of the offloaded step: the shared prologue —
-            grads go to the host CPU-Adam; params unchanged."""
-            state, grads, grad_norm, finite, _ = grad_prologue(state)
+            grads go to the host CPU-Adam; params unchanged. fuse_clip is
+            forced off under offload, so the grads here are clipped."""
+            state, grads, grad_norm, finite, _, _ = grad_prologue(state)
             return state, grads, grad_norm, ~finite
 
         sh = self.state_shardings
@@ -1823,8 +1974,18 @@ class DeepSpeedEngine:
             self._health_last_loss = loss   # device ref, no sync
         return loss
 
-    def _globalize_batch(self, batch, for_train=True):
+    def _globalize_batch(self, batch, for_train=True, verify=True):
         """Place the host batch onto the mesh as the GLOBAL batch.
+
+        ``verify=False`` is the background-thread (prefetch device
+        stage) contract: placement itself is collective-free — the
+        cross-process verification collectives (broadcast-leaf checksum
+        allgather, eval row-count agreement) are DEFERRED to
+        ``_verify_prefetched_batch`` on the main thread at consumption.
+        A background-thread collective racing main-thread collectives is
+        a deadlock, which is why PR 5 disabled the device stage on
+        multi-process runs; splitting verification out of the placement
+        path is what lifted that restriction.
 
         A scalar, or a dim0==1 leaf in a batch whose OTHER leaves carry
         real rows (a [1,S] broadcast mask, a shared table), is NOT a
@@ -1870,24 +2031,42 @@ class DeepSpeedEngine:
             return (_np.shape(x)[0] == 1 and not all_single_row
                     and (expect != 1 or not for_train))
 
-        if (for_train and (self._onebit_dist or self._sparse_grads)
+        if (for_train and (self._onebit_dist or self._sparse_grads
+                           or self._comm_overlap_on)
                 and any(_is_broadcast(x) and _np.ndim(x) > 0
                         for x in jax.tree.leaves(batch))):
-            # the 1-bit / sparse-grad TRAIN step fns shard_map the whole
-            # batch tree with in_specs=P(data) — a dim0==1 leaf fails
-            # divisibility there with an opaque trace error, so reject
-            # it loudly here (eval_batch jits without shard_map and
-            # handles replicated leaves fine)
+            # the 1-bit / sparse-grad / comm-overlap TRAIN step fns
+            # shard_map the whole batch tree with in_specs=P(data) — a
+            # dim0==1 leaf fails divisibility there with an opaque trace
+            # error, so reject it loudly here (eval_batch jits without
+            # shard_map and handles replicated leaves fine)
             raise NotImplementedError(
                 "broadcast batch leaves (leading dim 1) are not supported "
-                "with 1-bit optimizers or sparse_gradients: their step "
-                "functions shard the whole batch over the data axis; "
-                "give the leaf the batch's leading dimension")
+                "with 1-bit optimizers, sparse_gradients or comm_overlap: "
+                "their step functions shard the whole batch over the data "
+                "axis; give the leaf the batch's leading dimension")
         shardings = jax.tree.map(
             lambda x, sh: repl if _is_broadcast(x) else sh,
             batch, shardings)
         if n_proc == 1:
             return jax.device_put(batch, shardings)
+        # A batch the prefetch device stage already placed arrives as
+        # GLOBAL (non-fully-addressable) arrays — re-running placement
+        # would np.asarray them, which raises. Run the deferred
+        # cross-process verification the background thread skipped
+        # (verify=False placement) and hand the same buffers back.
+        batch_leaves = jax.tree.leaves(batch)
+        if batch_leaves and all(
+                isinstance(x, jax.Array) and not x.is_fully_addressable
+                for x in batch_leaves):
+            # verify=False is the background thread (a user loader can
+            # yield pre-placed global arrays straight into the device
+            # stage): the verification collectives stay deferred to the
+            # main-thread re-globalize at consumption, which lands in
+            # this same branch with verify=True
+            if verify:
+                self._verify_prefetched_batch(batch, for_train=for_train)
+            return batch
         # Validate the WHOLE tree before any placement or collective so a
         # uniform loader bug raises on every rank instead of deadlocking
         # a later collective (rank-DIVERGENT tree shapes can still hang —
@@ -1914,11 +2093,13 @@ class DeepSpeedEngine:
                     f"exactly {expect} per process (deepspeed_io slices "
                     f"evenly; feed each rank its own equal slice; "
                     f"broadcast leaves must have leading dim 1)")
-            if not for_train:
+            if not for_train and verify:
                 # eval batches are not bound to the train micro-batch
                 # geometry, but ranks must still agree on the row count —
                 # a mismatch would compile divergent programs and hang
-                # at the next collective instead of raising
+                # at the next collective instead of raising. verify=False
+                # (background placement) defers this agreement check to
+                # _verify_prefetched_batch on the main thread.
                 from jax.experimental import multihost_utils
                 all_rows = _np.asarray(multihost_utils.process_allgather(
                     _np.asarray([rows], _np.int64)))
@@ -1929,13 +2110,17 @@ class DeepSpeedEngine:
                         f" — every rank must feed an equal slice")
 
         def _place(path, x, sh):
-            if _is_broadcast(x):
+            if _is_broadcast(x) and verify:
                 # make_array_from_process_local_data does not cross-check
                 # replicated content, so a mis-sliced loader feeding each
                 # rank a different single row would silently diverge —
                 # checksum-verify the first time each leaf path is seen
                 # (steady-state cost zero; content drift after the first
-                # batch is the cross-rank-assert debug tier's job)
+                # batch is the cross-rank-assert debug tier's job).
+                # verify=False (background placement) defers the checksum
+                # to _verify_prefetched_batch on the main thread — the
+                # allgather is a collective and this may be a background
+                # thread.
                 key = (tuple(str(p) for p in path), _np.shape(x),
                        str(_np.asarray(x).dtype))
                 if key not in self._broadcast_leaves_checked:
@@ -1964,6 +2149,49 @@ class DeepSpeedEngine:
                 "process must feed the identical array; if this leaf is "
                 "really a per-process batch slice, give it the batch's "
                 "leading dimension")
+
+    def _verify_prefetched_batch(self, batch, for_train=True):
+        """Main-thread half of the split placement: the cross-process
+        verification collectives a ``verify=False`` (background-thread)
+        placement deferred — the broadcast-leaf checksum allgather and,
+        for eval routes, the row-count agreement check. Runs at
+        consumption, BEFORE the batch is dispatched, keyed by the same
+        first-occurrence sets the direct placement path uses (steady
+        state cost: one set lookup per leaf)."""
+        import numpy as _np
+        eval_rows = []
+        for path, x in jax.tree_util.tree_flatten_with_path(batch)[0]:
+            sh = getattr(x, "sharding", None)
+            shape = tuple(getattr(x, "shape", ()))
+            if sh is not None and getattr(sh, "is_fully_replicated", False):
+                key = (tuple(str(p) for p in path), shape, str(x.dtype))
+                if key in self._broadcast_leaves_checked:
+                    continue
+                self._broadcast_leaves_checked.add(key)
+                # the local copy of the replicated leaf: this process's
+                # own contribution, exactly what placement checksummed
+                self._assert_identical_across_processes(
+                    _np.asarray(x.addressable_data(0)))
+            elif not for_train and shape:
+                eval_rows.append(int(shape[0]))
+        if eval_rows:
+            # UNCONDITIONAL per batch, like the direct placement path's
+            # row check: caching this by shape would make the allgather
+            # call COUNT diverge across ranks exactly when shapes
+            # diverge — the silent-deadlock case the check exists to
+            # turn into a clean raise. ONE vector allgather for all
+            # leaves (not one per leaf): the per-leaf version taxed
+            # every steady-state eval batch L serial round-trips.
+            from jax.experimental import multihost_utils
+            all_rows = _np.asarray(multihost_utils.process_allgather(
+                _np.asarray(eval_rows, _np.int64)))
+            if not (all_rows == all_rows.reshape(
+                    -1, len(eval_rows))[0]).all():
+                raise ValueError(
+                    f"eval batch shapes disagree across processes "
+                    f"after background placement: global row counts "
+                    f"{sorted(set(all_rows.ravel().tolist()))} — "
+                    f"every rank must feed an equal slice")
 
     def backward(self, loss=None, allreduce_gradients=True, release_loss=False):
         """Bookkeeping half of the fused forward/backward (see ``forward``)."""
@@ -2351,7 +2579,11 @@ class DeepSpeedEngine:
                     f"collates synchronously on the consumer thread. "
                     f"Enable the 'data_prefetch' config block (or set "
                     f"DS_DATA_PREFETCH=1) to run the input pipeline in "
-                    f"the background with that worker count.")
+                    f"the background with that worker count — host "
+                    f"collate workers plus the device double-buffering "
+                    f"stage, which runs on multi-process meshes too "
+                    f"(placement is collective-free; verification stays "
+                    f"on the main thread).")
             return loader
         wrapped = PrefetchLoader(
             loader, depth=self._prefetch_cfg.depth,
@@ -2363,35 +2595,27 @@ class DeepSpeedEngine:
 
     def _prefetch_place_fn(self, for_train=True):
         """The prefetch device stage's placement fn — ``_globalize_batch``
-        on a background thread — or None when the stage must stay off:
+        with ``verify=False`` on a background thread — or None when the
+        stage must stay off (curriculum learning: the scheduled per-step
+        truncation happens on the HOST batch after ``next()`` —
+        pre-placing would pin the full-length batch and defeat the
+        plateau compile; warns once).
 
-        * multi-process: ``_globalize_batch`` performs cross-process work
-          (broadcast-leaf checksum allgather); a background-thread
-          collective racing the main thread's collectives is a deadlock,
-          so prefetch stays host-side (collate only) and the main thread
-          does placement;
-        * curriculum learning: the scheduled per-step truncation happens
-          on the HOST batch after ``next()`` — pre-placing would pin the
-          full-length batch and defeat the plateau compile.
+        Multi-process runs ARE supported: ``verify=False`` placement is
+        collective-free by construction (the broadcast-leaf checksum
+        allgather and eval row-count agreement are deferred to
+        ``_verify_prefetched_batch`` on the main thread at consumption),
+        so the background thread can never race a main-thread collective
+        — the deadlock that made PR 5 disable the stage is structurally
+        impossible now.
 
         ``for_train`` follows the loader's route: an eval-route loader
         must place with eval semantics (replicated dim0==1 leaves, no
         train-only broadcast rejection) or the background placement
-        would diverge from what ``eval_batch`` does on the main thread.
-
-        Never a silent behavior change: each disable path warns once."""
+        would diverge from what ``eval_batch`` does on the main thread."""
+        import functools
         pf = self._prefetch_cfg
         if not pf.to_device:
-            return None
-        if jax.process_count() > 1:
-            if not self._warned_prefetch_host_only:
-                self._warned_prefetch_host_only = True
-                logger.warning(
-                    "data_prefetch: device stage disabled on this "
-                    "multi-process run (batch placement verifies "
-                    "broadcast leaves with a cross-process collective, "
-                    "which must run on the main thread); host-side "
-                    "prefetch stays on")
             return None
         if self.curriculum_scheduler is not None:
             if not self._warned_prefetch_host_only:
@@ -2403,8 +2627,9 @@ class DeepSpeedEngine:
                     "prefetch stays on")
             return None
         if for_train:
-            return self._globalize_batch
-        return lambda b: self._globalize_batch(b, for_train=False)
+            return functools.partial(self._globalize_batch, verify=False)
+        return functools.partial(self._globalize_batch, for_train=False,
+                                 verify=False)
 
     def _maybe_prefetch_iter(self, data_iter):
         """Wrap a user-supplied ``train_batch`` iterator in the prefetch

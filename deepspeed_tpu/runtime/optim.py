@@ -29,6 +29,11 @@ import jax.numpy as jnp
 class Optimizer(NamedTuple):
     init: Callable[[Any], Any]
     update: Callable[..., Any]  # (grads, state, params, lr) -> (updates, state)
+    # fuses_clip: the optimizer applies the global-norm clip INSIDE its
+    # own sweep — update() accepts clip_coef= and the engine skips the
+    # separate clip pass over the grad tree (one fewer full HBM read+
+    # write). Only the whole-state sweep variants set this.
+    fuses_clip: bool = False
 
 
 class AdamState(NamedTuple):
@@ -180,6 +185,74 @@ def adagrad(eps=1e-8, weight_decay=0.0, initial_accumulator_value=0.0):
     return Optimizer(init, update)
 
 
+class FlatTreeSpec(NamedTuple):
+    """Static recipe to rebuild a pytree from one flat vector: treedef +
+    per-leaf shapes/dtypes (python data — baked into the trace, never a
+    traced value). ``n`` is the unpadded element count; ``n_pad`` the
+    padded vector length the spec was built with."""
+    treedef: Any
+    shapes: tuple
+    dtypes: tuple
+    n: int
+    n_pad: int
+
+
+def flatten_leaves(leaves, n_pad=None, dtype=jnp.float32):
+    """One contiguous ``dtype`` vector holding ``leaves`` back to back
+    (tail zero-padded to ``n_pad`` when given), assembled with
+    ``dynamic_update_slice`` writes into a preallocated buffer — NOT
+    ``concatenate``-of-ravels, which XLA CPU lowers to a pathological
+    element loop (measured 225 ms vs 18 ms for the same 37 MB on the
+    bench host). Shared by :func:`flatten_tree` and the comm-overlap
+    bucket assembly (runtime/comm_overlap.bucketed_pmean)."""
+    n = sum(x.size for x in leaves)
+    n_pad = n if n_pad is None else n_pad
+    vec = jnp.zeros((n_pad,), dtype)
+    off = 0
+    for x in leaves:
+        vec = jax.lax.dynamic_update_slice(
+            vec, jnp.ravel(x).astype(dtype), (off,))
+        off += x.size
+    return vec
+
+
+def flatten_tree(tree, pad_to=1, dtype=jnp.float32):
+    """Flatten a pytree into ONE contiguous ``dtype`` vector (padded to a
+    multiple of ``pad_to``) + the :class:`FlatTreeSpec` to undo it.
+
+    The shim behind the whole-state sweep optimizers (ops/adam
+    ``fused_adam_sweep``): the per-leaf fused Adam lost to XLA as a
+    per-bucket dispatch — one kernel launch per tensor — and a single
+    flattened sweep turns the whole optimizer step into ONE pass over
+    contiguous state."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    assert leaves, "flatten_tree: empty pytree"
+    n = sum(x.size for x in leaves)
+    pad_to = max(1, int(pad_to))
+    n_pad = -(-n // pad_to) * pad_to
+    vec = flatten_leaves(leaves, n_pad=n_pad, dtype=dtype)
+    spec = FlatTreeSpec(
+        treedef=treedef,
+        shapes=tuple(tuple(x.shape) for x in leaves),
+        dtypes=tuple(x.dtype for x in leaves),
+        n=n, n_pad=n_pad)
+    return vec, spec
+
+
+def unflatten_tree(vec, spec: FlatTreeSpec):
+    """Rebuild the pytree from a (padded) flat vector produced against
+    the same tree structure; each leaf is cast back to its own dtype."""
+    assert vec.shape == (spec.n_pad,), (
+        f"unflatten_tree: vector shape {vec.shape} != spec ({spec.n_pad},)")
+    out, off = [], 0
+    import numpy as _np
+    for shape, dt in zip(spec.shapes, spec.dtypes):
+        size = int(_np.prod(shape)) if shape else 1
+        out.append(vec[off:off + size].reshape(shape).astype(dt))
+        off += size
+    return jax.tree_util.tree_unflatten(spec.treedef, out)
+
+
 def global_norm(tree):
     """Global L2 norm over a pytree (reference runtime/utils.py
     get_global_norm / clip_grad_norm_). Under pjit the per-shard partial
@@ -195,3 +268,18 @@ def clip_by_global_norm(grads, max_norm):
     norm = global_norm(grads)
     clip_coef = jnp.minimum(max_norm / (norm + 1e-6), 1.0)
     return jax.tree.map(lambda g: g * clip_coef, grads), norm
+
+
+def clipped_update(opt, grads, state, params, lr, max_norm=1.0):
+    """Global-norm clip + optimizer update composed the way the engine's
+    grad_epilogue composes them: the torch-semantics clip coefficient is
+    handed to a clip-fusing optimizer via ``update(clip_coef=)``, else
+    applied as a grad-tree pre-scale. Shared by the optimizer
+    microbenches (bench.py, tests/perf/overlap_bench.py) so they measure
+    exactly the composition the engine runs and cannot drift from it."""
+    norm = global_norm(grads)
+    clip_coef = jnp.minimum(max_norm / (norm + 1e-6), 1.0)
+    if getattr(opt, "fuses_clip", False):
+        return opt.update(grads, state, params, lr, clip_coef=clip_coef)
+    grads = jax.tree.map(lambda g: g * clip_coef, grads)
+    return opt.update(grads, state, params, lr)

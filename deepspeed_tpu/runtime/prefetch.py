@@ -22,12 +22,13 @@ worker-backed dataloaders):
   is the SAME pytree with device-placed global leaves — not a wrapper —
   so user code that inspects batches keeps working, and the engine's own
   ``device_put`` against the identical sharding is a no-transfer no-op
-  (verified same-buffer in jax 0.4.37). The stage MUST NOT run when
-  placement performs cross-process work (multi-process
-  ``_globalize_batch`` does a broadcast-leaf checksum allgather — a
-  background-thread collective against main-thread collectives is a
-  deadlock); the engine only passes ``place_fn`` when placement is
-  process-local.
+  (verified same-buffer in jax 0.4.37). The stage runs on multi-process
+  meshes too: the engine passes ``verify=False`` placement, which is
+  collective-free by construction — the broadcast-leaf checksum
+  allgather and eval row-count agreement are deferred to the MAIN thread
+  at consumption (``engine._verify_prefetched_batch``), so a
+  background-thread collective can never race a main-thread one (the
+  deadlock that made PR 5 restrict the stage to single-process runs).
 
 Hard edges handled here, all unit-pinned (``tests/unit/test_prefetch.py``):
 

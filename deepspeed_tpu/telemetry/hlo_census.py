@@ -414,6 +414,45 @@ def census_compiled(compiled, mesh=None) -> HloCensus:
     return census
 
 
+def collective_schedule_positions(hlo_text: str) -> List[Dict[str, Any]]:
+    """Normalized instruction positions of the collectives inside the
+    ENTRY computation — the tail-clustering evidence for comm overlap.
+
+    Each collective (``-done`` halves skipped, as everywhere in this
+    module) is reported as ``{"kind", "pos"}`` with ``pos`` = its index
+    over the entry computation's instruction count, in [0, 1]. A program
+    whose gradient reductions are serialized behind the whole backward
+    shows them clustered near 1.0; per-bucket reductions issued as the
+    backward produces each bucket spread across the stream. The dump
+    order is the dependency/schedule order XLA prints post-optimization
+    — structural evidence, not a measured timeline (the measured half is
+    the off/on step time next to it in ``OVERLAP_BENCH.json``)."""
+    lines = hlo_text.splitlines()
+    entry, depth = [], 0
+    in_entry = False
+    for line in lines:
+        if not in_entry and line.lstrip().startswith("ENTRY "):
+            in_entry = True
+            depth = line.count("{") - line.count("}")
+            continue
+        if not in_entry:
+            continue
+        depth += line.count("{") - line.count("}")
+        if "=" in line:
+            entry.append(line)
+        if depth <= 0:
+            break
+    total = len(entry)
+    out: List[Dict[str, Any]] = []
+    for i, line in enumerate(entry):
+        m = _COLLECTIVE_LINE_RE.match(line)
+        if not m or m.group(3) == "-done":
+            continue
+        out.append({"kind": m.group(2) + (m.group(3) or ""),
+                    "pos": round(i / max(total - 1, 1), 4)})
+    return out
+
+
 def census_fn(fn, *args, mesh=None, static_argnums=()) -> HloCensus:
     """Compile-from-scratch fallback: jit + lower + compile ``fn(*args)``
     and census the artifact. This PAYS ONE XLA COMPILE — callers holding

@@ -1,0 +1,364 @@
+"""Gradient-collective overlap + one-sweep optimizer proof: OVERLAP_BENCH.json.
+
+Runs the SAME deep-narrow GPT-2 (many grad leaves — the regime where the
+NORTHSTAR gpt2-xl program carries 586 per-leaf all-reduces) through the
+full engine twice — ``comm_overlap`` off, then on — and records:
+
+* **measured (this host)**: per-step wall time off/on, and the PR-2 HLO
+  census of each compiled train step: the per-leaf grad all-reduces must
+  COLLAPSE to one per bucket, the bucket result bytes must match the
+  ``build_grad_bucket_spec`` attribution, and the bucketed collectives
+  must sit spread through the instruction stream (not tail-clustered);
+* **measured (this host)**: the optimizer sweep A/B at a ~9.5M-param /
+  144-leaf state — unfused per-leaf Adam + separate clip vs the
+  whole-state ``fused_adam_sweep`` — plus the microbench rows that
+  explain the result (XLA CPU runs ONE fused loop over a contiguous
+  buffer at measurably lower bandwidth than the same math as per-leaf
+  loops, and lowers concatenate-of-reshapes to a pathological element
+  loop — the reason flatten_tree uses dynamic_update_slice);
+* **projected (labeled, from committed artifacts + the PR-2 chip
+  table)**: the multichip overlap claim itself. This host has ONE core
+  and no interconnect — virtual-device collectives are memcpys, so
+  overlap cannot be *executed* here (the same honesty envelope as the
+  layered-offload bench's TRANSFER-BOUND artifact). The projection reads
+  the committed NORTHSTAR gpt2-xl census (586 all-reduces, measured wire
+  bytes, XLA flop count) and a declared per-collective launch latency,
+  and compares the tail-serialized exposure against per-layer buckets
+  overlapped behind the backward (the latency-hiding scheduler flag set
+  in runtime/comm_overlap.py).
+
+REFUSES to write a regen where the measured on-path taxes the step (>10%),
+the census shows no collective collapse, the bucketed collectives are
+tail-clustered, the projection shows no win, or the optimizer measurement
+is internally inconsistent (sweep loses while the microbench shows no
+flat-loop bandwidth deficit to explain it).
+
+Regenerate with:  python tests/perf/overlap_bench.py
+(not collected by pytest — no test_ prefix, like the other perf scripts;
+the artifact's schema + floors are pinned by tests/unit/test_artifacts.py)
+"""
+
+import json
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+SCHEMA = "deepspeed_tpu.overlap_bench/1"
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+
+# deep-narrow: 12 x 32 keeps compute small against ~150 grad leaves
+N_LAYER, N_EMBD, SEQ, BS = 12, 32, 64, 8
+BUCKET_MB = 0.25
+STEPS, ROUNDS = 10, 5
+
+# optimizer A/B scale: ~9.5M params over 144 leaves (a gpt2-class leaf
+# census at reduced width)
+OPT_LAYERS = 12
+
+# ---- projection constants (declared, labeled in the artifact) ----------
+ALPHA_US = 8.0          # per-collective launch + rendezvous latency
+BACKWARD_FRAC = 2 / 3   # share of compute the backward occupies
+MFU = 0.5               # headline MFU (PERF.md round 5)
+V5E_PEAK_TFLOPS, V5E_HBM_GBPS, V5E_ICI_GBPS = 197.0, 819.0, 400.0
+PROJ_BUCKETS = 48       # one bucket per NORTHSTAR layer
+
+
+def _train_run(overlap):
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import (GPT2Config, GPT2LMHeadModel,
+                                           synthetic_batch)
+    from deepspeed_tpu.telemetry.hlo_census import \
+        collective_schedule_positions
+    from deepspeed_tpu.utils import groups
+    groups.destroy()
+    groups.initialize()
+    cfg = GPT2Config(vocab_size=512, n_positions=SEQ, n_embd=N_EMBD,
+                     n_layer=N_LAYER, n_head=4)
+    batch = synthetic_batch(BS, SEQ, cfg.vocab_size)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=GPT2LMHeadModel(cfg),
+        config={"train_batch_size": BS, "steps_per_print": 10 ** 9,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "comm_overlap": {"enabled": overlap,
+                                 "bucket_mb": BUCKET_MB},
+                "telemetry": {"enabled": True, "trace": False,
+                              "jsonl": False, "prometheus": False,
+                              "cost_explorer": {"enabled": True}}},
+        sample_batch=batch, seed=42)
+    for _ in range(3):
+        engine.train_batch(batch=batch)
+    jax.device_get(engine.state.step)
+    rounds = []
+    for _ in range(ROUNDS):
+        t0 = time.perf_counter()
+        for _ in range(STEPS):
+            engine.train_batch(batch=batch)
+        jax.device_get(engine.state.step)
+        rounds.append((time.perf_counter() - t0) / STEPS * 1e3)
+    census = engine.get_cost_census()
+    aot = engine._aot_step_for("fused_train_step")
+    pos = [p for p in collective_schedule_positions(aot.compiled.as_text())
+           if p["kind"].startswith("all-reduce")]
+    ar_ops = [op for op in census.collectives if op.kind == "all-reduce"]
+    out = {
+        "per_step_ms": round(float(np.median(rounds)), 2),
+        "round_step_ms": [round(r, 1) for r in rounds],
+        "all_reduce_ops": len(ar_ops),
+        "all_reduce_result_bytes": sorted(
+            (op.result_bytes for op in ar_ops), reverse=True),
+        "all_reduce_wire_bytes": census.collective_wire_bytes.get(
+            "all-reduce", 0),
+        "collective_positions": {
+            "first": min((p["pos"] for p in pos), default=None),
+            "last": max((p["pos"] for p in pos), default=None),
+            "n": len(pos),
+        },
+    }
+    if overlap:
+        spec = engine._overlap_spec
+        out["grad_leaves"] = spec.n_leaves
+        out["buckets"] = spec.n_buckets
+        out["bucket_bytes"] = sorted(spec.bucket_bytes, reverse=True)
+    engine.close()
+    return out
+
+
+def _optimizer_bench():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deepspeed_tpu.ops.adam.fused_adam import fused_adam_sweep
+    from deepspeed_tpu.runtime import optim as optim_lib
+
+    rng = np.random.default_rng(0)
+    shapes = []
+    for _ in range(OPT_LAYERS):
+        shapes += [(256, 256)] * 4 + [(256,)] * 6 + \
+            [(256, 1024), (1024, 256)]
+    tree = {f"l{i}": jnp.asarray(
+        rng.standard_normal(s).astype(np.float32)) * 0.02
+        for i, s in enumerate(shapes)}
+    n_params = sum(x.size for x in jax.tree.leaves(tree))
+    grads = jax.tree.map(lambda x: x * 0.01, tree)
+
+    def timeit(f, *a, n=20):
+        o = f(*a)
+        jax.block_until_ready(o)
+        t0 = time.perf_counter()
+        for _ in range(n):
+            o = f(*a)
+        jax.block_until_ready(o)
+        return (time.perf_counter() - t0) / n * 1e3
+
+    def bench(opt):
+        st = opt.init(tree)
+
+        def step(g, s, p):
+            u, s2 = optim_lib.clipped_update(opt, g, s, p, 1e-3)
+            return jax.tree.map(jnp.add, p, u), s2
+
+        return timeit(jax.jit(step), grads, st, tree)
+
+    unfused_ms = bench(optim_lib.adam())
+    sweep_ms = bench(fused_adam_sweep())
+
+    # microbench rows: the same Adam math as one flat contiguous chain vs
+    # per-leaf loops, distinct buffers — the host's flat-loop bandwidth
+    # deficit is what decides the A/B above on CPU
+    def chain(p, g, m, v):
+        m2 = jax.tree.map(lambda a, b: 0.9 * a + 0.1 * b, m, g)
+        v2 = jax.tree.map(lambda a, b: 0.999 * a + 0.001 * b * b, v, g)
+        u = jax.tree.map(
+            lambda mm, vv: -1e-3 * (mm / 0.5) / (jnp.sqrt(vv / 0.5) + 1e-8),
+            m2, v2)
+        return u, m2, v2
+
+    t_args = [{k: jnp.asarray(rng.standard_normal(x.size).astype(
+        np.float32)).reshape(x.shape) for k, x in tree.items()}
+        for _ in range(4)]
+    v_args = [jnp.asarray(rng.standard_normal(n_params).astype(np.float32))
+              for _ in range(4)]
+    tree_chain_ms = timeit(jax.jit(chain), *t_args)
+    flat_chain_ms = timeit(jax.jit(chain), *v_args)
+    flatten_ms = timeit(
+        jax.jit(lambda t: optim_lib.flatten_tree(t, pad_to=32768)[0]), tree)
+
+    # projected at the PERF.md headline scale (gpt2-medium, 350M fp32
+    # state) against the v5e HBM roofline: the unfused path sweeps the
+    # state 10.5x (separate clip read+write of g, 7-buffer Adam, the
+    # fp32->bf16 cast read+half-write); the fused sweep folds clip+cast
+    # into the 7-buffer pass
+    n350 = 350e6
+    proj_unfused = 10.5 * 4 * n350 / (V5E_HBM_GBPS * 1e9) * 1e3
+    proj_sweep = 7.0 * 4 * n350 / (V5E_HBM_GBPS * 1e9) * 1e3
+    return {
+        "n_params": n_params,
+        "n_leaves": len(jax.tree.leaves(tree)),
+        "measured_cpu": {
+            "unfused_adam_plus_clip_ms": round(unfused_ms, 2),
+            "fused_sweep_ms": round(sweep_ms, 2),
+            "sweep_wins": bool(sweep_ms < unfused_ms),
+            "microbench": {
+                "note": "identical Adam math, distinct buffers: this "
+                        "host's XLA CPU runs one fused loop over a "
+                        "contiguous buffer SLOWER than the same math as "
+                        "per-leaf loops — the whole-state sweep cannot "
+                        "win here regardless of dispatch savings; the "
+                        "flatten row is the dynamic_update_slice path "
+                        "(concatenate-of-reshapes measured ~12x worse)",
+                "tree_chain_ms": round(tree_chain_ms, 2),
+                "flat_chain_ms": round(flat_chain_ms, 2),
+                "flatten_ms": round(flatten_ms, 2),
+            },
+        },
+        "projected_v5e_roofline": {
+            "note": "labeled projection, not a measurement: state-sweep "
+                    "HBM bytes at the PERF.md headline scale (350M fp32 "
+                    "state) over the chip-table bandwidth; the measured "
+                    "~23 ms includes the per-leaf dispatch overhead the "
+                    "sweep removes",
+            "n_params": int(n350),
+            "hbm_gbps": V5E_HBM_GBPS,
+            "unfused_clip_adam_cast_ms": round(proj_unfused, 2),
+            "fused_sweep_ms": round(proj_sweep, 2),
+            "measured_round5_ms": 23.0,
+            "adam_hbm_bound_ms": 13.0,
+        },
+    }
+
+
+def _projection(on):
+    """Multichip overlap projection from the committed NORTHSTAR census
+    (real gpt2-xl program: 586 per-leaf grad all-reduces) + declared
+    latency/bandwidth constants. Labeled as projection throughout."""
+    with open(os.path.join(ROOT, "NORTHSTAR_AOT.json")) as f:
+        ns = json.load(f)
+    n_ar = ns["collectives"]["all-reduce"]
+    wire = ns["collectives_detail"]["wire_bytes_per_chip"]["all-reduce"]
+    flops = ns["xla_flops_per_chip_per_step"]
+    compute_ms = flops / (V5E_PEAK_TFLOPS * 1e12 * MFU) * 1e3
+    wire_ms = wire / (V5E_ICI_GBPS * 1e9) * 1e3
+    launch_off = n_ar * ALPHA_US / 1e3
+    launch_on = PROJ_BUCKETS * ALPHA_US / 1e3
+    overlap_window = BACKWARD_FRAC * compute_ms
+    exposed_off = launch_off + wire_ms          # serialized at the tail
+    exposed_on = launch_on + max(0.0, wire_ms - overlap_window)
+    step_off = compute_ms + exposed_off
+    step_on = compute_ms + exposed_on
+    return {
+        "note": "labeled projection, not a measurement: this host has 1 "
+                "CPU core and no interconnect (virtual-device "
+                "collectives are memcpys), so overlap cannot execute "
+                "here; inputs are the committed NORTHSTAR gpt2-xl "
+                "census + declared constants. The measured halves of "
+                "this artifact are the census collapse and the on-path "
+                "cost above. Caveat: NORTHSTAR is a zero-3 program; the "
+                "projection treats its 586 per-leaf grad reductions as "
+                "the off structure at equal bytes.",
+        "source": "NORTHSTAR_AOT.json",
+        "constants": {"alpha_us_per_collective": ALPHA_US,
+                      "ici_gbps": V5E_ICI_GBPS,
+                      "peak_tflops": V5E_PEAK_TFLOPS, "mfu": MFU,
+                      "backward_frac": BACKWARD_FRAC,
+                      "buckets": PROJ_BUCKETS},
+        "all_reduce_ops_off": n_ar,
+        "all_reduce_wire_gb_per_chip": round(wire / 1e9, 2),
+        "compute_ms": round(compute_ms, 1),
+        "exposed_comm_ms_off_tail_serialized": round(exposed_off, 2),
+        "exposed_comm_ms_on_overlapped": round(exposed_on, 2),
+        "projected_step_ms_off": round(step_off, 1),
+        "projected_step_ms_on": round(step_on, 1),
+        "projected_speedup": round(step_off / step_on, 3),
+        "measured_cpu_bucket_collapse": {
+            "off_ops_to_on_ops": None,      # filled by main()
+            "bucketed_positions_spread": on["collective_positions"],
+        },
+    }
+
+
+def main(write=True):
+    off = _train_run(overlap=False)
+    on = _train_run(overlap=True)
+    opt = _optimizer_bench()
+    proj = _projection(on)
+    proj["measured_cpu_bucket_collapse"]["off_ops_to_on_ops"] = \
+        [off["all_reduce_ops"], on["all_reduce_ops"]]
+    on_vs_off = on["per_step_ms"] / off["per_step_ms"]
+    doc = {
+        "schema": SCHEMA,
+        "scenario": {
+            "model": f"GPT-2 {N_LAYER}x{N_EMBD} (deep-narrow, "
+                     f"{on.get('grad_leaves')} grad leaves)",
+            "batch": BS, "seq": SEQ, "bucket_mb": BUCKET_MB,
+            "steps": STEPS, "rounds": ROUNDS,
+            "platform": "cpu (8 virtual devices, 1 core — no "
+                        "interconnect; see projection note)",
+        },
+        "train_step": {
+            "off": off, "on": on,
+            "on_vs_off": round(on_vs_off, 3),
+            "note": "measured host cost of the restructuring; the "
+                    "overlap win itself needs an interconnect (see "
+                    "projected_multichip)",
+        },
+        "optimizer_sweep": opt,
+        "projected_multichip": proj,
+    }
+    out = json.dumps(doc, indent=2)
+    print(out)
+    refusals = []
+    if on_vs_off > 1.10:
+        refusals.append(f"overlap-on taxes the step {on_vs_off:.3f}x "
+                        "(> 1.10) on this host")
+    if not (on["all_reduce_ops"] * 4 <= off["all_reduce_ops"]):
+        refusals.append("census shows no collective collapse "
+                        f"({off['all_reduce_ops']} -> "
+                        f"{on['all_reduce_ops']})")
+    if on["all_reduce_ops"] > on.get("buckets", 0) + 2:
+        refusals.append("on-path all-reduce count exceeds buckets+2")
+    first = on["collective_positions"]["first"]
+    if first is None or first >= 0.9:
+        refusals.append(f"bucketed collectives tail-clustered "
+                        f"(first pos {first})")
+    # per-bucket byte attribution: every spec bucket must appear as a
+    # same-size all-reduce result in the compiled program
+    got = list(on["all_reduce_result_bytes"])
+    for b in on.get("bucket_bytes", []):
+        if b in got:
+            got.remove(b)
+        else:
+            refusals.append(f"bucket of {b} B has no matching all-reduce "
+                            "result in the census")
+            break
+    if proj["projected_speedup"] <= 1.0:
+        refusals.append("projection shows no overlap win")
+    mc = opt["measured_cpu"]
+    if not mc["sweep_wins"] and not (
+            mc["microbench"]["flat_chain_ms"]
+            > mc["microbench"]["tree_chain_ms"]):
+        refusals.append("sweep lost without the flat-loop bandwidth "
+                        "deficit to explain it — inconsistent "
+                        "measurement")
+    if refusals:
+        for r in refusals:
+            print(f"# REFUSING to write: {r}", file=sys.stderr)
+        return 1
+    if write:
+        with open(os.path.join(ROOT, "OVERLAP_BENCH.json"), "w") as f:
+            f.write(out + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,6 +26,10 @@ Asserts:
   loader (host workers + device stage) adds exactly ZERO train-step
   compiles — background placement produces the same avals/shardings —
   and ``engine.close()`` stops every pipeline thread;
+* ``comm_overlap``: the bucketed-reduction step variant still compiles
+  exactly ONE train-step program over 20 steps, its compiled program
+  carries one all-reduce per bucket (not per leaf), and the goodput
+  ledger's categories still sum to elapsed;
 * ``serving.observability``: the serving observatory is statically
   host-only (no jax import outside its CLI demo — it CANNOT add device
   syncs), an observability-on heterogeneous trace still runs exactly
@@ -60,7 +64,8 @@ def _per_span_us(tracer, iters):
 
 
 def _tiny_engine(ce_enabled, health_enabled=False, goodput_enabled=False,
-                 prefetch_enabled=False, steps_per_print=10 ** 9):
+                 prefetch_enabled=False, comm_overlap=False,
+                 steps_per_print=10 ** 9):
     import jax
     jax.config.update("jax_platforms", "cpu")
     import deepspeed_tpu
@@ -78,6 +83,8 @@ def _tiny_engine(ce_enabled, health_enabled=False, goodput_enabled=False,
                 "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
                 "steps_per_print": steps_per_print,
                 "data_prefetch": {"enabled": prefetch_enabled},
+                "comm_overlap": {"enabled": comm_overlap,
+                                 "bucket_mb": 0.05},
                 "telemetry": {"enabled": True, "trace": False,
                               "jsonl": False, "prometheus": False,
                               "cost_explorer": {"enabled": ce_enabled},
@@ -279,6 +286,45 @@ def check_prefetch_zero_extra_compiles(steps=20):
           f"teardown leak-free")
 
 
+def check_comm_overlap_zero_extra_compiles(steps=20, cadence=5):
+    """PR-10 acceptance guard: the bucketed-reduction (comm_overlap)
+    step variant is selected BEFORE the first lower, like health — a
+    20-step run still compiles the train step exactly ONCE, and the
+    goodput ledger's categories still sum to elapsed wall time (the
+    shard_map variant must not confuse the attribution stack)."""
+    engine, batch = _tiny_engine(ce_enabled=True, goodput_enabled=True,
+                                 comm_overlap=True,
+                                 steps_per_print=cadence)
+    assert engine._comm_overlap_on, \
+        "comm_overlap must be armed on this dp=8 config"
+    n_buckets = engine._overlap_spec.n_buckets
+    assert 1 < n_buckets < engine._overlap_spec.n_leaves
+    engine.train_batch(batch=batch)       # the one compile
+    after_prime = _backend_compiles(engine)
+    for _ in range(steps - 1):
+        engine.train_batch(batch=batch)
+    after_steps = _backend_compiles(engine)
+    assert after_steps == after_prime, (
+        f"comm_overlap step recompiled mid-run: "
+        f"{after_prime} -> {after_steps}")
+    ar = engine.get_cost_census().collective_counts.get("all-reduce", 0)
+    assert ar <= n_buckets + 2, (
+        f"comm_overlap program carries {ar} all-reduces for "
+        f"{n_buckets} buckets — the bucketing collapsed nothing")
+    rep = engine.goodput_report()
+    cats = rep["categories_s"]
+    drift = abs(sum(cats.values()) - rep["elapsed_s"])
+    assert drift <= 0.01 * rep["elapsed_s"] + 1e-6, (
+        f"ledger categories sum {sum(cats.values()):.6f}s but elapsed is "
+        f"{rep['elapsed_s']:.6f}s with comm_overlap on")
+    snap = engine.telemetry.registry.snapshot()
+    assert "comm_overlap_buckets" in snap
+    engine.telemetry.close()
+    print(f"comm_overlap path: 1 compile over {steps} steps, "
+          f"{n_buckets} buckets / {ar} all-reduces, ledger drift "
+          f"{drift:.4f}s")
+
+
 def check_serving_obs_no_device_access():
     """The serving observatory must stay PURE HOST bookkeeping — a module
     that cannot reach jax cannot introduce a per-step device sync. The
@@ -460,6 +506,7 @@ def main(iters=200_000):
     check_goodput_full_stack_one_compile()
     check_goodput_disabled_inert()
     check_prefetch_zero_extra_compiles()
+    check_comm_overlap_zero_extra_compiles()
     check_serving_obs_no_device_access()
     check_serving_obs_zero_extra_compiles()
     print("OK")

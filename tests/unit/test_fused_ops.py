@@ -11,7 +11,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from deepspeed_tpu.ops.adam.fused_adam import fused_adam
+from deepspeed_tpu.ops.adam.fused_adam import (adam_sweep_apply,
+                                               fused_adam, sweep_pad)
 from deepspeed_tpu.ops.lamb.fused_lamb import fused_lamb
 from deepspeed_tpu.ops.transformer.fused import (
     fused_bias_gelu, fused_layer_norm, fused_softmax)
@@ -121,6 +122,32 @@ def test_fused_adam_multiblock():
     ur, _ = ref.update(grads, sr, params, jnp.float32(1e-3))
     np.testing.assert_allclose(np.asarray(uf["w"]), np.asarray(ur["w"]),
                                atol=1e-6, rtol=1e-5)
+
+
+def test_sweep_kernel_multiblock_matches_per_tensor_math(  # PR-10
+):
+    """The whole-state sweep kernel (interpret-mode Pallas) over a
+    multi-block flat buffer matches the per-tensor jnp Adam chain on
+    the same values — the sweep is the same update, just one pass over
+    contiguous state (fast-tier engine parity lives in
+    tests/unit/test_comm_overlap.py)."""
+    n = 2 * sweep_pad()              # exercises the grid (2 blocks)
+    p = _rand((n,), 30)
+    g = _rand((n,), 31)
+    m = _rand((n,), 32)
+    v = jnp.abs(_rand((n,), 33))
+    u, m2, v2, cast = adam_sweep_apply(
+        p, g, m, v, 1e-3, 0.9, 0.99, 1.0, weight_decay=0.01,
+        cast_dtype=jnp.bfloat16, use_pallas=True)
+    mr = 0.9 * m + 0.1 * g
+    vr = 0.999 * v + 0.001 * g * g
+    ur = -1e-3 * (mr / 0.9) / (jnp.sqrt(vr / 0.99) + 1e-8) \
+        - 1e-3 * 0.01 * p
+    for a, r, name in ((u, ur, "u"), (m2, mr, "m"), (v2, vr, "v"),
+                       (cast, (p + ur).astype(jnp.bfloat16), "cast")):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(r, np.float32),
+                                   atol=1e-6, rtol=1e-5, err_msg=name)
 
 
 def test_engine_runs_with_fused_optimizer():

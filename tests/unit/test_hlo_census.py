@@ -253,6 +253,36 @@ def test_explain_gauges_reach_sinks():
     assert 'collective_bytes{axes="data"}' in text
 
 
+def test_collective_schedule_positions():
+    """Normalized entry-computation positions: collectives found with
+    their index over the instruction count, -done halves skipped,
+    non-entry computations ignored."""
+    from deepspeed_tpu.telemetry.hlo_census import \
+        collective_schedule_positions
+    hlo = """\
+HloModule m
+
+%aux (x: f32[4]) -> f32[4] {
+  %x = f32[4]{0} parameter(0)
+  ROOT %ar.aux = f32[4]{0} all-reduce(%x), replica_groups={}
+}
+
+ENTRY %main (p: f32[8]) -> f32[8] {
+  %p = f32[8]{0} parameter(0)
+  %a = f32[8]{0} add(%p, %p)
+  %ar0 = f32[8]{0} all-reduce-start(%a), replica_groups={{0,1}}
+  %b = f32[8]{0} multiply(%a, %a)
+  %ar0d = f32[8]{0} all-reduce-done(%ar0)
+  ROOT %ar1 = f32[8]{0} all-reduce(%b), replica_groups={{0,1}}
+}
+"""
+    pos = collective_schedule_positions(hlo)
+    assert [p["kind"] for p in pos] == ["all-reduce-start", "all-reduce"]
+    assert pos[0]["pos"] < pos[1]["pos"] == 1.0
+    # the aux computation's collective is not counted
+    assert len(pos) == 2
+
+
 def test_cost_explorer_disabled_is_inert():
     engine, batch = _tiny_engine(ce_enabled=False)
     engine.train_batch(batch=batch)

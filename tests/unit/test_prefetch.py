@@ -381,24 +381,146 @@ class TestEngineIntegration:
         assert sum("num_local_io_workers" in w for w in warns) == 1
         engine.close()
 
-    def test_multiprocess_guard_disables_device_stage(self, monkeypatch):
-        """The device stage must NOT run when _globalize_batch performs
-        cross-process work — host-side prefetch only, with a warning,
-        never a silent deadlock risk."""
+    def test_multiprocess_device_stage_armed_collective_free(
+            self, monkeypatch):
+        """The PR-10 lift: the device stage now RUNS on multi-process
+        meshes — background placement uses verify=False, which performs
+        no collectives by construction (the checksum/row-agreement
+        collectives are deferred to the main thread at consumption), so
+        the PR-5 deadlock cannot occur."""
         import jax
-
-        from deepspeed_tpu.runtime import engine as engine_mod
-        warns = []
-        monkeypatch.setattr(engine_mod.logger, "warning",
-                            lambda msg, *a, **k: warns.append(str(msg)))
         engine = _make_engine(enabled=True)
         monkeypatch.setattr(jax, "process_count", lambda: 2)
-        assert engine._prefetch_place_fn() is None
-        engine._prefetch_place_fn()                 # warns once, not twice
-        assert sum("device stage disabled" in w for w in warns) == 1
+        place = engine._prefetch_place_fn()
+        assert place is not None                    # stage armed
+        # the placement closure is the engine's _globalize_batch with the
+        # background-thread contract: verification OFF
+        assert place.func == engine._globalize_batch
+        assert place.keywords.get("verify") is False
+        eval_place = engine._prefetch_place_fn(for_train=False)
+        assert eval_place.keywords == {"for_train": False,
+                                       "verify": False}
         loader = engine.deepspeed_io(random_dataset(32, HIDDEN))
-        assert isinstance(loader, PrefetchLoader)   # host stage stays on
-        assert loader.place_fn is None
+        assert isinstance(loader, PrefetchLoader)
+        assert loader.place_fn is not None          # device stage on
+        engine.close()
+
+    def test_verify_false_placement_never_issues_collectives(
+            self, monkeypatch):
+        """verify=False placement (the background-thread path) must not
+        call the checksum allgather even for broadcast leaves, and must
+        not consume the first-occurrence key — the deferred main-thread
+        check still runs for that leaf."""
+        import jax
+        engine = _make_engine(enabled=True)
+        calls = []
+        monkeypatch.setattr(
+            engine, "_assert_identical_across_processes",
+            lambda x: calls.append(np.shape(x)))
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(
+            jax, "make_array_from_process_local_data",
+            lambda sh, x: np.asarray(x))
+        # 2 "processes" x 8 dp -> 4 local rows; one [1, H] broadcast leaf
+        batch = {"x": np.zeros((4, HIDDEN), np.float32),
+                 "mask": np.ones((1, HIDDEN), np.float32)}
+        engine._globalize_batch(batch, verify=False)
+        assert calls == []                          # no collective issued
+        assert not engine._broadcast_leaves_checked  # key not consumed
+        engine._globalize_batch(batch, verify=True)
+        assert len(calls) == 1                      # main-thread path does
+        engine.close()
+
+    def test_preplaced_global_batch_honours_verify_false(self, monkeypatch):
+        """A user loader can yield ALREADY-global arrays straight into
+        the background device stage: the pre-placed hand-back must still
+        honour verify=False (no verification collectives off the main
+        thread) — the deferred check runs when the consumption-side
+        re-globalize lands in the same branch with verify=True."""
+        import jax
+        engine = _make_engine(enabled=True)
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        calls = []
+        monkeypatch.setattr(
+            engine, "_verify_prefetched_batch",
+            lambda b, for_train=True: calls.append(for_train))
+
+        class _FakeGlobal:                    # a non-addressable jax.Array
+            is_fully_addressable = False
+            shape = (8, HIDDEN)
+            ndim = 2
+            dtype = np.dtype(np.float32)
+        jax.Array.register(_FakeGlobal)
+        batch = {"x": _FakeGlobal(), "y": _FakeGlobal()}
+        out = engine._globalize_batch(batch, verify=False)  # background
+        assert out is batch and calls == []
+        out = engine._globalize_batch(batch, verify=True)   # consumption
+        assert out is batch and calls == [True]
+        engine.close()
+
+    def test_deferred_verify_runs_on_main_thread(self, monkeypatch):
+        """_verify_prefetched_batch (the consumption-side half) checksums
+        replicated leaves exactly once, keyed by the shared
+        first-occurrence set."""
+        engine = _make_engine(enabled=True)
+
+        class _FakeSharding:
+            is_fully_replicated = True
+
+        class _FakeLeaf:
+            sharding = _FakeSharding()
+            shape = (1, HIDDEN)
+            dtype = np.float32
+
+            def addressable_data(self, i):
+                return np.ones(self.shape, np.float32)
+
+        calls = []
+        monkeypatch.setattr(
+            engine, "_assert_identical_across_processes",
+            lambda x: calls.append(np.shape(x)))
+        batch = {"mask": _FakeLeaf()}
+        engine._verify_prefetched_batch(batch)
+        engine._verify_prefetched_batch(batch)      # second call: cached
+        assert calls == [(1, HIDDEN)]
+        engine.close()
+
+    def test_deferred_eval_verify_one_collective_per_batch(
+            self, monkeypatch):
+        """The eval-route deferred row check issues ONE vector allgather
+        for the whole batch (not one per leaf — that taxed every
+        steady-state eval batch L serial round-trips) and still raises
+        on cross-process row divergence."""
+        from jax.experimental import multihost_utils
+        engine = _make_engine(enabled=True)
+
+        class _Leaf:
+            sharding = None
+            dtype = np.dtype(np.float32)
+
+            def __init__(self, rows):
+                self.shape = (rows, HIDDEN)
+
+        calls = []
+
+        def fake_allgather(x, divergent=False):
+            calls.append(np.asarray(x))
+            stacked = np.stack([np.asarray(x), np.asarray(x)])  # 2 procs
+            if divergent:
+                stacked[1, 0] += 1
+            return stacked
+
+        monkeypatch.setattr(multihost_utils, "process_allgather",
+                            fake_allgather)
+        batch = {"x": _Leaf(4), "y": _Leaf(4), "z": _Leaf(2)}
+        engine._verify_prefetched_batch(batch, for_train=False)
+        assert len(calls) == 1                      # one collective
+        assert sorted(calls[0].tolist()) == [2, 4, 4]
+        monkeypatch.setattr(
+            multihost_utils, "process_allgather",
+            lambda x: fake_allgather(x, divergent=True))
+        with pytest.raises(ValueError, match="disagree across processes"):
+            engine._verify_prefetched_batch(batch, for_train=False)
         engine.close()
 
     def test_eval_route_places_with_eval_semantics(self, monkeypatch):
@@ -410,8 +532,9 @@ class TestEngineIntegration:
         real = engine._globalize_batch
         monkeypatch.setattr(
             engine, "_globalize_batch",
-            lambda b, for_train=True: seen.append(for_train) or real(
-                b, for_train=for_train))
+            lambda b, for_train=True, verify=True:
+            seen.append(for_train) or real(
+                b, for_train=for_train, verify=verify))
         train_pl = engine.deepspeed_io(random_dataset(32, HIDDEN))
         train_pl.place_fn((np.zeros((8, HIDDEN), np.float32),
                            np.zeros((8, HIDDEN), np.float32)))
