@@ -105,6 +105,9 @@ def telemetry_report():
     row("serving observatory", True,
         "(serving.observability block; slot-step ledger + SLO rules -> "
         "SERVING_HEALTH.json)")
+    row("fleet flight recorder", True,
+        "(telemetry.fleet block; per-rank record shipping + skew/desync "
+        "sentinels -> FLEET_HEALTH.json; bench_diff CLI)")
     row("goodput autotuner (2-stage)", True,
         "(autotuning block; compile-time pruning + measured probes -> "
         "TUNE_REPORT.json)")

@@ -195,6 +195,40 @@ class DeepSpeedTelemetryConfig(DeepSpeedConfigObject):
             C.GOODPUT_PROFILER_MAX_CAPTURES_DEFAULT)
         self.goodput_profiler_dir = g.get(C.GOODPUT_PROFILER_DIR,
                                           C.GOODPUT_PROFILER_DIR_DEFAULT)
+        # fleet sub-block (telemetry/fleet.py): cross-rank flight recorder
+        # — per-rank window-record shipping + rank-0 skew/desync
+        # sentinels. Flattened onto fleet_* attributes.
+        fl = t.get(C.TELEMETRY_FLEET, {}) or {}
+        self.fleet_enabled = fl.get(C.FLEET_ENABLED,
+                                    C.FLEET_ENABLED_DEFAULT)
+        self.fleet_run_dir = fl.get(C.FLEET_RUN_DIR,
+                                    C.FLEET_RUN_DIR_DEFAULT)
+        self.fleet_rank = int(fl.get(C.FLEET_RANK, C.FLEET_RANK_DEFAULT))
+        self.fleet_cadence = int(fl.get(C.FLEET_CADENCE,
+                                        C.FLEET_CADENCE_DEFAULT))
+        self.fleet_desync = fl.get(C.FLEET_DESYNC, C.FLEET_DESYNC_DEFAULT)
+        self.fleet_desync_cadence = int(fl.get(
+            C.FLEET_DESYNC_CADENCE, C.FLEET_DESYNC_CADENCE_DEFAULT))
+        self.fleet_step_time_skew_frac = float(fl.get(
+            C.FLEET_STEP_TIME_SKEW_FRAC,
+            C.FLEET_STEP_TIME_SKEW_FRAC_DEFAULT))
+        self.fleet_input_wait_skew_frac = float(fl.get(
+            C.FLEET_INPUT_WAIT_SKEW_FRAC,
+            C.FLEET_INPUT_WAIT_SKEW_FRAC_DEFAULT))
+        self.fleet_checkpoint_skew_frac = float(fl.get(
+            C.FLEET_CHECKPOINT_SKEW_FRAC,
+            C.FLEET_CHECKPOINT_SKEW_FRAC_DEFAULT))
+        self.fleet_checkpoint_skew_floor_ms = float(fl.get(
+            C.FLEET_CHECKPOINT_SKEW_FLOOR_MS,
+            C.FLEET_CHECKPOINT_SKEW_FLOOR_MS_DEFAULT))
+        self.fleet_warmup_windows = int(fl.get(
+            C.FLEET_WARMUP_WINDOWS, C.FLEET_WARMUP_WINDOWS_DEFAULT))
+        self.fleet_window_ring = int(fl.get(C.FLEET_WINDOW_RING,
+                                            C.FLEET_WINDOW_RING_DEFAULT))
+        self.fleet_snapshot_file = fl.get(C.FLEET_SNAPSHOT_FILE,
+                                          C.FLEET_SNAPSHOT_FILE_DEFAULT)
+        self.fleet_background_ship = fl.get(
+            C.FLEET_BACKGROUND_SHIP, C.FLEET_BACKGROUND_SHIP_DEFAULT)
         env = os.environ.get("DS_TELEMETRY")
         if env is not None:
             self.enabled = env.lower() in ("1", "true", "yes", "on")
@@ -212,6 +246,38 @@ class DeepSpeedTelemetryConfig(DeepSpeedConfigObject):
         if env_g is not None:
             self.goodput_enabled = env_g.lower() in ("1", "true", "yes",
                                                      "on")
+        env_f = os.environ.get("DS_TELEMETRY_FLEET")
+        if env_f is not None:
+            self.fleet_enabled = env_f.lower() in ("1", "true", "yes",
+                                                   "on")
+        env_fd = os.environ.get("DS_TELEMETRY_FLEET_RUN_DIR")
+        if env_fd:
+            self.fleet_run_dir = env_fd
+        env_fr = os.environ.get("DS_TELEMETRY_FLEET_RANK")
+        if env_fr is not None:
+            self.fleet_rank = int(env_fr)
+        if self.fleet_cadence < 0:
+            raise DeepSpeedConfigError(
+                f"telemetry.fleet.cadence must be >= 0, got "
+                f"{self.fleet_cadence}")
+        if self.fleet_desync_cadence < 0:
+            raise DeepSpeedConfigError(
+                f"telemetry.fleet.desync_cadence must be >= 0, got "
+                f"{self.fleet_desync_cadence}")
+        for name, frac in (("step_time_skew_frac",
+                            self.fleet_step_time_skew_frac),
+                           ("input_wait_skew_frac",
+                            self.fleet_input_wait_skew_frac),
+                           ("checkpoint_skew_frac",
+                            self.fleet_checkpoint_skew_frac)):
+            if not 0.0 < frac <= 1.0:
+                raise DeepSpeedConfigError(
+                    f"telemetry.fleet.{name} must be in (0, 1], got "
+                    f"{frac}")
+        if self.fleet_window_ring < 1:
+            raise DeepSpeedConfigError(
+                f"telemetry.fleet.window_ring must be >= 1, got "
+                f"{self.fleet_window_ring}")
 
 
 class DeepSpeedDataPrefetchConfig(DeepSpeedConfigObject):

@@ -201,6 +201,46 @@ GOODPUT_PROFILER_MAX_CAPTURES_DEFAULT = 1
 GOODPUT_PROFILER_DIR = "profiler_dir"       # "" -> <output_path>/goodput_profile
 GOODPUT_PROFILER_DIR_DEFAULT = ""
 
+# telemetry.fleet: cross-rank flight recorder (telemetry/fleet.py). Every
+# rank ships window records (atomic files) into a shared run directory;
+# fleet rank 0 merges them and runs the cross-rank sentinels —
+# step_time_skew (straggler attribution), input_wait_skew,
+# checkpoint_persist_skew, and the desync sentinel (per-bucket parameter
+# checksums across data-parallel replicas) — escalating warn-once ->
+# throttled FLEET_HEALTH.json -> trace flush.
+# DS_TELEMETRY_FLEET=1/0 force-toggles `enabled`; DS_TELEMETRY_FLEET_RUN_DIR
+# overrides `run_dir`; DS_TELEMETRY_FLEET_RANK overrides `rank` (the
+# subprocess multi-rank simulations use it).
+TELEMETRY_FLEET = "fleet"
+FLEET_ENABLED = "enabled"
+FLEET_ENABLED_DEFAULT = False
+FLEET_RUN_DIR = "run_dir"                   # "" -> <output_path>/fleet_run
+FLEET_RUN_DIR_DEFAULT = ""
+FLEET_RANK = "rank"                         # -1 -> dist.get_rank()
+FLEET_RANK_DEFAULT = -1
+FLEET_CADENCE = "cadence"                   # ship every N steps; 0 -> steps_per_print
+FLEET_CADENCE_DEFAULT = 0
+FLEET_DESYNC = "desync"                     # arm the desync sentinel
+FLEET_DESYNC_DEFAULT = True
+FLEET_DESYNC_CADENCE = "desync_cadence"     # checksum every N fleet ticks; 0 -> 1
+FLEET_DESYNC_CADENCE_DEFAULT = 0
+FLEET_STEP_TIME_SKEW_FRAC = "step_time_skew_frac"   # (slow-fast)/slow
+FLEET_STEP_TIME_SKEW_FRAC_DEFAULT = 0.25
+FLEET_INPUT_WAIT_SKEW_FRAC = "input_wait_skew_frac"  # max-min window frac
+FLEET_INPUT_WAIT_SKEW_FRAC_DEFAULT = 0.25
+FLEET_CHECKPOINT_SKEW_FRAC = "checkpoint_skew_frac"  # (max-min)/max
+FLEET_CHECKPOINT_SKEW_FRAC_DEFAULT = 0.5
+FLEET_CHECKPOINT_SKEW_FLOOR_MS = "checkpoint_skew_floor_ms"
+FLEET_CHECKPOINT_SKEW_FLOOR_MS_DEFAULT = 50.0
+FLEET_WARMUP_WINDOWS = "warmup_windows"     # windows before the skew rules arm
+FLEET_WARMUP_WINDOWS_DEFAULT = 1
+FLEET_WINDOW_RING = "window_ring"           # merged-window ring buffer size
+FLEET_WINDOW_RING_DEFAULT = 128
+FLEET_SNAPSHOT_FILE = "snapshot_file"       # "" -> <output_path>/FLEET_HEALTH.json
+FLEET_SNAPSHOT_FILE_DEFAULT = ""
+FLEET_BACKGROUND_SHIP = "background_ship"   # write records off-thread
+FLEET_BACKGROUND_SHIP_DEFAULT = True
+
 # Checkpoint
 CHECKPOINT = "checkpoint"
 CHECKPOINT_TAG_VALIDATION = "tag_validation"

@@ -482,6 +482,62 @@ class DeepSpeedEngine:
         self._health_cadence = int(getattr(tcfg, "health_cadence", 0) or 0)
         self._health_spec = None
 
+        # ---- fleet flight recorder (telemetry/fleet.py) -------------------
+        # Cross-rank by design: the SHIPPER runs on EVERY rank (per-rank
+        # window records into the shared run dir are the whole point), so
+        # it is gated on the CONFIG, not the rank-0-only manager. The
+        # aggregating MONITOR (skew/desync sentinels, FLEET_HEALTH.json)
+        # lives on fleet rank 0 only. The desync checksum program is armed
+        # later, in _build_step_fns, once the param tree exists.
+        self._fleet = None
+        self._fleet_monitor = None
+        self._fleet_cadence = 0
+        self._fleet_ticks = 0
+        self._desync_on = False
+        self._desync_every = 1
+        self._desync_fn = None
+        self._desync_spec = None
+        self._warned_desync = False
+        if (bool(getattr(tcfg, "enabled", False))
+                and bool(getattr(tcfg, "fleet_enabled", False))
+                and not self._abstract_init):
+            from deepspeed_tpu.telemetry import fleet as _fleet_mod
+            frank = int(getattr(tcfg, "fleet_rank", -1))
+            if frank < 0:
+                frank = dist.get_rank()
+            fleet_run_dir = getattr(tcfg, "fleet_run_dir", "") or \
+                os.path.join(tcfg.output_path or "telemetry/", "fleet_run")
+            self._fleet_cadence = int(getattr(tcfg, "fleet_cadence", 0)
+                                      or 0)
+            self._desync_every = max(
+                1, int(getattr(tcfg, "fleet_desync_cadence", 0) or 1))
+            self._fleet = _fleet_mod.FleetShipper(
+                fleet_run_dir, rank=frank,
+                job_name=tcfg.job_name or "",
+                background=bool(getattr(tcfg, "fleet_background_ship",
+                                        True)))
+            _fleet_mod.set_shipper(self._fleet)
+            if self._goodput is not None:
+                # window categories come from this rank's own ledger as
+                # exact integer-µs diffs; ranks without a ledger fall
+                # back to the shipper's own input-wait/checkpoint timers
+                self._fleet.attach_ledger(self._goodput)
+            if frank == 0:
+                self._fleet_monitor = _fleet_mod.FleetMonitor.from_config(
+                    tcfg, run_dir=fleet_run_dir,
+                    output_path=tcfg.output_path or "telemetry/",
+                    job_name=tcfg.job_name or "",
+                    registry=self.telemetry.registry,
+                    on_escalate=(self.telemetry._force_trace_export
+                                 if self.telemetry.enabled and tcfg.trace
+                                 else None))
+            if self.telemetry.enabled and self.telemetry.tracer.enabled:
+                # rank-tagged process metadata: per-rank trace files
+                # concatenate into one per-rank-lane view (fleet.py's
+                # merge_traces / --merge-traces)
+                self.telemetry.tracer.set_process_label(
+                    f"rank {frank}", sort_index=frank)
+
         # ---- parameters / state init --------------------------------------
         with self.telemetry.span("engine/init_state"):
             self._init_state(model_parameters, sample_batch)
@@ -1228,6 +1284,19 @@ class DeepSpeedEngine:
                     "gradient reduction buckets per step").set(
                         self._overlap_spec.n_buckets)
 
+        if self._fleet is not None and \
+                getattr(cfg.telemetry, "fleet_desync", True):
+            self._desync_on = self._resolve_desync()
+            if self._desync_on:
+                from deepspeed_tpu.telemetry.fleet import (
+                    build_desync_checksum_fn, build_desync_spec)
+                self._desync_spec = build_desync_spec(
+                    self.state.params,
+                    depth=int(getattr(cfg.telemetry, "health_bucket_depth",
+                                      8)))
+                self._desync_fn = build_desync_checksum_fn(
+                    self.mesh, self._desync_spec, groups.DATA_AXIS)
+
         if self._sparse_grads:
             value_and_grad = self._make_sparse_vg()
         elif self._comm_overlap_on:
@@ -1873,9 +1942,16 @@ class DeepSpeedEngine:
     # --------------------------------------------------- goodput ledger
     def _led_attr(self, category):
         """Goodput wall-clock attribution context for *category*; the
-        shared no-op when the ledger is off (sub-µs, like trace_span)."""
+        shared no-op when the ledger is off (sub-µs, like trace_span).
+        Ranks whose manager (and therefore ledger) is disabled but whose
+        fleet shipper is live still time input-wait and checkpoint
+        intervals — the cross-rank skew rules need every rank's numbers,
+        not just rank 0's."""
         led = self._goodput
         if led is None:
+            if self._fleet is not None and category in (
+                    "input_wait", "checkpoint_save"):
+                return self._fleet.time_category(category)
             return _NULL_CTX
         return led.attribute(category)
 
@@ -1920,6 +1996,113 @@ class DeepSpeedEngine:
         report = led.report()
         if write:
             led.write_snapshot(force=True, report=report)
+        return report
+
+    # --------------------------------------------------- fleet recorder
+    def _resolve_desync(self):
+        """Arm the desync sentinel when the engine is inside its
+        envelope: data-parallel replicas that are REPLICATED in name
+        (zero <= 2, no model/expert/pipe sharding of params) are the
+        precondition for cross-replica checksum comparison — a sharded
+        param tree diverges across ranks by design. A perf/forensics
+        knob, never a semantic switch: outside the envelope the fleet
+        still ships, just without checksums (warn once)."""
+        bad = []
+        if self.dp_world_size < 2:
+            bad.append("data-parallel world size 1 (no replicas to "
+                       "cross-check)")
+        if self.zero_stage >= 3:
+            bad.append(f"zero stage {self.zero_stage} (params sharded "
+                       "over dp — replicas legitimately differ)")
+        if self.mp_world_size != 1:
+            bad.append("model parallelism")
+        if groups.get_expert_parallel_world_size() != 1:
+            bad.append("expert parallelism")
+        if groups.get_pipe_parallel_world_size() != 1:
+            bad.append("pipeline parallelism")
+        if not bad:
+            # belt and braces: the checksum shard_map assumes every leaf
+            # is fully replicated; any partitioned spec would make the
+            # per-device reduction read different (legitimate) slices
+            specs = {tuple(s.spec) for s in
+                     jax.tree_util.tree_leaves(self.param_shardings)}
+            if any(any(e is not None for e in spec) for spec in specs):
+                bad.append("partitioned param shardings")
+        if bad:
+            if not self._warned_desync:
+                self._warned_desync = True
+                logger.warning(
+                    "telemetry.fleet.desync requested but the parameter "
+                    "checksum sentinel is disabled — incompatible with: "
+                    + "; ".join(bad))
+            return False
+        return True
+
+    def _fleet_tick(self, force=False):
+        """Ship this rank's window record at the fleet cadence (and run
+        the rank-0 aggregation poll). The only device access is the
+        cadence-gated desync checksum fetch on THIS (main) thread —
+        attributed like the health tick; the shipping itself is host
+        file I/O on the background writer."""
+        fl = self._fleet
+        if fl is None:
+            return None
+        cad = self._fleet_cadence or self.steps_per_print()
+        if not force and self.global_steps % cad != 0:
+            return None
+        desync = None
+        if self._desync_on and self._desync_fn is not None and \
+                fl.has_pending_steps() and \
+                self._fleet_ticks % self._desync_every == 0:
+            with self._led_attr("device_compute"), \
+                    self.telemetry.span("fleet/desync_checksum"):
+                mat = jax.device_get(self._desync_fn(self.state.params))
+            desync = {
+                "step": self.global_steps,
+                "bucket_names": list(self._desync_spec.names),
+                "replicas": [[i, [float(v) for v in row]]
+                             for i, row in enumerate(mat)],
+            }
+        mon = self.telemetry.health
+        health = mon.last_sample if (mon is not None
+                                     and self._health_on) else None
+        rec = fl.tick(step=self.global_steps,
+                      skipped_steps=self.skipped_steps,
+                      desync=desync, health=health, force=force)
+        if rec is not None:
+            self._fleet_ticks += 1
+        if self._fleet_monitor is not None:
+            # rank 0 merges whatever every rank (this one included) has
+            # shipped so far; pure host file I/O, judged incrementally.
+            # Only the forced report path waits for the background
+            # writer — draining every cadence tick would park the train
+            # thread on the writer's fsync (on a shared fs that can be
+            # tens of ms), and the monitor simply judges this rank's
+            # window on the next poll once the file lands.
+            if force:
+                fl.drain()
+            self._fleet_monitor.poll(force=force)
+        return rec
+
+    def fleet_report(self, write=False):
+        """The fleet flight-recorder report (what ``FLEET_HEALTH.json``
+        holds): per-rank exact-integer window sums, the merged window
+        ring with cross-rank skew views, desync sentinel state and the
+        fired anomalies. Ships this rank's partial window first so the
+        report is current. On a non-zero fleet rank (no aggregator)
+        returns the shipper's own summary. ``{"enabled": False}`` when
+        ``telemetry.fleet`` is off."""
+        if self._fleet is None:
+            return {"enabled": False}
+        self._fleet_tick(force=True)
+        if self._fleet_monitor is None:
+            return {"enabled": True, "role": "shipper",
+                    "rank": self._fleet.rank,
+                    "windows_shipped": self._fleet.windows_shipped,
+                    "ship_errors": self._fleet.ship_errors}
+        report = self._fleet_monitor.report()
+        if write:
+            self._fleet_monitor.write_snapshot(force=True, report=report)
         return report
 
     def _lr_fn_traced(self, step):
@@ -2378,7 +2561,16 @@ class DeepSpeedEngine:
             data_iter = self._maybe_prefetch_iter(data_iter)
         tel = self.telemetry
         if not tel.enabled:
-            return self._train_batch(data_iter, batch)
+            if self._fleet is None:
+                return self._train_batch(data_iter, batch)
+            # non-zero fleet ranks: the manager (and ledger) are rank-0
+            # only, but the fleet needs THIS rank's step wall times —
+            # two clock reads, nothing else
+            t0 = time.perf_counter()
+            mean_loss = self._train_batch(data_iter, batch)
+            self._fleet.note_step_time(time.perf_counter() - t0)
+            self._fleet_tick()
+            return mean_loss
         t0 = time.perf_counter()
         # goodput: the whole step interval is host_dispatch SELF time —
         # nested attributions (input_wait in next(), compile via the
@@ -2392,8 +2584,11 @@ class DeepSpeedEngine:
         with self._led_attr("host_dispatch"):
             with tel.span("train_batch", global_step=self.global_steps):
                 mean_loss = self._train_batch(data_iter, batch)
-            self._publish_step_telemetry(mean_loss,
-                                         time.perf_counter() - t0)
+            step_s = time.perf_counter() - t0
+            self._publish_step_telemetry(mean_loss, step_s)
+        if self._fleet is not None:
+            self._fleet.note_step_time(step_s)
+            self._fleet_tick()
         return mean_loss
 
     def _tokens_per_sample(self):
@@ -2707,6 +2902,19 @@ class DeepSpeedEngine:
             self._cost_census = None
             self._cost_census_program = None
             self._last_batch = None
+            if self._fleet is not None:
+                from deepspeed_tpu.telemetry import fleet as _fleet_mod
+                try:
+                    # ship the final partial window and judge it before
+                    # the writer thread goes away (anomalies whose last
+                    # firings rode the snapshot throttle still land)
+                    self._fleet_tick(force=True)
+                except Exception as e:
+                    logger.warning("[fleet] final tick failed: %s", e)
+                self._fleet.close()
+                _fleet_mod.reset_shipper(if_current=self._fleet)
+            if self._fleet_monitor is not None:
+                self._fleet_monitor.close()
             self.telemetry.close()
 
     # ------------------------------------------------------------ checkpoints

@@ -27,7 +27,16 @@ Four pieces (see the per-module docstrings):
   attribution ledger (categories sum to steps x max_batch x
   decode_steps by construction), windowed SLO rules and
   SERVING_HEALTH.json forensics
-  (``python -m deepspeed_tpu.telemetry.serving_observatory``).
+  (``python -m deepspeed_tpu.telemetry.serving_observatory``);
+* ``fleet`` — the cross-rank flight recorder: every rank ships atomic
+  window records into a shared run dir, rank 0 merges them and runs the
+  straggler/input/checkpoint skew sentinels plus the desync sentinel
+  (cross-replica parameter checksums), escalating to
+  FLEET_HEALTH.json; ``merge_traces`` joins per-rank Chrome traces into
+  per-rank process lanes (``python -m deepspeed_tpu.telemetry.fleet``);
+* ``bench_diff`` — bench-regression differ over committed BENCH_r*.json
+  rounds (``python -m deepspeed_tpu.telemetry.bench_diff`` exits
+  non-zero past the regression threshold).
 
 ``TelemetryManager`` (manager.py) wires them per engine run, behind the
 ``telemetry`` config block (see CONFIG.md). Everything is importable and
@@ -60,6 +69,10 @@ from deepspeed_tpu.telemetry.ledger import (GoodputIterator, GoodputLedger,
 from deepspeed_tpu.telemetry.serving_observatory import (RequestTimeline,
                                                          ServingObservatory,
                                                          SlotStepLedger)
+from deepspeed_tpu.telemetry.fleet import (FleetMonitor, FleetShipper,
+                                           build_desync_checksum_fn,
+                                           get_shipper, merge_traces,
+                                           set_shipper)
 from deepspeed_tpu.telemetry.manager import (TelemetryManager, get_manager,
                                              set_manager)
 
@@ -76,5 +89,7 @@ __all__ = [
     "build_bucket_spec", "decode_nonfinite_mask",
     "GoodputIterator", "GoodputLedger", "get_ledger", "set_ledger",
     "RequestTimeline", "ServingObservatory", "SlotStepLedger",
+    "FleetMonitor", "FleetShipper", "build_desync_checksum_fn",
+    "get_shipper", "merge_traces", "set_shipper",
     "get_manager", "set_manager",
 ]

@@ -1,0 +1,162 @@
+"""Bench regression differ — compare consecutive ``BENCH_r*.json`` rounds.
+
+The repo commits its measured trajectory (``BENCH_r01.json`` ..): every
+round records step_ms / tok/s / MFU (+ the tunnel-health probes that
+caught the round-3 poisoned environment). Nothing, however, FAILED when a
+round regressed — a slower regen could land silently. This differ makes
+the trajectory self-guarding:
+
+``python -m deepspeed_tpu.telemetry.bench_diff`` compares the last two
+rounds (or an explicit file list, or ``--all`` for the whole chain) and
+**exits non-zero** when a tracked metric regressed past the threshold —
+wired into tier-1 via ``tests/unit/test_bench_diff.py`` so the committed
+trajectory cannot silently regress.
+
+Environment honesty: a round whose ``tunnel_healthy`` flag is ``False``
+measured the tunnel, not the engine (the BENCH_r03 lesson — identical
+code, 62 then 2.2 TFLOPS hours apart). Comparisons involving such a
+round are reported ``unmeasurable`` and do NOT fail, unless ``--strict``.
+
+Pure stdlib — usable from CI without jax installed.
+"""
+
+import glob
+import json
+import os
+import re
+import sys
+
+# metric -> direction ("down" = lower is better). ``input_wait_frac`` is
+# tracked informationally (it appears from PR 5 on); missing-on-either-
+# side metrics are skipped, never failed.
+METRICS = {
+    "step_time_ms": "down",
+    "tokens_per_s": "up",
+    "value": "up",            # the headline TFLOPS/chip
+    "mfu": "up",
+    "input_wait_frac": "down",
+}
+
+DEFAULT_THRESHOLD = 0.10      # 10% relative regression fails
+
+
+def load_round(path):
+    """A bench round: either the raw one-line bench JSON or the committed
+    ``{"n", "cmd", "parsed": {...}}`` wrapper. Returns (metrics_dict,
+    note) — metrics None when the round carries no parsed payload (the
+    round-1 seed failure is such a file)."""
+    with open(path) as f:
+        doc = json.load(f)
+    parsed = doc.get("parsed", doc)
+    if not isinstance(parsed, dict) or "step_time_ms" not in parsed:
+        return None, "no parsed bench payload"
+    return parsed, None
+
+
+def diff_rounds(prev, cur, threshold=DEFAULT_THRESHOLD):
+    """Compare two parsed rounds. Returns the verdict dict:
+    ``status`` is ``ok`` | ``regression`` | ``unmeasurable``; ``fields``
+    holds per-metric before/after/delta; ``regressions`` the offenders."""
+    for side, name in ((prev, "previous"), (cur, "current")):
+        if side.get("tunnel_healthy") is False:
+            return {"status": "unmeasurable",
+                    "why": f"the {name} round's tunnel-health probe "
+                           f"failed — it measured a degraded "
+                           f"environment, not the engine",
+                    "fields": {}, "regressions": []}
+    fields = {}
+    regressions = []
+    for name, direction in METRICS.items():
+        a, b = prev.get(name), cur.get(name)
+        if not isinstance(a, (int, float)) or \
+                not isinstance(b, (int, float)) or a == 0:
+            continue
+        rel = (b - a) / abs(a)
+        worse = rel > threshold if direction == "down" \
+            else rel < -threshold
+        fields[name] = {"prev": a, "cur": b,
+                        "delta_frac": round(rel, 4),
+                        "direction": direction,
+                        "regressed": worse}
+        if worse:
+            regressions.append(name)
+    return {"status": "regression" if regressions else "ok",
+            "threshold": threshold,
+            "fields": fields,
+            "regressions": regressions}
+
+
+def _round_key(path):
+    m = re.search(r"BENCH_r(\d+)", os.path.basename(path))
+    return (int(m.group(1)) if m else 0, path)
+
+
+def find_rounds(root="."):
+    return sorted(glob.glob(os.path.join(root, "BENCH_r*.json")),
+                  key=_round_key)
+
+
+def render(prev_name, cur_name, verdict):
+    lines = [f"bench diff: {os.path.basename(prev_name)} -> "
+             f"{os.path.basename(cur_name)}  [{verdict['status'].upper()}]"]
+    if verdict.get("why"):
+        lines.append(f"  {verdict['why']}")
+    for name, row in verdict["fields"].items():
+        arrow = "v" if row["delta_frac"] < 0 else "^"
+        flag = "  << REGRESSED" if row["regressed"] else ""
+        lines.append(
+            f"  {name:16s} {row['prev']:>10g} -> {row['cur']:>10g}  "
+            f"{arrow}{abs(row['delta_frac']):.1%}{flag}")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    import argparse
+    p = argparse.ArgumentParser(
+        prog="python -m deepspeed_tpu.telemetry.bench_diff",
+        description="Compare consecutive BENCH_r*.json rounds; exit "
+                    "non-zero when step_ms / tok/s / MFU / "
+                    "input_wait_frac regressed past the threshold")
+    p.add_argument("files", nargs="*",
+                   help="explicit round files (chronological); default: "
+                        "all BENCH_r*.json under --root, last two")
+    p.add_argument("--root", default=".",
+                   help="directory holding the BENCH_r*.json rounds")
+    p.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD,
+                   help=f"relative regression threshold (default "
+                        f"{DEFAULT_THRESHOLD:.0%})")
+    p.add_argument("--all", action="store_true",
+                   help="compare EVERY consecutive pair of the chain, "
+                        "not just the last two")
+    p.add_argument("--strict", action="store_true",
+                   help="treat unmeasurable (tunnel-degraded) rounds as "
+                        "failures instead of skipping them")
+    args = p.parse_args(argv)
+
+    paths = args.files or find_rounds(args.root)
+    rounds = []
+    for path in paths:
+        parsed, note = load_round(path)
+        if parsed is None:
+            print(f"# skipping {os.path.basename(path)}: {note}")
+            continue
+        rounds.append((path, parsed))
+    if len(rounds) < 2:
+        print("bench_diff: need at least two parseable rounds "
+              f"(got {len(rounds)})")
+        return 2
+    pairs = list(zip(rounds, rounds[1:])) if args.all \
+        else [(rounds[-2], rounds[-1])]
+    rc = 0
+    for (pname, prev), (cname, cur) in pairs:
+        verdict = diff_rounds(prev, cur, threshold=args.threshold)
+        print(render(pname, cname, verdict))
+        if verdict["status"] == "regression":
+            rc = 1
+        elif verdict["status"] == "unmeasurable" and args.strict:
+            rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,1388 @@
+"""Fleet flight recorder — cross-rank telemetry aggregation + sentinels.
+
+Every instrument so far — tracer (PR 1), cost explorer (PR 2), health
+observatory (PR 3), goodput ledger (PR 4), serving observatory (PR 9) —
+sees exactly ONE process. The moment the mesh spans hosts, the dominant
+failure modes are *relative*: one straggler host serializing every
+collective, one replica silently diverging, one rank's checkpoint persist
+stalling the manifest barrier. This module is the cross-rank layer, three
+pieces sharing one window clock:
+
+* **Per-rank shipping** (:class:`FleetShipper`): EVERY process writes
+  rank-tagged window records into a shared run directory —
+  ``<run_dir>/rank_00007/win_00000042.json`` — using the PR-7
+  tmp+fsync+atomic-rename discipline, so the aggregator never reads a
+  torn file (``*.tmp.*`` siblings are invisible to the scanner). A record
+  is pure host data: window wall time, per-step wall stats, input-wait /
+  checkpoint seconds, the goodput ledger's category breakdown (exact
+  integer microseconds — ``sum(categories_us) == wall_us`` BY
+  CONSTRUCTION, the residual is computed, never measured), the last
+  health sample, recent serving SLO windows when a serving engine runs in
+  this process, and the desync checksum rows. Shipping happens on a
+  background writer thread (``suppress_attribution`` — the PR-5
+  discipline — and never a device handle, so the shipper thread can
+  neither skew the ledger nor sync the device); the hot loop pays two
+  clock reads and a dict update per step.
+
+* **Rank-0 aggregation + sentinels** (:class:`FleetMonitor`): merges
+  windows across ranks (join key = the per-rank window sequence number,
+  identical across ranks because every rank ships at the same step
+  cadence) and runs the cross-rank rules —
+
+  ======================== ================================================
+  ``step_time_skew``       straggler attribution: in a synchronous data-
+                           parallel step every rank waits for the slowest,
+                           so ``(slow-fast)/slow`` of fleet step time is
+                           straggler-induced badput ≈ what the fast ranks
+                           book as collective wait. Names the slow rank
+                           AND what that rank's own ledger says it was
+                           doing (input_wait -> input-bound host;
+                           device_compute -> genuinely slow chip).
+  ``input_wait_skew``      one rank's input pipeline starving while the
+                           others overlap fine (a per-host storage/DNS
+                           problem, invisible in any single-rank ledger).
+  ``checkpoint_persist_skew`` one rank's persist dominating the save: the
+                           PR-7 manifest waits for every rank's shard
+                           files, so the slowest persist gates the tag.
+  ``desync``               the **desync sentinel** (critical): per-bucket
+                           parameter checksums disagree across data-
+                           parallel replicas — silent divergence, with
+                           module-bucket provenance (the PR-3
+                           ``build_bucket_spec`` buckets).
+  ======================== ================================================
+
+  Escalation is the established protocol: one warning log per rule →
+  throttled ``FLEET_HEALTH.json`` snapshot (forced for first-time rules)
+  → trace-flush hook + ``fleet_anomalies_total{rule=...}``.
+
+* **Flight recorder**: ``engine.fleet_report(write=True)`` and the CLI
+  (``--render`` / ``--demo`` / ``--aggregate`` / ``--merge-traces``)
+  produce the unified artifact; ``merge_traces`` concatenates per-rank
+  Chrome traces into one file with per-rank *process* lanes (the Tracer's
+  process-label metadata keeps rank identity through the merge).
+
+The desync checksum itself is traced device code (one cheap reduction per
+module bucket, per-replica rows extracted via ``shard_map`` on the data
+axis); it lives in :func:`build_desync_checksum_fn` behind a
+function-local jax import. Everything else in this module is **pure host
+bookkeeping** — no jax import at module scope (statically guarded in
+tests/perf/telemetry_overhead.py, the serving_observatory pattern), so
+the shipper cannot add device syncs to any step.
+
+CLI: ``python -m deepspeed_tpu.telemetry.fleet --render FLEET_HEALTH.json``
+pretty-prints a snapshot; ``--demo`` runs the committed-example scenario
+(one real dp=8 engine rank with an injected 20 ms input stall and a
+perturbed replica + three subprocess-simulated ranks) and writes the
+repo-root ``FLEET_HEALTH.json``.
+"""
+
+import json
+import os
+import threading
+import time
+import weakref
+from collections import deque
+
+from deepspeed_tpu.telemetry.health import build_bucket_spec, json_safe
+from deepspeed_tpu.telemetry.ledger import suppress_attribution
+from deepspeed_tpu.utils.logging import logger
+
+FLEET_SCHEMA = "deepspeed_tpu.fleet_health/1"
+RECORD_SCHEMA = "deepspeed_tpu.fleet_record/1"
+
+# categories a rank record may carry (the goodput ledger's, as exact
+# integer microseconds); kept as a local tuple so this module never
+# imports the ledger's jnp-adjacent machinery at record-read time
+RECORD_CATEGORIES = (
+    "device_compute", "compile", "input_wait", "host_dispatch",
+    "checkpoint_save", "checkpoint_load", "eval", "overflow_skipped",
+    "unattributed",
+)
+_GOOD_CATEGORIES = frozenset({"device_compute", "host_dispatch"})
+
+RULE_SEVERITY = {
+    "desync": "critical",
+    "step_time_skew": "warning",
+    "input_wait_skew": "warning",
+    "checkpoint_persist_skew": "warning",
+}
+_SEVERITY_ORDER = ("critical", "warning", "watch")
+
+_TMP_MARK = ".tmp."          # the checkpoint_io sibling-marker convention
+_RANK_DIR_FMT = "rank_{:05d}"
+_WIN_FILE_FMT = "win_{:08d}.json"
+
+
+def _fsync_dir(dirname):
+    """Durability for the rename itself (best-effort — mirrors
+    checkpoint_io._fsync_dir, re-implemented here because checkpoint_io
+    imports jax at module scope and this module must stay host-only)."""
+    try:
+        fd = os.open(dirname or ".", os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write_bytes(path, payload):
+    """tmp sibling + fsync + atomic rename (+ dir fsync): a reader sees
+    the file COMPLETE or not at all; a kill mid-write strands only a
+    ``*.tmp.<pid>`` sibling every scanner here ignores."""
+    tmp = f"{path}{_TMP_MARK}{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+    _fsync_dir(os.path.dirname(path))
+
+
+class _NullTimer:
+    """Shared no-op context for the disabled shipper (the hot path)."""
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_TIMER = _NullTimer()
+
+
+class _CatTimer:
+    """Times one interval into a shipper category accumulator (µs)."""
+    __slots__ = ("_acc", "_cat", "_t0")
+
+    def __init__(self, acc, cat):
+        self._acc = acc
+        self._cat = cat
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        us = int((time.perf_counter() - self._t0) * 1e6)
+        if us > 0:
+            self._acc[self._cat] += us
+        return False
+
+
+class _WriterState:
+    """Everything the background writer thread may touch. The thread
+    holds ONLY this object (never the shipper), so an abandoned shipper
+    is reclaimed by GC via weakref.finalize — the PR-5/PR-7 thread
+    discipline. ``busy`` is True from dequeue to write-complete, so
+    ``drain`` means durably-on-disk, not merely queue-empty."""
+    __slots__ = ("queue", "cond", "stopped", "busy", "errors", "warned")
+
+    def __init__(self):
+        self.queue = deque()
+        self.cond = threading.Condition()
+        self.stopped = False
+        self.busy = False
+        self.errors = 0
+        self.warned = False
+
+
+def _writer_loop(state):
+    # shipping must never book wall time into the (thread-local muted)
+    # ledger: the writer's seconds are overlapped, not the train loop's
+    with suppress_attribution():
+        while True:
+            with state.cond:
+                state.busy = False
+                state.cond.notify_all()
+                while not state.queue and not state.stopped:
+                    state.cond.wait(timeout=0.5)
+                if not state.queue and state.stopped:
+                    return
+                path, payload = state.queue.popleft()
+                state.busy = True
+            try:
+                atomic_write_bytes(path, payload)
+            except Exception as e:       # forensics must never kill a run
+                state.errors += 1
+                if not state.warned:
+                    state.warned = True
+                    logger.warning("[fleet] background ship failed: %s", e)
+
+
+def _finalize_writer(state, thread):
+    with state.cond:
+        state.stopped = True
+        state.cond.notify_all()
+    if thread.is_alive():
+        thread.join(timeout=5.0)
+
+
+class FleetShipper:
+    """Per-rank window-record shipper (pure host bookkeeping).
+
+    The engine drives it: ``note_step_time`` every global step (two clock
+    reads), ``time_category`` around input-wait / checkpoint intervals on
+    ranks that have no goodput ledger, and ``tick`` at the fleet cadence
+    — which builds one record from whatever sources this rank has (the
+    attached ledger's category diff when present, the shipper's own
+    accumulators otherwise) and ships it atomically into
+    ``<run_dir>/rank_XXXXX/``.
+
+    Exactness contract: every duration in a record is an integer
+    microsecond count, and when the ledger is attached the categories are
+    diffs of its totals with ``unattributed`` recomputed as the residual,
+    so ``sum(categories_us.values()) == wall_us`` holds EXACTLY per
+    window and per-rank sums re-add exactly across windows (the PR-4 /
+    PR-9 sum-by-construction discipline, now integer-valued so there is
+    no float drift across files)."""
+
+    def __init__(self, run_dir, rank, job_name="", background=True,
+                 serving_ring=8, enabled=True, log_fn=None):
+        self.enabled = bool(enabled)
+        self.rank = int(rank)
+        self.windows_shipped = 0
+        if not self.enabled:
+            return
+        self.run_dir = run_dir
+        self.job_name = job_name
+        self.rank_dir = os.path.join(run_dir, _RANK_DIR_FMT.format(self.rank))
+        os.makedirs(self.rank_dir, exist_ok=True)
+        # an elastically-resumed rank continues its window sequence
+        # instead of overwriting win_00000000.json onward — the monitor
+        # scans by filename, so a restarted-at-zero shipper would be
+        # invisible behind its own pre-crash files
+        existing = []
+        for f in os.listdir(self.rank_dir):
+            if f.startswith("win_") and f.endswith(".json") \
+                    and _TMP_MARK not in f:
+                try:
+                    existing.append(int(f[4:-5]))
+                except ValueError:
+                    pass
+        if existing:
+            self.windows_shipped = max(existing) + 1
+        self._log = log_fn or logger.warning
+        self._ledger = None
+        self._led_totals = None
+        self._led_elapsed = 0.0
+        self._t_last = time.perf_counter()
+        self._step_sum_us = 0
+        self._step_max_us = 0
+        self._step_n = 0
+        self._acc = {"input_wait": 0, "checkpoint_save": 0}
+        self._skipped_last = 0
+        self._serving = deque(maxlen=max(1, int(serving_ring)))
+        self.ship_errors = 0
+        self._warned_ship = False
+        self._closed = False
+        self._wstate = None
+        self._wthread = None
+        if background:
+            self._wstate = _WriterState()
+            self._wthread = threading.Thread(
+                target=_writer_loop, args=(self._wstate,),
+                name=f"ds-fleet-ship-r{self.rank}", daemon=True)
+            self._wthread.start()
+            self._finalizer = weakref.finalize(
+                self, _finalize_writer, self._wstate, self._wthread)
+
+    # ------------------------------------------------------------- feeding
+    def attach_ledger(self, ledger):
+        """Source the window category breakdown from *ledger* (the rank's
+        goodput ledger) instead of the shipper's own accumulators."""
+        if not self.enabled:
+            return
+        self._ledger = ledger
+        self._led_totals = ledger.totals()
+        self._led_elapsed = ledger.elapsed()
+
+    def note_step_time(self, seconds):
+        """One global step's wall time (the whole ``train_batch``)."""
+        if not self.enabled:
+            return
+        us = int(seconds * 1e6)
+        self._step_sum_us += us
+        if us > self._step_max_us:
+            self._step_max_us = us
+        self._step_n += 1
+
+    def time_category(self, category):
+        """Context manager timing an interval into the shipper's own
+        ``input_wait`` / ``checkpoint_save`` accumulators — the fallback
+        source on ranks whose manager (and therefore ledger) is disabled.
+        The shared no-op when the shipper is disabled."""
+        if not self.enabled or category not in self._acc:
+            return _NULL_TIMER
+        return _CatTimer(self._acc, category)
+
+    def add_category_us(self, category, us):
+        """Book *us* microseconds directly (the subprocess simulator and
+        tests use this; the engine goes through ``time_category``)."""
+        if self.enabled and category in self._acc and us > 0:
+            self._acc[category] += int(us)
+
+    def note_serving_window(self, window):
+        """A closed serving-observatory window (rides along in the next
+        shipped record, bounded ring)."""
+        if self.enabled:
+            self._serving.append(window)
+
+    def has_pending_steps(self):
+        """True when at least one step accumulated since the last ship —
+        the engine's report path skips the desync device fetch when a
+        forced tick would ship nothing anyway."""
+        return self.enabled and self._step_n > 0
+
+    # ------------------------------------------------------------ shipping
+    def tick(self, step, skipped_steps=0, desync=None, health=None,
+             force=False):
+        """Close the current window and ship its record. Returns the
+        record dict, or None when no step completed since the last tick
+        (an empty window carries no information and would desynchronise
+        the cross-rank window join)."""
+        if not self.enabled or self._step_n == 0:
+            return None
+        now = time.perf_counter()
+        categories_us = None
+        goodput_fraction = None
+        if self._ledger is not None and self._ledger.enabled:
+            led_elapsed = self._ledger.elapsed()
+            totals = self._ledger.totals()
+            wall_us = int(round((led_elapsed - self._led_elapsed) * 1e6))
+            categories_us = {
+                c: int(round((totals[c] - self._led_totals.get(c, 0.0))
+                             * 1e6))
+                for c in RECORD_CATEGORIES if c != "unattributed"}
+            # the residual is COMPUTED so the integer sum is exact by
+            # construction (independent rounding may make it a few µs
+            # negative — honest jitter, never drift)
+            categories_us["unattributed"] = \
+                wall_us - sum(categories_us.values())
+            good = sum(categories_us[c] for c in _GOOD_CATEGORIES)
+            goodput_fraction = (round(good / wall_us, 6)
+                                if wall_us > 0 else None)
+            input_wait_us = categories_us["input_wait"]
+            ckpt_us = categories_us["checkpoint_save"]
+            self._led_totals = totals
+            self._led_elapsed = led_elapsed
+        else:
+            wall_us = int(round((now - self._t_last) * 1e6))
+            input_wait_us = self._acc["input_wait"]
+            ckpt_us = self._acc["checkpoint_save"]
+        record = {
+            "schema": RECORD_SCHEMA,
+            "rank": self.rank,
+            "window": self.windows_shipped,
+            "job_name": self.job_name,
+            "end_step": int(step),
+            "steps": self._step_n,
+            "skipped_steps": int(skipped_steps) - self._skipped_last,
+            "wall_us": wall_us,
+            "step_time_us": {"sum": self._step_sum_us,
+                             "max": self._step_max_us,
+                             "count": self._step_n},
+            "input_wait_us": int(input_wait_us),
+            "checkpoint_save_us": int(ckpt_us),
+            "categories_us": categories_us,
+            "goodput_fraction": goodput_fraction,
+            "health": health,
+            "desync": desync,
+            "serving": list(self._serving) or None,
+            "ts": round(time.time(), 3),
+        }
+        if force:
+            record["forced"] = True
+        self._skipped_last = int(skipped_steps)
+        self._step_sum_us = self._step_max_us = self._step_n = 0
+        self._acc = {k: 0 for k in self._acc}
+        self._serving.clear()
+        self._t_last = now
+        self._ship(record)
+        self.windows_shipped += 1
+        return record
+
+    def _ship(self, record):
+        path = os.path.join(self.rank_dir,
+                            _WIN_FILE_FMT.format(record["window"]))
+        try:
+            # serialise on the caller's thread so a non-JSON-able value
+            # surfaces deterministically; the file I/O overlaps
+            payload = json.dumps(json_safe(record), allow_nan=False,
+                                 default=repr).encode()
+        except Exception as e:
+            self.ship_errors += 1
+            if not self._warned_ship:
+                self._warned_ship = True
+                self._log("[fleet] record serialisation failed: %s", e)
+            return
+        if self._wstate is not None and not self._closed:
+            with self._wstate.cond:
+                self._wstate.queue.append((path, payload))
+                self._wstate.cond.notify()
+            return
+        try:
+            atomic_write_bytes(path, payload)
+        except Exception as e:
+            self.ship_errors += 1
+            if not self._warned_ship:
+                self._warned_ship = True
+                self._log("[fleet] ship failed: %s", e)
+
+    def drain(self):
+        """Block until every queued record is durably on disk (queue
+        empty AND the in-flight write, if any, completed — the forced
+        report path polls the monitor right after this)."""
+        if not self.enabled or self._wstate is None:
+            return
+        deadline = time.monotonic() + 10.0
+        with self._wstate.cond:
+            while (self._wstate.queue or self._wstate.busy) \
+                    and time.monotonic() < deadline:
+                self._wstate.cond.wait(timeout=0.1)
+
+    def close(self):
+        if not self.enabled or self._closed:
+            return
+        self._closed = True
+        self.drain()
+        if self._wstate is not None:
+            self._finalizer()
+        self.ship_errors += getattr(self._wstate, "errors", 0) or 0
+
+
+# Process-global shipper handle, mirroring tracer/metrics/ledger: library
+# code with no engine reference (the serving observatory's window close)
+# reaches the live shipper through it. None until an engine installs one.
+_GLOBAL = None
+
+
+def get_shipper():
+    return _GLOBAL
+
+
+def set_shipper(shipper):
+    global _GLOBAL
+    old, _GLOBAL = _GLOBAL, shipper
+    return old
+
+
+def reset_shipper(if_current=None):
+    global _GLOBAL
+    if if_current is None or _GLOBAL is if_current:
+        _GLOBAL = None
+
+
+# --------------------------------------------------------------- desync fn
+
+def build_desync_spec(params, depth=8):
+    """The PR-3 module-bucket spec, reused so desync provenance speaks
+    the same bucket names HEALTH.json does."""
+    return build_bucket_spec(params, depth=depth)
+
+
+def build_desync_checksum_fn(mesh, spec, axis="data"):
+    """Traced per-replica per-bucket parameter checksum.
+
+    Returns a jitted ``fn(params) -> f32[dp, n_buckets]`` where row ``i``
+    is data-parallel replica ``i``'s LOCAL checksum of each module
+    bucket: ``sum(x) + sum(x*x)`` over the bucket's leaves in fp32 — a
+    cheap projection, not a cryptographic hash, but identical replicas
+    running identical programs produce bit-identical rows, so ANY
+    cross-row difference is real divergence. ``shard_map`` with
+    replicated in_specs makes each device reduce its OWN buffer (exactly
+    what a replicated-in-name-only param tree breaks), and
+    ``out_specs=P(axis)`` stacks the per-replica rows.
+
+    jax is imported inside this function on purpose: the rest of this
+    module is statically host-only (see telemetry_overhead.py's guard)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from deepspeed_tpu.utils.jax_compat import get_shard_map
+    shard_map, smap_kw = get_shard_map()
+    n = len(spec.names)
+    leaf_buckets = spec.leaf_buckets
+
+    def body(params):
+        leaves = jax.tree_util.tree_leaves(params)
+        assert len(leaves) == len(leaf_buckets), (
+            f"desync spec built for {len(leaf_buckets)} leaves but the "
+            f"param tree has {len(leaves)}")
+        sums = [jnp.float32(0.0)] * n
+        for leaf, b in zip(leaves, leaf_buckets):
+            x = leaf.astype(jnp.float32)
+            sums[b] = sums[b] + jnp.sum(x) + jnp.sum(x * x)
+        return jnp.stack(sums)[None, :]      # local [1, B] row
+
+    smap = functools.partial(shard_map, mesh=mesh)
+    fn = smap(body, in_specs=(P(),), out_specs=P(axis), **smap_kw)
+    return jax.jit(fn)
+
+
+# ---------------------------------------------------------------- monitor
+
+class FleetMonitor:
+    """Rank-0 cross-rank aggregator + sentinels. See module docstring.
+
+    Pure host file I/O: ``poll()`` scans the run directory for new rank
+    records (incremental — each rank directory remembers how many window
+    files it has consumed), judges every window index all known ranks
+    have shipped, and runs the skew/desync rules on the merged view.
+    ``force=True`` (the report path) also judges windows some ranks have
+    not shipped yet, marking them partial."""
+
+    SNAPSHOT_MIN_INTERVAL_S = 5.0
+    MAX_ANOMALY_HISTORY = 100
+    MIN_SKEW_RANKS = 2
+
+    def __init__(self, run_dir, job_name="", snapshot_path=None,
+                 step_time_skew_frac=0.25, input_wait_skew_frac=0.25,
+                 checkpoint_skew_frac=0.5, checkpoint_skew_floor_ms=50.0,
+                 warmup_windows=1, window_ring=128,
+                 registry=None, on_escalate=None, log_fn=None):
+        self.run_dir = run_dir
+        self.job_name = job_name
+        if snapshot_path is None:
+            # NEVER default into the current directory: an anomaly-firing
+            # monitor (e.g. a unit test) running from the repo root would
+            # silently overwrite the committed FLEET_HEALTH.json example —
+            # the PR-4 GOODPUT clobber, which DID recur here before this
+            # default was moved next to the run dir it aggregates
+            snapshot_path = os.path.join(run_dir, "FLEET_HEALTH.json")
+        self.snapshot_path = snapshot_path
+        self.step_time_skew_frac = float(step_time_skew_frac)
+        self.input_wait_skew_frac = float(input_wait_skew_frac)
+        self.checkpoint_skew_frac = float(checkpoint_skew_frac)
+        self.checkpoint_skew_floor_us = float(checkpoint_skew_floor_ms) * 1e3
+        self.warmup_windows = int(warmup_windows)
+        self.registry = registry
+        self.on_escalate = on_escalate
+        self._log = log_fn or logger.warning
+
+        self._rank_next = {}          # rank -> next window index to read
+        self._pending = {}            # window idx -> {rank: record}
+        self._judged = set()
+        self.windows = deque(maxlen=max(1, int(window_ring)))
+        self.windows_dropped = 0
+        self.rank_totals = {}         # rank -> exact integer sums
+        self.anomalies = []
+        self.rule_counts = {}
+        self.records_loaded = 0
+        self.late_records = 0
+        self._warned_late = False
+        self.windows_judged = 0
+        self.desync_checks = 0
+        self.desync_mismatches = 0
+        self.last_desync = None
+        self._snapshots_written = 0
+        self._last_snapshot_t = float("-inf")
+
+    @classmethod
+    def from_config(cls, tconfig, run_dir, output_path="telemetry/",
+                    job_name="", registry=None, on_escalate=None):
+        """Build from a parsed ``DeepSpeedTelemetryConfig``'s ``fleet_*``
+        fields."""
+        snap = getattr(tconfig, "fleet_snapshot_file", "") \
+            or "FLEET_HEALTH.json"
+        if not os.path.isabs(snap):
+            snap = os.path.join(output_path or ".", snap)
+        return cls(
+            run_dir=run_dir,
+            job_name=job_name,
+            snapshot_path=snap,
+            step_time_skew_frac=getattr(
+                tconfig, "fleet_step_time_skew_frac", 0.25),
+            input_wait_skew_frac=getattr(
+                tconfig, "fleet_input_wait_skew_frac", 0.25),
+            checkpoint_skew_frac=getattr(
+                tconfig, "fleet_checkpoint_skew_frac", 0.5),
+            checkpoint_skew_floor_ms=getattr(
+                tconfig, "fleet_checkpoint_skew_floor_ms", 50.0),
+            warmup_windows=getattr(tconfig, "fleet_warmup_windows", 1),
+            window_ring=getattr(tconfig, "fleet_window_ring", 128),
+            registry=registry, on_escalate=on_escalate)
+
+    # ------------------------------------------------------------ scanning
+    def scan(self):
+        """Incrementally load new rank records from the run directory.
+
+        Each rank's records are probed SEQUENTIALLY (``win_%08d`` —
+        every shipper writes its windows in FIFO order through one
+        writer, and an elastic resume continues the numbering), so a
+        poll costs O(new files), not O(all files ever written): the
+        per-rank cursor is one integer, and the only directory listing
+        is the run dir itself (O(ranks)). Torn/half-written files can
+        never be seen (atomic renames); a record that fails to parse is
+        logged and skipped — one bad record must not blind the fleet."""
+        try:
+            names = sorted(os.listdir(self.run_dir))
+        except OSError:
+            return 0
+        loaded = 0
+        for name in names:
+            if not name.startswith("rank_"):
+                continue
+            rank_dir = os.path.join(self.run_dir, name)
+            if not os.path.isdir(rank_dir):
+                continue
+            try:
+                rank = int(name.split("_", 1)[1])
+            except ValueError:
+                continue
+            nxt = self._rank_next.setdefault(rank, 0)
+            while True:
+                path = os.path.join(rank_dir, _WIN_FILE_FMT.format(nxt))
+                if not os.path.isfile(path):
+                    break
+                nxt += 1
+                try:
+                    with open(path) as f:
+                        rec = json.load(f)
+                except Exception as e:
+                    self._log("[fleet] unreadable record %s: %s",
+                              path, e)
+                    continue
+                self._ingest(rank, rec)
+                loaded += 1
+            self._rank_next[rank] = nxt
+        return loaded
+
+    def _ingest(self, rank, rec):
+        self.records_loaded += 1
+        idx = int(rec.get("window", -1))
+        if idx < 0:
+            return
+        if idx in self._judged:
+            # the window was already judged (force-judged partial, or a
+            # rank's directory appeared late) — folding the record in
+            # now would desynchronise the per-rank totals from the
+            # merged window ring, breaking the exact re-add invariant
+            # the artifact pin enforces. Count it instead of hiding it.
+            self.late_records += 1
+            if not self._warned_late:
+                self._warned_late = True
+                self._log("[fleet] rank %s shipped window %s after it "
+                          "was judged (forced report or late-joining "
+                          "rank); counting as late_records", rank, idx)
+            return
+        self._pending.setdefault(idx, {})[rank] = rec
+
+    # ------------------------------------------------------------- judging
+    # a rank this many windows behind the newest pending one is treated
+    # as a straggler/dead host: its window is judged partial rather than
+    # letting one silent rank blind every live rule forever
+    STRAGGLER_GRACE_WINDOWS = 2
+
+    def poll(self, force=False):
+        """Scan + judge. Returns the number of windows judged.
+
+        A window is judged once every known rank has shipped it; a rank
+        that falls ``STRAGGLER_GRACE_WINDOWS`` behind the newest pending
+        window stops being waited for (judged partial) — a dead host
+        must not disable the very sentinels that exist to catch it.
+        ``force=True`` (the report path) judges everything pending."""
+        self.scan()
+        known = set(self._rank_next)
+        newest = max(self._pending, default=-1)
+        judged = 0
+        for idx in sorted(self._pending):
+            if idx in self._judged:
+                continue
+            recs = self._pending[idx]
+            complete = known and set(recs) >= known
+            if not complete and not force and \
+                    newest - idx < self.STRAGGLER_GRACE_WINDOWS:
+                # wait (briefly) for the stragglers' files — judging
+                # early would bias every skew rule toward whoever ships
+                # fastest
+                break
+            self._judge(idx, recs, partial=not complete)
+            judged += 1
+        for idx in list(self._pending):
+            if idx in self._judged:
+                del self._pending[idx]
+        return judged
+
+    def _accumulate_totals(self, rank, rec):
+        """Per-rank exact integer sums — accumulated at JUDGE time from
+        the records actually merged into the window ring, so the
+        report's totals and its windows re-add exactly by construction
+        on every path (live cadence, forced report, partial judges)."""
+        tot = self.rank_totals.setdefault(rank, {
+            "windows": 0, "steps": 0, "skipped_steps": 0, "wall_us": 0,
+            "step_time_us": 0, "input_wait_us": 0, "checkpoint_save_us": 0,
+            "categories_us": {},
+        })
+        tot["windows"] += 1
+        tot["steps"] += int(rec.get("steps", 0))
+        tot["skipped_steps"] += int(rec.get("skipped_steps", 0))
+        tot["wall_us"] += int(rec.get("wall_us", 0))
+        st = rec.get("step_time_us") or {}
+        tot["step_time_us"] += int(st.get("sum", 0))
+        tot["input_wait_us"] += int(rec.get("input_wait_us", 0))
+        tot["checkpoint_save_us"] += int(rec.get("checkpoint_save_us", 0))
+        cats = rec.get("categories_us")
+        if cats:
+            for c, v in cats.items():
+                tot["categories_us"][c] = \
+                    tot["categories_us"].get(c, 0) + int(v)
+
+    def _judge(self, idx, recs, partial=False):
+        self._judged.add(idx)
+        self.windows_judged += 1
+        per_rank = {}
+        for rank, rec in sorted(recs.items()):
+            self._accumulate_totals(rank, rec)
+            per_rank[str(rank)] = {
+                "end_step": rec.get("end_step"),
+                "steps": rec.get("steps"),
+                "skipped_steps": rec.get("skipped_steps", 0),
+                "wall_us": rec.get("wall_us"),
+                "step_time_us": rec.get("step_time_us"),
+                "input_wait_us": rec.get("input_wait_us"),
+                "checkpoint_save_us": rec.get("checkpoint_save_us"),
+                "categories_us": rec.get("categories_us"),
+                "goodput_fraction": rec.get("goodput_fraction"),
+            }
+        window = {
+            "index": idx,
+            "end_step": max((r.get("end_step") or 0)
+                            for r in recs.values()),
+            "ranks": sorted(recs),
+            "per_rank": per_rank,
+            "skew": self._skew_view(recs),
+        }
+        if partial:
+            window["partial"] = True
+        if len(self.windows) == self.windows.maxlen:
+            self.windows_dropped += 1
+        self.windows.append(window)
+        anoms = []
+        # the desync sentinel is a CORRECTNESS check — it never warms up
+        anoms += self._check_desync(idx, recs, window)
+        if self.windows_judged > self.warmup_windows:
+            anoms += self._check_skew(idx, recs, window)
+        self._publish(window)
+        if anoms:
+            self._escalate(anoms)
+
+    @staticmethod
+    def _mean_step_us(rec):
+        st = rec.get("step_time_us") or {}
+        n = int(st.get("count", 0))
+        return (st.get("sum", 0) / n) if n else None
+
+    def _skew_view(self, recs):
+        """The merged window's cross-rank extremes (always recorded, so
+        the artifact shows the skew trajectory, not just firings)."""
+        view = {}
+        means = {r: m for r, rec in recs.items()
+                 if (m := self._mean_step_us(rec)) is not None}
+        if len(means) >= 2:
+            slow = max(means, key=means.get)
+            fast = min(means, key=means.get)
+            view["step_time"] = {
+                "slow_rank": slow, "fast_rank": fast,
+                "slow_mean_us": round(means[slow], 1),
+                "fast_mean_us": round(means[fast], 1),
+                "skew_frac": round(
+                    (means[slow] - means[fast]) / means[slow], 4)
+                if means[slow] > 0 else 0.0,
+            }
+        iw = {r: rec.get("input_wait_us", 0) / rec["wall_us"]
+              for r, rec in recs.items() if rec.get("wall_us")}
+        if len(iw) >= 2:
+            hi, lo = max(iw, key=iw.get), min(iw, key=iw.get)
+            view["input_wait"] = {
+                "max_rank": hi, "max_frac": round(iw[hi], 4),
+                "min_rank": lo, "min_frac": round(iw[lo], 4),
+            }
+        ck = {r: int(rec.get("checkpoint_save_us", 0))
+              for r, rec in recs.items()}
+        if any(ck.values()):
+            hi, lo = max(ck, key=ck.get), min(ck, key=ck.get)
+            view["checkpoint_save"] = {
+                "max_rank": hi, "max_us": ck[hi],
+                "min_rank": lo, "min_us": ck[lo],
+            }
+        return view
+
+    @staticmethod
+    def _dominant_badput(rec):
+        cats = rec.get("categories_us")
+        if not cats:
+            return None
+        bad = {c: v for c, v in cats.items() if c not in _GOOD_CATEGORIES}
+        if not bad or all(v <= 0 for v in bad.values()):
+            return None
+        return max(bad, key=bad.get)
+
+    def _check_skew(self, idx, recs, window):
+        anoms = []
+        if len(recs) < self.MIN_SKEW_RANKS:
+            return anoms
+        step = window["end_step"]
+        st = window["skew"].get("step_time")
+        if st and st["skew_frac"] > self.step_time_skew_frac:
+            dom = self._dominant_badput(recs[st["slow_rank"]])
+            dom_txt = (f"; rank {st['slow_rank']}'s own ledger books the "
+                       f"window dominantly as {dom}" if dom
+                       else "; no per-category ledger on that rank — "
+                            "likely device-side (collective/compute)")
+            anoms.append({
+                "rule": "step_time_skew", "step": step, "window": idx,
+                "severity": RULE_SEVERITY["step_time_skew"],
+                "slow_rank": int(st["slow_rank"]),
+                "fast_rank": int(st["fast_rank"]),
+                "slow_mean_us": st["slow_mean_us"],
+                "fast_mean_us": st["fast_mean_us"],
+                "badput_share": st["skew_frac"],
+                "slow_rank_dominant_badput": dom,
+                "detail": (
+                    f"rank {st['slow_rank']} is the straggler: mean step "
+                    f"{st['slow_mean_us'] / 1e3:.1f} ms vs fastest rank "
+                    f"{st['fast_rank']}'s {st['fast_mean_us'] / 1e3:.1f} "
+                    f"ms — in a synchronous step every other rank waits, "
+                    f"so ~{st['skew_frac']:.0%} of fleet step time is "
+                    f"straggler-induced collective wait" + dom_txt)})
+        iw = window["skew"].get("input_wait")
+        if iw and (iw["max_frac"] - iw["min_frac"]
+                   > self.input_wait_skew_frac):
+            anoms.append({
+                "rule": "input_wait_skew", "step": step, "window": idx,
+                "severity": RULE_SEVERITY["input_wait_skew"],
+                "rank": int(iw["max_rank"]),
+                "max_frac": iw["max_frac"], "min_frac": iw["min_frac"],
+                "detail": (
+                    f"rank {iw['max_rank']} spent {iw['max_frac']:.0%} of "
+                    f"the window blocked on input while rank "
+                    f"{iw['min_rank']} spent {iw['min_frac']:.0%} — a "
+                    f"per-host input problem (storage, network, collate), "
+                    f"invisible in any single-rank ledger")})
+        ck = window["skew"].get("checkpoint_save")
+        if ck and ck["max_us"] >= self.checkpoint_skew_floor_us \
+                and ck["max_us"] > 0 \
+                and (ck["max_us"] - ck["min_us"]) / ck["max_us"] \
+                > self.checkpoint_skew_frac:
+            anoms.append({
+                "rule": "checkpoint_persist_skew", "step": step,
+                "window": idx,
+                "severity": RULE_SEVERITY["checkpoint_persist_skew"],
+                "rank": int(ck["max_rank"]),
+                "max_us": ck["max_us"], "min_us": ck["min_us"],
+                "detail": (
+                    f"rank {ck['max_rank']} spent "
+                    f"{ck['max_us'] / 1e3:.0f} ms in checkpoint_save this "
+                    f"window vs {ck['min_us'] / 1e3:.0f} ms on rank "
+                    f"{ck['min_rank']} — the manifest waits for every "
+                    f"rank's shard files, so the slowest persist gates "
+                    f"the whole tag")})
+        return anoms
+
+    def _check_desync(self, idx, recs, window):
+        """Compare parameter checksum rows across every replica that
+        shipped one this window (rows within one record are the
+        single-process virtual-mesh dp path; rows across records are the
+        multi-process path). Mismatch = silent divergence, critical."""
+        groups = {}          # bucket_names tuple -> [(rank, replica, row)]
+        for rank, rec in recs.items():
+            d = rec.get("desync")
+            if not d:
+                continue
+            names = tuple(d.get("bucket_names") or ())
+            for rep in d.get("replicas") or []:
+                rep_idx, values = rep[0], rep[1]
+                groups.setdefault(names, []).append(
+                    (rank, int(rep_idx), list(values)))
+        anoms = []
+        checked = False
+        for names, rows in groups.items():
+            if len(rows) < 2 or not names:
+                continue
+            checked = True
+            self.desync_checks += 1
+            mismatched = []
+            ambiguous = False
+            for j, bucket in enumerate(names):
+                vals = {}
+                for rank, rep, values in rows:
+                    vals.setdefault(repr(values[j]), []).append(
+                        (rank, rep))
+                if len(vals) <= 1:
+                    continue
+                by_size = sorted(vals.values(), key=len, reverse=True)
+                if len(by_size[0]) == len(by_size[1]):
+                    # even split (e.g. dp=2): there IS no majority —
+                    # naming one side would deterministically blame
+                    # whichever replica happened to hash second, and an
+                    # operator restoring 'the healthy one' could keep
+                    # the corrupt one. List every split replica instead.
+                    ambiguous = True
+                    outliers = [rr for v in vals.values() for rr in v]
+                else:
+                    majority = by_size[0]
+                    outliers = [rr for v in vals.values()
+                                if v is not majority for rr in v]
+                mismatched.append((bucket, outliers))
+            self.last_desync = {
+                "window": idx,
+                "replicas": len(rows),
+                "buckets": list(names),
+                "mismatch_buckets": [b for b, _ in mismatched],
+            }
+            window["desync"] = self.last_desync
+            if not mismatched:
+                continue
+            self.desync_mismatches += 1
+            buckets = [b for b, _ in mismatched]
+            outliers = sorted({rr for _, out in mismatched
+                               for rr in out})
+            who = ", ".join(f"rank {r} replica {p}" for r, p in outliers)
+            anoms.append({
+                "rule": "desync", "step": window["end_step"],
+                "window": idx,
+                "severity": RULE_SEVERITY["desync"],
+                "buckets": buckets,
+                "ambiguous": ambiguous,
+                "replicas": [{"rank": int(r), "replica": int(p)}
+                             for r, p in outliers],
+                "detail": (
+                    f"parameter desync: module bucket(s) "
+                    f"{', '.join(buckets)} checksum-diverge across "
+                    f"data-parallel replicas ("
+                    + (f"replicas split EVENLY — cannot attribute which "
+                       f"side diverged; involved: {who}" if ambiguous
+                       else f"outlier {who}")
+                    + ") — the replicas are silently training different "
+                      "models; checkpoint and investigate NOW")})
+        if checked and self.registry is not None:
+            self.registry.counter(
+                "fleet_desync_checks_total",
+                "cross-replica parameter checksum comparisons").inc()
+        return anoms
+
+    # ------------------------------------------------------------ metrics
+    def _publish(self, window):
+        reg = self.registry
+        if reg is None:
+            return
+        reg.gauge("fleet_ranks",
+                  "ranks shipping fleet records").set(
+                      len(self._rank_next))
+        reg.counter("fleet_windows_judged_total",
+                    "cross-rank windows merged and judged").inc()
+        st = window["skew"].get("step_time")
+        if st:
+            reg.gauge("fleet_step_time_skew_frac",
+                      "(slowest-fastest)/slowest mean step time of the "
+                      "last judged window").set(st["skew_frac"])
+
+    # ---------------------------------------------------------- escalation
+    def _escalate(self, anoms):
+        any_first = False
+        for a in anoms:
+            rule = a["rule"]
+            first = rule not in self.rule_counts
+            any_first = any_first or first
+            self.rule_counts[rule] = self.rule_counts.get(rule, 0) + 1
+            self.anomalies.append(a)
+            if first:
+                self._log("[fleet] %s (%s) at step %s: %s — snapshot "
+                          "-> %s", rule, a["severity"], a.get("step"),
+                          a["detail"], self.snapshot_path)
+            if self.registry is not None:
+                self.registry.counter(
+                    "fleet_anomalies_total",
+                    "fleet cross-rank rule firings",
+                    labels={"rule": rule}).inc()
+        del self.anomalies[:-self.MAX_ANOMALY_HISTORY]
+        self.write_snapshot(force=any_first)
+        if self.on_escalate is not None:
+            try:
+                self.on_escalate()
+            except Exception as e:   # forensics must never kill a step
+                logger.warning("[fleet] on_escalate hook failed: %s", e)
+
+    # -------------------------------------------------------------- output
+    def verdict(self):
+        if not self.windows_judged:
+            return "unknown"
+        seen = {RULE_SEVERITY.get(r, "warning") for r in self.rule_counts}
+        for tier in _SEVERITY_ORDER:
+            if tier in seen:
+                return tier
+        return "healthy"
+
+    def report(self):
+        """The full fleet forensics dict (what ``FLEET_HEALTH.json``
+        holds)."""
+        return {
+            "schema": FLEET_SCHEMA,
+            "enabled": True,
+            "job_name": self.job_name,
+            "run_dir": self.run_dir,
+            "verdict": self.verdict(),
+            "rules": {
+                "step_time_skew_frac": self.step_time_skew_frac,
+                "input_wait_skew_frac": self.input_wait_skew_frac,
+                "checkpoint_skew_frac": self.checkpoint_skew_frac,
+                "checkpoint_skew_floor_ms":
+                    self.checkpoint_skew_floor_us / 1e3,
+                "warmup_windows": self.warmup_windows,
+            },
+            "n_ranks": len(self._rank_next),
+            "ranks": {str(r): dict(t, categories_us=dict(
+                t["categories_us"]))
+                for r, t in sorted(self.rank_totals.items())},
+            "counters": {
+                "records_loaded": self.records_loaded,
+                "late_records": self.late_records,
+                "windows_judged": self.windows_judged,
+                "windows_in_ring": len(self.windows),
+                "windows_dropped": self.windows_dropped,
+                "desync_checks": self.desync_checks,
+                "desync_mismatches": self.desync_mismatches,
+                "anomaly_counts": dict(self.rule_counts),
+            },
+            "desync": self.last_desync,
+            "anomalies": list(self.anomalies),
+            "windows": list(self.windows),
+        }
+
+    def write_snapshot(self, path=None, force=False, report=None):
+        """Write ``FLEET_HEALTH.json`` (throttled like every other
+        forensics snapshot; strict JSON via json_safe/allow_nan)."""
+        if not force and (time.monotonic() - self._last_snapshot_t
+                          < self.SNAPSHOT_MIN_INTERVAL_S):
+            return None
+        self._last_snapshot_t = time.monotonic()
+        path = path or self.snapshot_path
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(json_safe(report if report is not None
+                                else self.report()),
+                      f, indent=1, default=repr, allow_nan=False)
+        self._snapshots_written += 1
+        return path
+
+    def close(self):
+        """Final snapshot — only when there is something to explain."""
+        if self.anomalies:
+            self.write_snapshot(force=True)
+
+
+# ------------------------------------------------------------ trace merge
+
+def merge_traces(out_path, trace_paths):
+    """Concatenate per-rank Chrome traces into ONE file with per-rank
+    process lanes: each input file's events are re-pidded to its rank id
+    (parsed from the file's ``process_name`` metadata when the Tracer
+    stamped one, else the file's position), and process_name /
+    process_sort_index metadata keep the lanes labelled and ordered in
+    chrome://tracing / Perfetto."""
+    merged = []
+    for i, path in enumerate(trace_paths):
+        with open(path) as f:
+            doc = json.load(f)
+        events = doc.get("traceEvents", [])
+        rank = i
+        label = None
+        for ev in events:
+            if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                label = (ev.get("args") or {}).get("name")
+                if isinstance(label, str) and label.startswith("rank "):
+                    try:
+                        rank = int(label.split()[1])
+                    except (ValueError, IndexError):
+                        pass
+                break
+        merged.append({"name": "process_name", "ph": "M", "pid": rank,
+                       "args": {"name": label
+                                or f"rank {rank} ({os.path.basename(path)})"
+                                }})
+        merged.append({"name": "process_sort_index", "ph": "M",
+                       "pid": rank, "args": {"sort_index": rank}})
+        for ev in events:
+            if ev.get("ph") == "M" and ev.get("name") in (
+                    "process_name", "process_sort_index"):
+                continue
+            ev = dict(ev)
+            ev["pid"] = rank
+            merged.append(ev)
+    payload = json.dumps({"traceEvents": merged,
+                          "displayTimeUnit": "ms"}).encode()
+    atomic_write_bytes(out_path, payload)
+    return out_path
+
+
+# --------------------------------------------------------------------- CLI
+
+def render(report):
+    """Human-readable rendering of a FLEET_HEALTH.json report dict."""
+    lines = []
+    lines.append(f"fleet verdict: {report.get('verdict', '?').upper()}"
+                 f"  ({report.get('n_ranks', 0)} ranks"
+                 + (f", job {report['job_name']}"
+                    if report.get("job_name") else "") + ")")
+    c = report.get("counters", {})
+    lines.append(f"  windows judged {c.get('windows_judged', 0)} "
+                 f"({c.get('records_loaded', 0)} records), desync checks "
+                 f"{c.get('desync_checks', 0)} "
+                 f"(mismatches {c.get('desync_mismatches', 0)})")
+    for r, t in sorted((report.get("ranks") or {}).items(),
+                       key=lambda kv: int(kv[0])):
+        steps = t.get("steps", 0)
+        mean = (t.get("step_time_us", 0) / steps / 1e3) if steps else 0.0
+        wall = t.get("wall_us", 0)
+        iwf = (t.get("input_wait_us", 0) / wall) if wall else 0.0
+        lines.append(
+            f"  rank {r}: {steps} steps, mean step {mean:.1f} ms, "
+            f"input-wait {iwf:.0%}, checkpoint "
+            f"{t.get('checkpoint_save_us', 0) / 1e3:.0f} ms, "
+            f"{t.get('windows', 0)} windows")
+    for a in report.get("anomalies", []):
+        lines.append(f"  [{a.get('severity', '?'):8s}] step "
+                     f"{a.get('step')}: {a.get('rule')} — "
+                     f"{a.get('detail')}")
+    if not report.get("anomalies"):
+        lines.append("  no fleet anomalies recorded")
+    return "\n".join(lines)
+
+
+def _simulate_rank(args):
+    """Subprocess-writer rank simulator: a REAL FleetShipper driven by a
+    synthetic-but-wall-clock-honest step loop (each step actually sleeps
+    its step time, so window wall / fraction arithmetic stays
+    consistent). The multi-rank e2e tests and the demo use it as the
+    'other hosts' — pure host code, no jax import, sub-second."""
+    sh = FleetShipper(args.run_dir, rank=args.rank, job_name=args.job,
+                      background=False)
+    step_s = args.step_ms / 1e3
+    for w in range(args.windows):
+        for _ in range(args.steps_per_window):
+            t0 = time.perf_counter()
+            time.sleep(step_s)
+            dt = time.perf_counter() - t0
+            sh.note_step_time(dt)
+            if args.input_wait_frac > 0:
+                sh.add_category_us("input_wait",
+                                   int(dt * 1e6 * args.input_wait_frac))
+        if args.ckpt_ms > 0 and w == args.ckpt_window:
+            sh.add_category_us("checkpoint_save", int(args.ckpt_ms * 1e3))
+        sh.tick(step=(w + 1) * args.steps_per_window)
+    sh.close()
+    return 0
+
+
+def _demo(args):
+    """The committed-example scenario: three subprocess-simulated fast
+    ranks (rank 3 with a slow checkpoint persist) + ONE real dp=8
+    virtual-mesh engine as fleet rank 0, whose data iterator carries an
+    injected 20 ms stall (making it both the step-time straggler and the
+    input-wait outlier) and whose Dense_1 parameters get one replica
+    perturbed mid-run (firing the desync sentinel with bucket
+    provenance). All four cross-rank rules fire on real shipped files."""
+    import subprocess
+    import sys
+    import tempfile
+
+    if args.devices:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.devices}")
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    run_dir = args.run_dir or tempfile.mkdtemp(prefix="ds_fleet_demo_")
+    tel_dir = tempfile.mkdtemp(prefix="ds_fleet_demo_tel_")
+    steps, cadence = args.steps, 2
+    windows = steps // cadence
+    # ---- the simulated fast ranks (subprocess writers) ----------------
+    procs = []
+    for rank in (1, 2, 3):
+        cmd = [sys.executable, "-m", "deepspeed_tpu.telemetry.fleet",
+               "--simulate-rank", str(rank), "--run-dir", run_dir,
+               "--windows", str(windows),
+               "--steps-per-window", str(cadence),
+               "--step-ms", "5", "--input-wait-frac", "0.05",
+               "--job", "fleet_demo"]
+        if rank == 3:
+            cmd += ["--ckpt-ms", "250", "--ckpt-window",
+                    str(windows // 2)]
+        procs.append(subprocess.Popen(cmd))
+    for p in procs:
+        assert p.wait(timeout=120) == 0, "rank simulator failed"
+
+    # ---- the real engine (fleet rank 0, the straggler) ----------------
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.simple import SimpleModel, random_dataset, \
+        sample_batch
+    from deepspeed_tpu.utils import groups
+
+    groups.destroy()
+    groups.initialize()
+    hidden = 32
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=hidden, nlayers=2),
+        config={
+            "train_batch_size": 8,
+            "steps_per_print": cadence,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+            "telemetry": {
+                "enabled": True, "trace": False,
+                "jsonl": False, "prometheus": False,
+                "job_name": "fleet_demo",
+                "output_path": tel_dir,
+                "goodput": {"enabled": True, "cadence": cadence,
+                            "profiler_capture": False},
+                # the engine's LIVE monitor snapshots into scratch: the
+                # sim ranks finished long before the engine compiled, so
+                # its live view (correctly) judges their early windows
+                # partial under the straggler grace; the COMMITTED
+                # artifact is the offline post-mortem aggregation below,
+                # where every window is complete
+                "fleet": {"enabled": True, "run_dir": run_dir,
+                          "cadence": cadence, "rank": 0,
+                          "warmup_windows": 1,
+                          "snapshot_file": os.path.join(
+                              tel_dir, "FLEET_HEALTH.live.json")},
+            },
+        },
+        sample_batch=sample_batch(8, hidden))
+    loader = engine.deepspeed_io(random_dataset(64, hidden))
+
+    class _Stall:
+        def __init__(self, it, stall_s):
+            from deepspeed_tpu.runtime.dataloader import RepeatingLoader
+            self._it = RepeatingLoader(it)
+            self.stall_s = stall_s
+
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            time.sleep(self.stall_s)
+            return next(self._it)
+
+    it = _Stall(loader, args.stall_ms / 1e3)
+    for step in range(steps):
+        if step == steps - 2:
+            # silently diverge ONE data-parallel replica of Dense_1: same
+            # logical (replicated) array, one device's buffer perturbed —
+            # exactly the failure the sentinel exists to catch
+            def perturb(path, leaf):
+                if "Dense_1" not in jax.tree_util.keystr(path) \
+                        or getattr(leaf, "ndim", 0) != 2:
+                    return leaf
+                bufs = []
+                for j, d in enumerate(leaf.sharding.mesh.devices.ravel()):
+                    arr = np.array(leaf.addressable_data(j), copy=True)
+                    if j == 3:
+                        arr[0, 0] += 1.0
+                    bufs.append(jax.device_put(arr, d))
+                return jax.make_array_from_single_device_arrays(
+                    leaf.shape, leaf.sharding, bufs)
+            engine.state = engine.state._replace(
+                params=jax.tree_util.tree_map_with_path(
+                    perturb, engine.state.params))
+        engine.train_batch(data_iter=it)
+    engine.close()       # drains the writer: every record is on disk
+    # the flight-recorder post-mortem: a FRESH monitor over the complete
+    # run dir (the --aggregate path) — every window joins all 4 ranks
+    mon = FleetMonitor(run_dir, job_name="fleet_demo",
+                       snapshot_path=os.path.abspath(args.out),
+                       warmup_windows=1)
+    mon.poll(force=True)
+    report = mon.report()
+    mon.write_snapshot(force=True, report=report)
+    print(render(report))
+    print(f"\nwrote {args.out} (run dir: {run_dir})")
+    return 0
+
+
+def main(argv=None):
+    import argparse
+    p = argparse.ArgumentParser(
+        prog="python -m deepspeed_tpu.telemetry.fleet",
+        description="Render a FLEET_HEALTH.json snapshot, aggregate a "
+                    "fleet run directory, merge per-rank Chrome traces, "
+                    "or run the fleet-forensics demo")
+    p.add_argument("--render", metavar="FLEET_HEALTH.json",
+                   help="pretty-print an existing snapshot and exit")
+    p.add_argument("--aggregate", metavar="RUN_DIR",
+                   help="offline aggregation of a fleet run directory")
+    p.add_argument("--merge-traces", nargs="+", metavar="TRACE",
+                   help="merge per-rank Chrome traces (first arg after "
+                        "--merge-out) into one per-rank-process-lane "
+                        "file")
+    p.add_argument("--merge-out", default="fleet_trace.json")
+    p.add_argument("--demo", action="store_true",
+                   help="subprocess-simulated ranks + one real dp=8 "
+                        "engine with an injected straggler stall and a "
+                        "perturbed replica; writes the snapshot")
+    p.add_argument("--simulate-rank", type=int, default=None,
+                   help="(internal) run one subprocess rank simulator")
+    p.add_argument("--run-dir", default=None)
+    p.add_argument("--windows", type=int, default=8)
+    p.add_argument("--steps-per-window", type=int, default=2)
+    p.add_argument("--step-ms", type=float, default=5.0)
+    p.add_argument("--input-wait-frac", type=float, default=0.0)
+    p.add_argument("--ckpt-ms", type=float, default=0.0)
+    p.add_argument("--ckpt-window", type=int, default=0)
+    p.add_argument("--job", default="")
+    p.add_argument("--steps", type=int, default=16)
+    p.add_argument("--stall-ms", type=float, default=20.0)
+    p.add_argument("--devices", type=int, default=8,
+                   help="virtual CPU devices for the demo (0 = existing)")
+    p.add_argument("--out", default="FLEET_HEALTH.json")
+    args = p.parse_args(argv)
+    if args.render:
+        with open(args.render) as f:
+            print(render(json.load(f)))
+        return 0
+    if args.simulate_rank is not None:
+        args.rank = args.simulate_rank
+        assert args.run_dir, "--simulate-rank needs --run-dir"
+        return _simulate_rank(args)
+    if args.aggregate:
+        mon = FleetMonitor(args.aggregate, snapshot_path=args.out)
+        mon.poll(force=True)
+        report = mon.report()
+        print(render(report))
+        mon.write_snapshot(force=True, report=report)
+        print(f"\nwrote {args.out}")
+        return 0
+    if args.merge_traces:
+        out = merge_traces(args.merge_out, args.merge_traces)
+        print(f"merged {len(args.merge_traces)} traces -> {out}")
+        return 0
+    if args.demo:
+        return _demo(args)
+    p.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

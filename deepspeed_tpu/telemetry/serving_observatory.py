@@ -556,6 +556,13 @@ class ServingObservatory:
         self.windows_closed += 1
         self._queue_means.append(window["queue_depth"]["mean"])
         self._publish(window)
+        # fleet flight recorder: when this process also ships fleet
+        # records, closed serving SLO windows ride along in the next
+        # rank record (fleet.py is host-only, so this stays device-free)
+        from deepspeed_tpu.telemetry import fleet as _fleet_mod
+        shipper = _fleet_mod.get_shipper()
+        if shipper is not None:
+            shipper.note_serving_window(window)
         # reset BEFORE the rules run: escalation re-enters report() (the
         # snapshot), and report() force-closes any partial window — with
         # the accumulators still live it would ring-append the window

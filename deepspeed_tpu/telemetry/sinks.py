@@ -68,8 +68,19 @@ def _labels_str(labels, extra=None):
     return "{" + inner + "}"
 
 
+SUMMARY_QUANTILES = (0.5, 0.9, 0.99)
+
+
 def render_prometheus(registry):
-    """Render *registry* in the Prometheus text exposition format v0.0.4."""
+    """Render *registry* in the Prometheus text exposition format v0.0.4.
+
+    Histograms additionally render a sibling ``<name>_summary`` family of
+    TYPE summary carrying p50/p90/p99 estimates
+    (:meth:`~deepspeed_tpu.telemetry.metrics.Histogram.quantile` — linear
+    interpolation inside the bucket), so TTFT / step-time percentiles
+    reach scrape sinks directly instead of living only in the JSON
+    artifacts. Empty histograms render no summary (a quantile of nothing
+    is a lie, not a zero)."""
     lines = []
     for family, ms in sorted(registry.collect().items()):
         name = sanitize_metric_name(family)
@@ -77,6 +88,7 @@ def render_prometheus(registry):
         if help_text:
             lines.append(f"# HELP {name} {escape_help(help_text)}")
         lines.append(f"# TYPE {name} {ms[0].kind}")
+        summaries = []
         for m in ms:
             if isinstance(m, Histogram):
                 cum = m.cumulative_counts()
@@ -89,9 +101,26 @@ def render_prometheus(registry):
                     f"{name}_sum{_labels_str(m.labels)} {_fmt_value(m.sum)}")
                 lines.append(
                     f"{name}_count{_labels_str(m.labels)} {m.count}")
+                if m.count:
+                    summaries.append(m)
             else:
                 lines.append(
                     f"{name}{_labels_str(m.labels)} {_fmt_value(m.value)}")
+        if summaries:
+            sname = f"{name}_summary"
+            lines.append(f"# TYPE {sname} summary")
+            for m in summaries:
+                for q in SUMMARY_QUANTILES:
+                    v = m.quantile(q)
+                    lines.append(
+                        f"{sname}"
+                        f"{_labels_str(m.labels, {'quantile': _fmt_value(q)})}"
+                        f" {_fmt_value(v)}")
+                lines.append(
+                    f"{sname}_sum{_labels_str(m.labels)} "
+                    f"{_fmt_value(m.sum)}")
+                lines.append(f"{sname}_count{_labels_str(m.labels)} "
+                             f"{m.count}")
     return "\n".join(lines) + "\n"
 
 

@@ -72,6 +72,16 @@ class Tracer:
         self._events = []
         self._lock = threading.Lock()
         self._pid = os.getpid()
+        self._process_label = None
+        self._process_sort = None
+
+    def set_process_label(self, name, sort_index=None):
+        """Rank-tag this process's trace: ``export`` will prepend
+        ``process_name`` / ``process_sort_index`` metadata, so per-rank
+        trace files carry their identity and concatenate cleanly into
+        one per-rank-lane view (telemetry/fleet.py's ``merge_traces``)."""
+        self._process_label = str(name)
+        self._process_sort = sort_index
 
     def span(self, name, **args):
         if not self.enabled:
@@ -132,7 +142,17 @@ class Tracer:
 
     def export(self, path):
         """Write the Chrome-trace JSON object format; returns the path."""
-        doc = {"traceEvents": self.events(), "displayTimeUnit": "ms"}
+        events = self.events()
+        if self._process_label is not None:
+            meta = [{"name": "process_name", "ph": "M", "pid": self._pid,
+                     "args": {"name": self._process_label}}]
+            if self._process_sort is not None:
+                meta.append({"name": "process_sort_index", "ph": "M",
+                             "pid": self._pid,
+                             "args": {"sort_index":
+                                      int(self._process_sort)}})
+            events = meta + events
+        doc = {"traceEvents": events, "displayTimeUnit": "ms"}
         if self.dropped:
             doc["metadata"] = {"dropped_events": self.dropped}
         d = os.path.dirname(path)

@@ -1,0 +1,119 @@
+"""Bench regression differ (telemetry/bench_diff.py).
+
+The fast tier-1 self-test the satellite asks for: the differ's rules on
+synthetic rounds, and the COMMITTED BENCH_r*.json chain through the real
+CLI — the default (last-two) comparison must pass, so a regen that
+regresses the trajectory fails tier-1 instead of landing silently; the
+``--all`` sweep must flag the real committed r02 -> r03 regression (the
+tunnel-poisoned round), proving the tool catches exactly the event it
+exists for.
+"""
+
+import json
+import os
+
+from deepspeed_tpu.telemetry import bench_diff
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def _round(step_ms=100.0, tok_s=1000.0, tflops=50.0, mfu=0.5,
+           iw=None, healthy=True):
+    d = {"step_time_ms": step_ms, "tokens_per_s": tok_s, "value": tflops,
+         "mfu": mfu, "tunnel_healthy": healthy}
+    if iw is not None:
+        d["input_wait_frac"] = iw
+    return d
+
+
+class TestDiffRounds:
+    def test_clean_improvement_is_ok(self):
+        v = bench_diff.diff_rounds(_round(), _round(step_ms=90,
+                                                    tok_s=1100))
+        assert v["status"] == "ok" and not v["regressions"]
+
+    def test_step_time_regression_detected(self):
+        v = bench_diff.diff_rounds(_round(), _round(step_ms=120))
+        assert v["status"] == "regression"
+        assert "step_time_ms" in v["regressions"]
+        assert v["fields"]["step_time_ms"]["regressed"] is True
+
+    def test_throughput_regression_detected(self):
+        v = bench_diff.diff_rounds(_round(), _round(tok_s=800, tflops=40,
+                                                    mfu=0.4))
+        assert set(v["regressions"]) == {"tokens_per_s", "value", "mfu"}
+
+    def test_threshold_boundary(self):
+        # 10% exactly does NOT fail (strictly-greater), 10%+eps does
+        v = bench_diff.diff_rounds(_round(step_ms=100),
+                                   _round(step_ms=110))
+        assert v["status"] == "ok"
+        v = bench_diff.diff_rounds(_round(step_ms=100),
+                                   _round(step_ms=111))
+        assert v["status"] == "regression"
+
+    def test_custom_threshold(self):
+        v = bench_diff.diff_rounds(_round(), _round(step_ms=105),
+                                   threshold=0.02)
+        assert v["status"] == "regression"
+
+    def test_input_wait_frac_tracked_when_present(self):
+        v = bench_diff.diff_rounds(_round(iw=0.01), _round(iw=0.4))
+        assert "input_wait_frac" in v["regressions"]
+
+    def test_missing_metrics_skipped_not_failed(self):
+        v = bench_diff.diff_rounds({"step_time_ms": 100,
+                                    "tokens_per_s": None},
+                                   {"step_time_ms": 99})
+        assert v["status"] == "ok"
+        assert set(v["fields"]) == {"step_time_ms"}
+
+    def test_unhealthy_tunnel_is_unmeasurable_not_regression(self):
+        # the BENCH_r03 lesson: a poisoned environment measured the
+        # tunnel, not the engine — that must not read as a code change
+        v = bench_diff.diff_rounds(_round(), _round(step_ms=9000,
+                                                    healthy=False))
+        assert v["status"] == "unmeasurable"
+        assert not v["regressions"]
+
+
+class TestCommittedChain:
+    def test_rounds_discovered_in_order(self):
+        paths = bench_diff.find_rounds(ROOT)
+        names = [os.path.basename(p) for p in paths]
+        assert names == sorted(names)
+        assert "BENCH_r05.json" in names
+
+    def test_seed_round_skipped_gracefully(self):
+        parsed, note = bench_diff.load_round(
+            os.path.join(ROOT, "BENCH_r01.json"))
+        assert parsed is None and note
+
+    def test_latest_two_rounds_do_not_regress(self, capsys):
+        """The committed trajectory's guard: the default CLI run over the
+        repo's own rounds must exit 0 — a regressing regen fails here."""
+        rc = bench_diff.main(["--root", ROOT])
+        out = capsys.readouterr().out
+        assert rc == 0, f"committed bench trajectory regressed:\n{out}"
+        assert "[OK]" in out
+
+    def test_all_sweep_flags_the_real_r02_r03_regression(self, capsys):
+        """r03 IS a regression on disk (the tunnel-poisoned round, no
+        health flag recorded yet) — the sweep must catch it, proving the
+        differ detects exactly the event it exists for."""
+        rc = bench_diff.main(["--all", "--root", ROOT])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "BENCH_r02.json -> BENCH_r03.json  [REGRESSION]" in out
+
+    def test_explicit_files_and_wrapper_format(self, tmp_path, capsys):
+        a = tmp_path / "BENCH_r01.json"
+        b = tmp_path / "BENCH_r02.json"
+        a.write_text(json.dumps({"parsed": _round()}))
+        b.write_text(json.dumps({"parsed": _round(step_ms=130)}))
+        rc = bench_diff.main([str(a), str(b)])
+        assert rc == 1
+        assert "REGRESSED" in capsys.readouterr().out
+
+    def test_too_few_rounds_is_usage_error(self, tmp_path):
+        assert bench_diff.main(["--root", str(tmp_path)]) == 2

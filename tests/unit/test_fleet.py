@@ -1,0 +1,855 @@
+"""Fleet flight recorder (telemetry/fleet.py) — shipper, monitor,
+sentinels, and the ISSUE-11 injection e2es.
+
+The acceptance scenarios live here:
+
+* a rank with an injected 20 ms step stall -> ``step_time_skew`` fires
+  NAMING that rank, its badput share consistent with the goodput
+  ledger's categories (integer sums still exact);
+* a perturbed data-parallel replica -> the desync sentinel fires
+  critical with the correct module-bucket provenance;
+* both through the warn-once -> throttled snapshot -> trace-flush
+  protocol, on REAL shipped files (the multi-rank side is a
+  subprocess-writer simulation — the PR-7 trick for a container whose
+  jax cannot run cross-process collectives).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.telemetry import fleet as fleet_mod
+from deepspeed_tpu.telemetry.fleet import (FleetMonitor, FleetShipper,
+                                           RULE_SEVERITY, merge_traces)
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def _mk_shipper(tmp_path, rank, **kw):
+    kw.setdefault("background", False)
+    return FleetShipper(str(tmp_path), rank=rank, **kw)
+
+
+def _ship_window(sh, steps=2, step_ms=5.0, iw_frac=0.0, ckpt_ms=0.0,
+                 end_step=None, desync=None, sleep=True):
+    for _ in range(steps):
+        if sleep:
+            t0 = time.perf_counter()
+            time.sleep(step_ms / 1e3)
+            dt = time.perf_counter() - t0
+        else:
+            dt = step_ms / 1e3
+        sh.note_step_time(dt)
+        if iw_frac:
+            sh.add_category_us("input_wait", int(dt * 1e6 * iw_frac))
+    if ckpt_ms:
+        sh.add_category_us("checkpoint_save", int(ckpt_ms * 1e3))
+    return sh.tick(step=end_step if end_step is not None
+                   else (sh.windows_shipped + 1) * steps,
+                   desync=desync)
+
+
+# ----------------------------------------------------------------- shipper
+
+class TestShipper:
+    def test_record_lands_atomically_with_schema(self, tmp_path):
+        sh = _mk_shipper(tmp_path, rank=3)
+        rec = _ship_window(sh, steps=2, step_ms=1.0)
+        path = os.path.join(str(tmp_path), "rank_00003",
+                            "win_00000000.json")
+        assert os.path.isfile(path)
+        on_disk = json.load(open(path))
+        assert on_disk["schema"] == "deepspeed_tpu.fleet_record/1"
+        assert on_disk["rank"] == 3 and on_disk["window"] == 0
+        assert on_disk["steps"] == 2
+        assert on_disk["step_time_us"]["count"] == 2
+        assert rec["wall_us"] >= rec["step_time_us"]["sum"] > 0
+        # no stray tmp siblings after the atomic rename
+        assert not [f for f in os.listdir(os.path.dirname(path))
+                    if ".tmp." in f]
+
+    def test_empty_window_ships_nothing(self, tmp_path):
+        sh = _mk_shipper(tmp_path, rank=0)
+        assert sh.tick(step=0) is None
+        assert sh.tick(step=0, force=True) is None
+        assert sh.windows_shipped == 0
+
+    def test_accumulators_reset_between_windows(self, tmp_path):
+        sh = _mk_shipper(tmp_path, rank=0)
+        r1 = _ship_window(sh, steps=3, step_ms=1.0, ckpt_ms=5.0)
+        r2 = _ship_window(sh, steps=1, step_ms=1.0)
+        assert r1["steps"] == 3 and r2["steps"] == 1
+        assert r1["checkpoint_save_us"] >= 5000
+        assert r2["checkpoint_save_us"] == 0
+
+    def test_ledger_categories_sum_exactly_to_wall(self, tmp_path):
+        """With an attached goodput ledger the record's integer
+        categories partition the window wall time EXACTLY (the residual
+        is computed, never measured)."""
+        from deepspeed_tpu.telemetry.ledger import GoodputLedger
+        led = GoodputLedger(enabled=True)
+        sh = _mk_shipper(tmp_path, rank=0)
+        sh.attach_ledger(led)
+        for _ in range(2):
+            with led.attribute("host_dispatch"):
+                with led.attribute("input_wait"):
+                    time.sleep(0.004)
+                time.sleep(0.002)
+            sh.note_step_time(0.006)
+        rec = sh.tick(step=2)
+        cats = rec["categories_us"]
+        assert sum(cats.values()) == rec["wall_us"]
+        assert rec["input_wait_us"] == cats["input_wait"] >= 7000
+        assert cats["host_dispatch"] >= 3000
+        # second window diffs from the ledger snapshot, not from zero
+        with led.attribute("host_dispatch"):
+            time.sleep(0.002)
+        sh.note_step_time(0.002)
+        rec2 = sh.tick(step=3)
+        assert sum(rec2["categories_us"].values()) == rec2["wall_us"]
+        assert rec2["categories_us"]["input_wait"] == 0
+
+    def test_time_category_fallback_without_ledger(self, tmp_path):
+        sh = _mk_shipper(tmp_path, rank=1)
+        with sh.time_category("input_wait"):
+            time.sleep(0.003)
+        with sh.time_category("checkpoint_save"):
+            time.sleep(0.002)
+        sh.note_step_time(0.005)
+        rec = sh.tick(step=1)
+        assert rec["categories_us"] is None
+        assert rec["input_wait_us"] >= 2500
+        assert rec["checkpoint_save_us"] >= 1500
+
+    def test_background_writer_drains_and_joins(self, tmp_path):
+        sh = FleetShipper(str(tmp_path), rank=0, background=True)
+        for _ in range(3):
+            sh.note_step_time(0.001)
+            sh.tick(step=sh.windows_shipped + 1)
+        sh.close()
+        files = os.listdir(os.path.join(str(tmp_path), "rank_00000"))
+        assert len([f for f in files if f.endswith(".json")]) == 3
+        alive = [t for t in threading.enumerate()
+                 if t.name.startswith("ds-fleet-ship")]
+        assert not alive, f"writer thread leaked: {alive}"
+
+    def test_disabled_shipper_is_inert(self, tmp_path):
+        sh = FleetShipper(str(tmp_path), rank=0, enabled=False)
+        sh.note_step_time(1.0)
+        with sh.time_category("input_wait"):
+            pass
+        assert sh.tick(step=1) is None
+        assert not os.path.isdir(os.path.join(str(tmp_path),
+                                              "rank_00000"))
+
+    def test_serving_windows_ride_along(self, tmp_path):
+        sh = _mk_shipper(tmp_path, rank=0)
+        sh.note_serving_window({"index": 0, "tokens": 12})
+        sh.note_step_time(0.001)
+        rec = sh.tick(step=1)
+        assert rec["serving"] == [{"index": 0, "tokens": 12}]
+        sh.note_step_time(0.001)
+        assert sh.tick(step=2)["serving"] is None   # ring cleared
+
+    def test_serving_observatory_ships_closed_windows(self, tmp_path):
+        """The PR-9 observatory's cadence windows reach the fleet record
+        through the process-global shipper (host-only wiring)."""
+        from deepspeed_tpu.telemetry.serving_observatory import \
+            ServingObservatory
+        sh = _mk_shipper(tmp_path, rank=0)
+        old = fleet_mod.set_shipper(sh)
+        try:
+            obs = ServingObservatory(max_batch=2, window=2,
+                                     snapshot_path=str(
+                                         tmp_path / "SH.json"))
+            for _ in range(2):
+                obs.end_step(acts={}, occupied=set(), queue_depth=0,
+                             active=0, kv_occupancy=0.0,
+                             kv_fragmentation=0.0, progress=True)
+            assert len(sh._serving) == 1
+            sh.note_step_time(0.001)
+            rec = sh.tick(step=1)
+            assert rec["serving"][0]["index"] == 0
+        finally:
+            fleet_mod.set_shipper(old)
+
+
+# ----------------------------------------------------------------- monitor
+
+def _write_rank_windows(run_dir, rank, windows, steps=2, step_ms=5.0,
+                        iw_frac=0.0, ckpt_ms_at=None, desync_at=None,
+                        sleep=False):
+    sh = FleetShipper(str(run_dir), rank=rank, background=False)
+    for w in range(windows):
+        _ship_window(
+            sh, steps=steps, step_ms=step_ms, iw_frac=iw_frac,
+            ckpt_ms=(ckpt_ms_at[1] if ckpt_ms_at and w == ckpt_ms_at[0]
+                     else 0.0),
+            end_step=(w + 1) * steps,
+            desync=(desync_at(w) if desync_at else None), sleep=sleep)
+    sh.close()
+    return sh
+
+
+def _desync_block(values_fn, buckets=("Dense_0", "Dense_1"), replicas=2,
+                  step=0):
+    return {"step": step, "bucket_names": list(buckets),
+            "replicas": [[i, values_fn(i)] for i in range(replicas)]}
+
+
+class TestMonitor:
+    def test_merges_by_window_index_and_waits_for_stragglers(
+            self, tmp_path):
+        _write_rank_windows(tmp_path, 0, windows=3)
+        _write_rank_windows(tmp_path, 1, windows=2)
+        mon = FleetMonitor(str(tmp_path), log_fn=lambda *a: None)
+        mon.poll()
+        # window 2 is missing rank 1: not judged without force — judging
+        # early would bias the skew rules toward the fastest shipper
+        assert mon.windows_judged == 2
+        assert [w["index"] for w in mon.windows] == [0, 1]
+        assert mon.windows[0]["ranks"] == [0, 1]
+        mon.poll(force=True)
+        assert mon.windows_judged == 3
+        assert mon.windows[-1].get("partial") is True
+
+    def test_torn_tmp_files_invisible(self, tmp_path):
+        _write_rank_windows(tmp_path, 0, windows=1)
+        rank_dir = os.path.join(str(tmp_path), "rank_00000")
+        with open(os.path.join(rank_dir, "win_00000001.json.tmp.999"),
+                  "w") as f:
+            f.write('{"torn":')          # a crashed writer's leftover
+        mon = FleetMonitor(str(tmp_path), log_fn=lambda *a: None)
+        mon.poll(force=True)
+        assert mon.records_loaded == 1
+
+    def test_step_time_skew_names_the_slow_rank(self, tmp_path):
+        logs = []
+        _write_rank_windows(tmp_path, 0, windows=3, step_ms=5.0)
+        _write_rank_windows(tmp_path, 1, windows=3, step_ms=25.0)
+        _write_rank_windows(tmp_path, 2, windows=3, step_ms=5.0)
+        mon = FleetMonitor(str(tmp_path), warmup_windows=1,
+                           log_fn=lambda msg, *a: logs.append(msg % a))
+        mon.poll()
+        skews = [a for a in mon.anomalies if a["rule"] == "step_time_skew"]
+        assert skews, "injected 20ms straggler must fire step_time_skew"
+        a = skews[0]
+        assert a["slow_rank"] == 1
+        assert a["severity"] == "warning"
+        # 25 vs 5 ms -> ~80% of fleet step time is straggler wait
+        assert 0.7 <= a["badput_share"] <= 0.9
+        assert "rank 1" in a["detail"]
+        # warn-once: two post-warmup firing windows, ONE log line
+        assert len([m for m in logs if "step_time_skew" in m]) == 1
+        assert mon.rule_counts["step_time_skew"] == 2
+
+    def test_skew_respects_warmup(self, tmp_path):
+        _write_rank_windows(tmp_path, 0, windows=2, step_ms=5.0)
+        _write_rank_windows(tmp_path, 1, windows=2, step_ms=25.0)
+        mon = FleetMonitor(str(tmp_path), warmup_windows=2,
+                           log_fn=lambda *a: None)
+        mon.poll()
+        assert not mon.anomalies
+
+    def test_skew_needs_two_ranks(self, tmp_path):
+        _write_rank_windows(tmp_path, 0, windows=3, step_ms=25.0)
+        mon = FleetMonitor(str(tmp_path), log_fn=lambda *a: None)
+        mon.poll()
+        assert not mon.anomalies
+
+    def test_input_wait_skew_names_the_starved_rank(self, tmp_path):
+        _write_rank_windows(tmp_path, 0, windows=3, iw_frac=0.7,
+                            sleep=True)
+        _write_rank_windows(tmp_path, 1, windows=3, iw_frac=0.02,
+                            sleep=True)
+        mon = FleetMonitor(str(tmp_path), warmup_windows=1,
+                           step_time_skew_frac=1.0,   # isolate the rule
+                           log_fn=lambda *a: None)
+        mon.poll()
+        iw = [a for a in mon.anomalies if a["rule"] == "input_wait_skew"]
+        assert iw and iw[0]["rank"] == 0
+        assert iw[0]["max_frac"] > iw[0]["min_frac"]
+
+    def test_checkpoint_skew_floor_and_rank(self, tmp_path):
+        _write_rank_windows(tmp_path, 0, windows=3)
+        _write_rank_windows(tmp_path, 1, windows=3, ckpt_ms_at=(2, 200.0))
+        mon = FleetMonitor(str(tmp_path), warmup_windows=1,
+                           step_time_skew_frac=1.0,
+                           log_fn=lambda *a: None)
+        mon.poll()
+        ck = [a for a in mon.anomalies
+              if a["rule"] == "checkpoint_persist_skew"]
+        assert ck and ck[0]["rank"] == 1
+        assert ck[0]["max_us"] >= 200_000
+        # below the floor nothing fires: a 5 ms persist skew is noise
+        mon2 = FleetMonitor(str(tmp_path / "sub"),
+                            log_fn=lambda *a: None)
+        _write_rank_windows(tmp_path / "sub", 0, windows=3)
+        _write_rank_windows(tmp_path / "sub", 1, windows=3,
+                            ckpt_ms_at=(2, 5.0))
+        mon2.step_time_skew_frac = 1.0
+        mon2.poll()
+        assert not [a for a in mon2.anomalies
+                    if a["rule"] == "checkpoint_persist_skew"]
+
+    def test_desync_within_one_record_virtual_mesh_rows(self, tmp_path):
+        """The single-process virtual-mesh dp path: one rank's record
+        carries all replica rows; a perturbed row fires critical with
+        bucket provenance, and the outlier is majority-voted."""
+        def desync_at(w):
+            def values(i):
+                v = [1.5, 2.5]
+                if w >= 2 and i == 1:
+                    v = [1.5, 99.0]       # replica 1 diverges in Dense_1
+                return v
+            return _desync_block(values, replicas=4, step=(w + 1) * 2)
+        _write_rank_windows(tmp_path, 0, windows=3, desync_at=desync_at)
+        mon = FleetMonitor(str(tmp_path), log_fn=lambda *a: None)
+        mon.poll(force=True)
+        des = [a for a in mon.anomalies if a["rule"] == "desync"]
+        assert des, "perturbed replica must fire the desync sentinel"
+        a = des[0]
+        assert a["severity"] == "critical"
+        assert a["buckets"] == ["Dense_1"]
+        assert a["replicas"] == [{"rank": 0, "replica": 1}]
+        assert mon.desync_checks == 3 and mon.desync_mismatches == 1
+        assert mon.verdict() == "critical"
+
+    def test_desync_across_ranks(self, tmp_path):
+        ok = _desync_block(lambda i: [1.0], buckets=("all",), replicas=1)
+        bad = _desync_block(lambda i: [2.0], buckets=("all",), replicas=1)
+        _write_rank_windows(tmp_path, 0, windows=1,
+                            desync_at=lambda w: ok)
+        _write_rank_windows(tmp_path, 1, windows=1,
+                            desync_at=lambda w: bad)
+        _write_rank_windows(tmp_path, 2, windows=1,
+                            desync_at=lambda w: ok)
+        mon = FleetMonitor(str(tmp_path), log_fn=lambda *a: None)
+        mon.poll(force=True)
+        des = [a for a in mon.anomalies if a["rule"] == "desync"]
+        assert des and des[0]["replicas"] == [{"rank": 1, "replica": 0}]
+
+    def test_desync_two_way_tie_is_ambiguous(self, tmp_path):
+        """dp=2 split: there IS no majority — the sentinel must list
+        BOTH replicas as involved instead of deterministically blaming
+        whichever value hashed second (an operator restoring 'the
+        healthy one' could otherwise keep the corrupt one)."""
+        def desync_at(w):
+            return _desync_block(lambda i: [1.0 + i], buckets=("all",),
+                                 replicas=2)
+        _write_rank_windows(tmp_path, 0, windows=1, desync_at=desync_at)
+        mon = FleetMonitor(str(tmp_path), log_fn=lambda *a: None)
+        mon.poll(force=True)
+        des = [a for a in mon.anomalies if a["rule"] == "desync"]
+        assert des and des[0]["ambiguous"] is True
+        assert des[0]["replicas"] == [{"rank": 0, "replica": 0},
+                                      {"rank": 0, "replica": 1}]
+        assert "split EVENLY" in des[0]["detail"]
+
+    def test_dead_rank_grace_keeps_sentinels_live(self, tmp_path):
+        """A rank that stops shipping (dead host — the PRIMARY failure
+        this monitor exists for) must not blind live judging: after the
+        straggler grace its windows are judged partial and the skew
+        rules keep firing on the surviving ranks."""
+        _write_rank_windows(tmp_path, 0, windows=5, step_ms=25.0)
+        _write_rank_windows(tmp_path, 1, windows=1)   # dies after w0
+        _write_rank_windows(tmp_path, 2, windows=5, step_ms=5.0)
+        mon = FleetMonitor(str(tmp_path), warmup_windows=1,
+                           log_fn=lambda *a: None)
+        mon.poll()
+        # w0 complete; w1/w2 past the grace -> judged partial with the
+        # two live ranks; w3/w4 still inside the grace window
+        assert mon.windows_judged == 3
+        assert mon.windows[1].get("partial") is True
+        skews = [a for a in mon.anomalies
+                 if a["rule"] == "step_time_skew"]
+        assert skews and skews[0]["slow_rank"] == 0, (
+            "the straggler rule must keep firing after a rank dies")
+
+    def test_late_record_counted_totals_stay_exact(self, tmp_path):
+        """A record landing AFTER its window was force-judged is counted
+        (late_records), never folded in — folding would desynchronise
+        the per-rank totals from the window ring and break the exact
+        re-add invariant the artifact pin enforces."""
+        logs = []
+        _write_rank_windows(tmp_path, 0, windows=1)
+        mon = FleetMonitor(str(tmp_path),
+                           log_fn=lambda msg, *a: logs.append(msg % a))
+        mon.poll(force=True)           # judges w0 with rank 0 only
+        _write_rank_windows(tmp_path, 1, windows=1)   # late joiner
+        mon.poll(force=True)
+        rep = mon.report()
+        assert rep["counters"]["late_records"] == 1
+        assert any("late" in m for m in logs)
+        assert set(rep["ranks"]) == {"0"}
+        for rank, tot in rep["ranks"].items():
+            wins = [w["per_rank"][rank] for w in rep["windows"]
+                    if rank in w["per_rank"]]
+            assert tot["wall_us"] == sum(w["wall_us"] for w in wins)
+            assert tot["windows"] == len(wins)
+
+    def test_shipper_resumes_window_numbering(self, tmp_path):
+        """An elastically-resumed rank continues its window sequence —
+        restarting at zero would overwrite its pre-crash records and
+        hide every post-restart one behind the monitor's seen-file set."""
+        sh = _mk_shipper(tmp_path, rank=0)
+        _ship_window(sh, steps=1, step_ms=1.0)
+        _ship_window(sh, steps=1, step_ms=1.0)
+        sh.close()
+        sh2 = _mk_shipper(tmp_path, rank=0)     # the resumed process
+        assert sh2.windows_shipped == 2
+        _ship_window(sh2, steps=1, step_ms=1.0)
+        sh2.close()
+        files = sorted(os.listdir(os.path.join(str(tmp_path),
+                                               "rank_00000")))
+        assert files == ["win_00000000.json", "win_00000001.json",
+                         "win_00000002.json"]
+        mon = FleetMonitor(str(tmp_path), log_fn=lambda *a: None)
+        mon.poll(force=True)
+        assert mon.records_loaded == 3
+
+    def test_desync_clean_replicas_no_false_positive(self, tmp_path):
+        _write_rank_windows(
+            tmp_path, 0, windows=3,
+            desync_at=lambda w: _desync_block(lambda i: [3.25, 4.5],
+                                              replicas=8))
+        mon = FleetMonitor(str(tmp_path), log_fn=lambda *a: None)
+        mon.poll(force=True)
+        assert mon.desync_checks == 3
+        assert mon.desync_mismatches == 0 and not mon.anomalies
+
+    def test_report_per_rank_sums_re_add_exactly(self, tmp_path):
+        from deepspeed_tpu.telemetry.ledger import GoodputLedger
+        for rank in (0, 1):
+            led = GoodputLedger(enabled=True)
+            sh = FleetShipper(str(tmp_path), rank=rank, background=False)
+            sh.attach_ledger(led)
+            for w in range(3):
+                for _ in range(2):
+                    with led.attribute("host_dispatch"):
+                        time.sleep(0.001)
+                    sh.note_step_time(0.001)
+                sh.tick(step=(w + 1) * 2)
+            sh.close()
+        mon = FleetMonitor(str(tmp_path), log_fn=lambda *a: None)
+        mon.poll()
+        rep = mon.report()
+        assert rep["counters"]["windows_dropped"] == 0
+        for rank in ("0", "1"):
+            tot = rep["ranks"][rank]
+            wins = [w["per_rank"][rank] for w in rep["windows"]]
+            assert tot["wall_us"] == sum(w["wall_us"] for w in wins)
+            assert tot["steps"] == sum(w["steps"] for w in wins) == 6
+            assert tot["step_time_us"] == sum(
+                w["step_time_us"]["sum"] for w in wins)
+            for c, v in tot["categories_us"].items():
+                assert v == sum(w["categories_us"][c] for w in wins)
+            for w in wins:
+                assert sum(w["categories_us"].values()) == w["wall_us"]
+
+    def test_snapshot_strict_json_and_throttle(self, tmp_path):
+        _write_rank_windows(tmp_path, 0, windows=3, step_ms=5.0)
+        _write_rank_windows(tmp_path, 1, windows=3, step_ms=25.0)
+        snap = tmp_path / "FLEET_HEALTH.json"
+        mon = FleetMonitor(str(tmp_path), snapshot_path=str(snap),
+                           warmup_windows=1, log_fn=lambda *a: None)
+        mon.poll()
+        assert snap.is_file(), "a first-time rule must force a snapshot"
+        doc = json.load(open(snap), parse_constant=lambda t: pytest.fail(
+            f"snapshot carries bare {t!r} — not strict JSON"))
+        assert doc["schema"] == "deepspeed_tpu.fleet_health/1"
+        assert doc["verdict"] == "warning"
+        written = mon._snapshots_written
+        # repeat firings inside the 5s window ride the throttle
+        mon._escalate([{"rule": "step_time_skew", "step": 99,
+                        "severity": "warning", "detail": "again"}])
+        assert mon._snapshots_written == written
+
+    def test_registry_counters_published(self, tmp_path):
+        from deepspeed_tpu.telemetry.metrics import MetricsRegistry
+        _write_rank_windows(tmp_path, 0, windows=2, step_ms=5.0)
+        _write_rank_windows(tmp_path, 1, windows=2, step_ms=25.0)
+        reg = MetricsRegistry()
+        mon = FleetMonitor(str(tmp_path), registry=reg, warmup_windows=1,
+                           log_fn=lambda *a: None)
+        mon.poll()
+        snap = reg.snapshot()
+        assert snap["fleet_ranks"][0]["value"] == 2
+        assert "fleet_windows_judged_total" in snap
+        assert any(r["labels"] == {"rule": "step_time_skew"}
+                   for r in snap["fleet_anomalies_total"])
+
+    def test_default_snapshot_never_lands_in_cwd(self, tmp_path,
+                                                 monkeypatch):
+        """The PR-4 clobber class, regression-pinned: a monitor built
+        without an explicit snapshot_path (as ~every unit test here is)
+        must write its escalation snapshot NEXT TO THE RUN DIR it
+        aggregates — an anomaly firing during a repo-root test run must
+        never overwrite the committed FLEET_HEALTH.json example (it DID,
+        before the default moved)."""
+        _write_rank_windows(tmp_path, 0, windows=2, step_ms=5.0)
+        _write_rank_windows(tmp_path, 1, windows=2, step_ms=25.0)
+        cwd = tmp_path / "somewhere_else"
+        cwd.mkdir()
+        monkeypatch.chdir(cwd)
+        mon = FleetMonitor(str(tmp_path), warmup_windows=1,
+                           log_fn=lambda *a: None)
+        mon.poll()
+        assert mon.anomalies, "the skew must fire to test the snapshot"
+        assert not (cwd / "FLEET_HEALTH.json").exists()
+        assert (tmp_path / "FLEET_HEALTH.json").is_file()
+
+    def test_on_escalate_hook_failures_swallowed(self, tmp_path):
+        _write_rank_windows(tmp_path, 0, windows=2, step_ms=5.0)
+        _write_rank_windows(tmp_path, 1, windows=2, step_ms=25.0)
+
+        def boom():
+            raise RuntimeError("hook")
+        mon = FleetMonitor(str(tmp_path), warmup_windows=1,
+                           on_escalate=boom, log_fn=lambda *a: None)
+        mon.poll()          # must not raise
+        assert mon.anomalies
+
+
+# ------------------------------------------------------------- trace merge
+
+class TestTraceMerge:
+    def test_process_label_metadata_exported(self, tmp_path):
+        from deepspeed_tpu.telemetry.tracer import Tracer
+        tr = Tracer(enabled=True)
+        tr.set_process_label("rank 2", sort_index=2)
+        with tr.span("step"):
+            pass
+        path = tr.export(str(tmp_path / "t.trace.json"))
+        doc = json.load(open(path))
+        meta = [e for e in doc["traceEvents"] if e.get("ph") == "M"]
+        assert {"name": "process_name", "ph": "M", "pid": os.getpid(),
+                "args": {"name": "rank 2"}} in meta
+        assert any(e["name"] == "process_sort_index" for e in meta)
+
+    def test_merge_remaps_pids_to_ranks(self, tmp_path):
+        from deepspeed_tpu.telemetry.tracer import Tracer
+        paths = []
+        for rank in (0, 2):
+            tr = Tracer(enabled=True)
+            tr.set_process_label(f"rank {rank}", sort_index=rank)
+            with tr.span(f"work_r{rank}"):
+                pass
+            paths.append(tr.export(
+                str(tmp_path / f"r{rank}.trace.json")))
+        out = merge_traces(str(tmp_path / "merged.json"), paths)
+        doc = json.load(open(out))
+        evs = doc["traceEvents"]
+        spans = {e["name"]: e for e in evs if e.get("ph") == "X"}
+        assert spans["work_r0"]["pid"] == 0
+        assert spans["work_r2"]["pid"] == 2
+        names = {(e["pid"], e["args"]["name"]) for e in evs
+                 if e.get("ph") == "M" and e["name"] == "process_name"}
+        assert (0, "rank 0") in names and (2, "rank 2") in names
+
+
+# ------------------------------------------------------------- fleet config
+
+class TestFleetConfig:
+    def _cfg(self, monkeypatch=None, **fleet):
+        from deepspeed_tpu.runtime.config import DeepSpeedTelemetryConfig
+        return DeepSpeedTelemetryConfig(
+            {"telemetry": {"enabled": True, "fleet": fleet}})
+
+    def test_defaults(self):
+        t = self._cfg()
+        assert t.fleet_enabled is False
+        assert t.fleet_rank == -1 and t.fleet_cadence == 0
+        assert t.fleet_desync is True
+        assert t.fleet_step_time_skew_frac == 0.25
+
+    def test_block_parsed(self):
+        t = self._cfg(enabled=True, run_dir="/tmp/fr", rank=7, cadence=4,
+                      desync=False, step_time_skew_frac=0.5)
+        assert t.fleet_enabled and t.fleet_run_dir == "/tmp/fr"
+        assert t.fleet_rank == 7 and t.fleet_cadence == 4
+        assert t.fleet_desync is False
+        assert t.fleet_step_time_skew_frac == 0.5
+
+    def test_env_overrides(self, monkeypatch):
+        monkeypatch.setenv("DS_TELEMETRY_FLEET", "1")
+        monkeypatch.setenv("DS_TELEMETRY_FLEET_RUN_DIR", "/tmp/envdir")
+        monkeypatch.setenv("DS_TELEMETRY_FLEET_RANK", "5")
+        t = self._cfg()
+        assert t.fleet_enabled is True
+        assert t.fleet_run_dir == "/tmp/envdir"
+        assert t.fleet_rank == 5
+
+    @pytest.mark.parametrize("bad", [
+        {"cadence": -1}, {"desync_cadence": -2},
+        {"step_time_skew_frac": 0.0}, {"input_wait_skew_frac": 1.5},
+        {"checkpoint_skew_frac": -0.1}, {"window_ring": 0},
+    ])
+    def test_validation_rejects(self, bad):
+        from deepspeed_tpu.runtime.config import DeepSpeedConfigError
+        with pytest.raises(DeepSpeedConfigError):
+            self._cfg(**bad)
+
+
+# ------------------------------------------------- subprocess multi-rank e2e
+
+def _run_sims(run_dir, specs, timeout=120):
+    """Launch the fleet CLI rank simulators as REAL subprocesses writing
+    into one shared run dir (the multi-host analogue this container can
+    actually run)."""
+    env = dict(os.environ, PYTHONPATH=ROOT)
+    procs = []
+    for spec in specs:
+        cmd = [sys.executable, "-m", "deepspeed_tpu.telemetry.fleet",
+               "--simulate-rank", str(spec["rank"]),
+               "--run-dir", str(run_dir),
+               "--windows", str(spec.get("windows", 4)),
+               "--steps-per-window", str(spec.get("steps", 2)),
+               "--step-ms", str(spec.get("step_ms", 5.0))]
+        if spec.get("iw_frac"):
+            cmd += ["--input-wait-frac", str(spec["iw_frac"])]
+        if spec.get("ckpt_ms"):
+            cmd += ["--ckpt-ms", str(spec["ckpt_ms"]),
+                    "--ckpt-window", str(spec.get("ckpt_window", 2))]
+        procs.append(subprocess.Popen(cmd, cwd=ROOT, env=env))
+    for p in procs:
+        assert p.wait(timeout=timeout) == 0
+
+
+class TestSubprocessMultiRank:
+    def test_straggler_injection_e2e(self, tmp_path):
+        """THE acceptance e2e: three subprocess-writer ranks, rank 1
+        carrying an injected +20 ms per-step stall — the aggregator must
+        fire step_time_skew NAMING rank 1 with the right badput share,
+        through the real warn -> snapshot protocol on real files."""
+        snap = tmp_path / "FLEET_HEALTH.json"
+        _run_sims(tmp_path, [
+            {"rank": 0, "step_ms": 5.0},
+            {"rank": 1, "step_ms": 25.0},          # 5 + injected 20 ms
+            {"rank": 2, "step_ms": 5.0},
+        ])
+        logs = []
+        mon = FleetMonitor(str(tmp_path), snapshot_path=str(snap),
+                           warmup_windows=1,
+                           log_fn=lambda msg, *a: logs.append(msg % a))
+        mon.poll(force=True)
+        rep = mon.report()
+        assert rep["n_ranks"] == 3
+        skews = [a for a in rep["anomalies"]
+                 if a["rule"] == "step_time_skew"]
+        assert skews, "the injected straggler must fire step_time_skew"
+        a = skews[0]
+        assert a["slow_rank"] == 1, \
+            "the skew verdict must NAME the stalled rank"
+        # ~(25-5)/25 of fleet step time is straggler-induced badput
+        assert 0.6 <= a["badput_share"] <= 0.92
+        assert len([m for m in logs if "step_time_skew" in m]) == 1
+        assert snap.is_file()
+        json.load(open(snap))
+
+    def test_sim_records_join_cleanly(self, tmp_path):
+        _run_sims(tmp_path, [{"rank": r, "windows": 3} for r in range(3)])
+        mon = FleetMonitor(str(tmp_path), log_fn=lambda *a: None)
+        mon.poll()
+        assert mon.windows_judged == 3
+        assert all(w["ranks"] == [0, 1, 2] for w in mon.windows)
+
+
+# --------------------------------------------------- engine (virtual-mesh) e2e
+
+def _fleet_engine(tmp_path, steps_per_print=2, stall_ms=0.0, fleet=None,
+                  goodput=True):
+    import deepspeed_tpu
+    from deepspeed_tpu.models.simple import SimpleModel, random_dataset, \
+        sample_batch
+    from deepspeed_tpu.runtime.dataloader import RepeatingLoader
+    from deepspeed_tpu.utils import groups
+    groups.destroy()
+    groups.initialize()
+    hidden = 32
+    fleet_cfg = {"enabled": True, "run_dir": str(tmp_path / "fleet_run"),
+                 "snapshot_file": str(tmp_path / "FLEET_HEALTH.json")}
+    fleet_cfg.update(fleet or {})
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=hidden, nlayers=2),
+        config={
+            "train_batch_size": 8,
+            "steps_per_print": steps_per_print,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+            "telemetry": {"enabled": True, "trace": False,
+                          "jsonl": False, "prometheus": False,
+                          "output_path": str(tmp_path / "tel"),
+                          "goodput": {"enabled": goodput,
+                                      "profiler_capture": False},
+                          "fleet": fleet_cfg},
+        },
+        sample_batch=sample_batch(8, hidden))
+    loader = engine.deepspeed_io(random_dataset(64, hidden))
+
+    class _Stall:
+        def __init__(self, it, stall_s):
+            self._it = RepeatingLoader(it)
+            self.stall_s = stall_s
+
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            if self.stall_s:
+                time.sleep(self.stall_s)
+            return next(self._it)
+
+    return engine, _Stall(loader, stall_ms / 1e3)
+
+
+def _perturb_replica(engine, module="Dense_1", device_index=3):
+    """Silently diverge ONE data-parallel replica of *module*'s kernel:
+    same logical (replicated) jax.Array, one device's buffer perturbed —
+    the exact failure mode the sentinel exists to catch."""
+    import jax
+
+    def perturb(path, leaf):
+        if module not in jax.tree_util.keystr(path) \
+                or getattr(leaf, "ndim", 0) != 2:
+            return leaf
+        bufs = []
+        for j, d in enumerate(leaf.sharding.mesh.devices.ravel()):
+            arr = np.array(leaf.addressable_data(j), copy=True)
+            if j == device_index:
+                arr[0, 0] += 1.0
+            bufs.append(jax.device_put(arr, d))
+        return jax.make_array_from_single_device_arrays(
+            leaf.shape, leaf.sharding, bufs)
+    engine.state = engine.state._replace(
+        params=jax.tree_util.tree_map_with_path(
+            perturb, engine.state.params))
+
+
+class TestEngineFleet:
+    def test_desync_sentinel_fires_with_bucket_provenance(self, tmp_path):
+        """THE desync acceptance e2e (single-process virtual-mesh dp
+        path): a perturbed dp replica fires the sentinel critical,
+        naming the perturbed module bucket and replica."""
+        engine, it = _fleet_engine(tmp_path)
+        try:
+            assert engine._fleet is not None
+            assert engine._fleet_monitor is not None
+            assert engine._desync_on, "dp=8 zero=0 is inside the envelope"
+            for step in range(6):
+                if step == 4:
+                    _perturb_replica(engine, "Dense_1", device_index=3)
+                engine.train_batch(data_iter=it)
+            rep = engine.fleet_report(write=True)
+            assert rep["verdict"] == "critical"
+            des = [a for a in rep["anomalies"] if a["rule"] == "desync"]
+            assert des, "perturbed replica must fire the desync sentinel"
+            assert des[0]["buckets"] == ["Dense_1"]
+            assert des[0]["replicas"] == [{"rank": 0, "replica": 3}]
+            assert rep["counters"]["desync_mismatches"] >= 1
+            # pre-perturbation windows checked clean (no false positive)
+            assert rep["counters"]["desync_checks"] > \
+                rep["counters"]["desync_mismatches"]
+            assert (tmp_path / "FLEET_HEALTH.json").is_file()
+        finally:
+            engine.close()
+        alive = [t for t in threading.enumerate()
+                 if t.name.startswith("ds-fleet-ship")]
+        assert not alive, "engine.close() must join the shipper thread"
+
+    def test_straggler_badput_consistent_with_ledger(self, tmp_path):
+        """Acceptance: the engine rank carries an injected 20 ms
+        per-step input stall; against a fast simulated rank the skew
+        verdict names the engine rank AND its badput attribution agrees
+        with the goodput ledger's categories (whose integer sums stay
+        exact)."""
+        run_dir = tmp_path / "fleet_run"
+        _run_sims(run_dir, [{"rank": 1, "windows": 4, "steps": 2,
+                             "step_ms": 2.0}])
+        engine, it = _fleet_engine(tmp_path, stall_ms=20.0)
+        try:
+            for _ in range(8):
+                engine.train_batch(data_iter=it)
+            rep = engine.fleet_report()
+            skews = [a for a in rep["anomalies"]
+                     if a["rule"] == "step_time_skew"]
+            assert skews, "the stalled engine rank must be the straggler"
+            a = skews[0]
+            assert a["slow_rank"] == 0
+            assert a["badput_share"] > 0.5
+            # the slow rank's OWN ledger explains the straggle: the
+            # injected stall is input_wait, and the skew verdict carries
+            # that attribution
+            assert a["slow_rank_dominant_badput"] == "input_wait"
+            # ...and the ledger-sourced integer categories still
+            # partition each of the slow rank's windows exactly
+            for w in rep["windows"]:
+                pr = w["per_rank"].get("0")
+                if pr and pr["categories_us"] is not None:
+                    assert sum(pr["categories_us"].values()) == \
+                        pr["wall_us"]
+                    assert pr["categories_us"]["input_wait"] > 0
+            iw = [x for x in rep["anomalies"]
+                  if x["rule"] == "input_wait_skew"]
+            assert iw and iw[0]["rank"] == 0
+        finally:
+            engine.close()
+
+    def test_desync_envelope_falls_back_outside(self, tmp_path, caplog):
+        """zero-3 shards params over dp — replicas legitimately differ,
+        so the sentinel must disarm (warn once), never fire falsely."""
+        import deepspeed_tpu
+        from deepspeed_tpu.models.simple import SimpleModel, sample_batch
+        from deepspeed_tpu.utils import groups
+        groups.destroy()
+        groups.initialize()
+        hidden = 32
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=SimpleModel(hidden_dim=hidden, nlayers=2),
+            config={
+                "train_batch_size": 8,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                "zero_optimization": {"stage": 3},
+                "telemetry": {
+                    "enabled": True, "trace": False, "jsonl": False,
+                    "prometheus": False,
+                    "output_path": str(tmp_path / "tel"),
+                    "fleet": {"enabled": True,
+                              "run_dir": str(tmp_path / "fr")}},
+            },
+            sample_batch=sample_batch(8, hidden))
+        try:
+            assert engine._fleet is not None
+            assert engine._desync_on is False
+            assert engine._desync_fn is None
+        finally:
+            engine.close()
+
+    def test_fleet_disabled_engine_inert(self, tmp_path):
+        import deepspeed_tpu
+        from deepspeed_tpu.models.simple import SimpleModel, sample_batch
+        from deepspeed_tpu.utils import groups
+        groups.destroy()
+        groups.initialize()
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=SimpleModel(hidden_dim=32, nlayers=2),
+            config={
+                "train_batch_size": 8,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                "telemetry": {"enabled": True, "trace": False,
+                              "jsonl": False, "prometheus": False,
+                              "output_path": str(tmp_path / "tel")},
+            },
+            sample_batch=sample_batch(8, 32))
+        try:
+            assert engine._fleet is None
+            assert engine._fleet_monitor is None
+            assert engine.fleet_report() == {"enabled": False}
+            assert fleet_mod.get_shipper() is None
+        finally:
+            engine.close()

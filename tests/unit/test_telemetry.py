@@ -208,6 +208,35 @@ class TestPrometheusRender:
         assert "inf_g +Inf" in out
         assert "nan_g NaN" in out
 
+    def test_histogram_summary_quantiles(self):
+        """p50/p90/p99 reach the scrape sink as a sibling summary family
+        (satellite: percentiles must not live only in JSON artifacts)."""
+        reg = MetricsRegistry()
+        h = reg.histogram("ttft_ms", buckets=(1, 10, 100),
+                          labels={"engine": "srv"})
+        for v in (2.0,) * 9 + (50.0,):
+            h.observe(v)
+        out = render_prometheus(reg)
+        assert "# TYPE ttft_ms_summary summary" in out
+        for q in ("0.5", "0.9", "0.99"):
+            assert f'ttft_ms_summary{{engine="srv",quantile="{q}"}}' \
+                in out
+        assert 'ttft_ms_summary_sum{engine="srv"} 68' in out
+        assert 'ttft_ms_summary_count{engine="srv"} 10' in out
+        # the quantile values agree with Histogram.quantile exactly
+        import re
+        p50 = re.search(r'quantile="0\.5"} ([\d.]+)', out)
+        assert float(p50.group(1)) == h.quantile(0.5)
+
+    def test_empty_histogram_renders_no_summary(self):
+        """A quantile of nothing is a lie, not a zero — empty histograms
+        keep their bucket/sum/count lines but render no summary family."""
+        reg = MetricsRegistry()
+        reg.histogram("empty_ms", buckets=(1, 5))
+        out = render_prometheus(reg)
+        assert "empty_ms_count 0" in out
+        assert "empty_ms_summary" not in out
+
 
 # ----------------------------------------------------------- engine glue
 
