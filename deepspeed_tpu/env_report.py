@@ -111,6 +111,9 @@ def telemetry_report():
     row("goodput autotuner (2-stage)", True,
         "(autotuning block; compile-time pruning + measured probes -> "
         "TUNE_REPORT.json)")
+    row("self-healing guardian", True,
+        "(guardian block; anomaly->action policies: emergency ckpt, "
+        "rollback, fp16 rescue, admission pause -> GUARDIAN.json)")
     try:
         from deepspeed_tpu.telemetry.ledger import profiler_available
         row("jax.profiler programmatic capture", profiler_available(),

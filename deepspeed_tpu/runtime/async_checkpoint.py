@@ -28,10 +28,13 @@ Contract (mirrors the prefetch pipeline's shutdown discipline,
   process exit does not truncate a checkpoint.
 """
 
+import random
 import threading
+import time
 import weakref
 
 from deepspeed_tpu.telemetry.ledger import suppress_attribution
+from deepspeed_tpu.telemetry.metrics import get_registry
 from deepspeed_tpu.utils.logging import logger
 
 # at interpreter exit the finalizer joins the in-flight persist; bounded
@@ -72,8 +75,14 @@ class AsyncCheckpointWriter:
     """One in-flight background persist at a time. Built lazily by the
     engine when ``checkpoint.async_save`` is on."""
 
-    def __init__(self, name="ckpt-writer"):
+    def __init__(self, name="ckpt-writer", retries=0, backoff_s=0.05):
         self._name = name
+        # transient-failure budget for the persist stage: a failed
+        # persist_fn is re-run up to `retries` more times with jittered
+        # exponential backoff; only the LAST failure surfaces (at the
+        # next drain). 0 = seed behavior, fail on first error.
+        self.retries = max(0, int(retries))
+        self.backoff_s = max(0.0, float(backoff_s))
         self._state = _WriterState()
         self._closed = False
         self._finalizer = weakref.finalize(self, _finalize_state,
@@ -94,13 +103,40 @@ class AsyncCheckpointWriter:
         state = self._state
         state.tag = str(tag)
 
+        retries, backoff_s = self.retries, self.backoff_s
+
         def _run():
             try:
                 # overlapped persist seconds must not book into the
                 # ledger's shared totals (they run CONCURRENT with the
                 # train loop's attributed time)
                 with suppress_attribution():
-                    persist_fn()
+                    for attempt in range(retries + 1):
+                        try:
+                            persist_fn()
+                            break
+                        except Exception as e:
+                            # a transient filesystem hiccup must not be
+                            # terminal when budget remains: back off
+                            # (exponential, jittered so a fleet of ranks
+                            # doesn't retry in lockstep) and re-run the
+                            # whole persist — every file write is
+                            # idempotent (atomic tmp+rename)
+                            if attempt >= retries:
+                                raise
+                            get_registry().counter(
+                                "checkpoint_retries_total",
+                                "checkpoint persist attempts retried "
+                                "after a transient failure").inc()
+                            delay = (backoff_s * (2 ** attempt)
+                                     * (0.5 + random.random()))
+                            logger.warning(
+                                f"async checkpoint: persist of tag "
+                                f"{state.tag!r} failed (attempt "
+                                f"{attempt + 1}/{retries + 1}: {e}); "
+                                f"retrying in {delay:.3f}s")
+                            if delay > 0:
+                                time.sleep(delay)
             except BaseException as e:      # surfaced at the next drain
                 state.error = e
 

@@ -336,6 +336,103 @@ class DeepSpeedCommOverlapConfig(DeepSpeedConfigObject):
         return int(self.bucket_mb * (1 << 20))
 
 
+class DeepSpeedGuardianConfig(DeepSpeedConfigObject):
+    """``guardian`` block (runtime/guardian.py): the self-healing
+    anomaly->action policy engine. Subscribes to the telemetry monitors'
+    ``on_anomaly`` hooks and maps fired rules to bounded, rate-limited
+    actions — emergency checkpoint, rollback-to-last-intact, fp16
+    loss-scale rescue, serving admission pause/resume. Every action is
+    journaled to ``GUARDIAN.json``.
+
+    Env overrides (sweep ergonomics, after JSON parsing):
+    ``DS_GUARDIAN`` = 1/0 force-toggles ``enabled``;
+    ``DS_GUARDIAN_JOURNAL`` overrides ``journal_file``;
+    ``DS_GUARDIAN_MAX_ROLLBACKS`` and ``DS_GUARDIAN_COOLDOWN_STEPS``
+    override the rollback budget and the per-action cooldown."""
+
+    def __init__(self, param_dict):
+        g = param_dict.get(C.GUARDIAN, {}) or {}
+        self.enabled = g.get(C.GUARDIAN_ENABLED, C.GUARDIAN_ENABLED_DEFAULT)
+        self.journal_file = g.get(C.GUARDIAN_JOURNAL_FILE,
+                                  C.GUARDIAN_JOURNAL_FILE_DEFAULT)
+        self.action_cooldown_steps = int(g.get(
+            C.GUARDIAN_ACTION_COOLDOWN, C.GUARDIAN_ACTION_COOLDOWN_DEFAULT))
+        self.emergency_checkpoint = g.get(
+            C.GUARDIAN_EMERGENCY_CHECKPOINT,
+            C.GUARDIAN_EMERGENCY_CHECKPOINT_DEFAULT)
+        # [] / absent -> the guardian's built-in warning-tier rule set
+        from deepspeed_tpu.runtime.guardian import (DEFAULT_EMERGENCY_RULES,
+                                                    DEFAULT_PAUSE_RULES)
+        self.emergency_rules = tuple(
+            g.get(C.GUARDIAN_EMERGENCY_RULES) or DEFAULT_EMERGENCY_RULES)
+        self.max_emergency_checkpoints = int(g.get(
+            C.GUARDIAN_MAX_EMERGENCY_CHECKPOINTS,
+            C.GUARDIAN_MAX_EMERGENCY_CHECKPOINTS_DEFAULT))
+        self.rollback = g.get(C.GUARDIAN_ROLLBACK,
+                              C.GUARDIAN_ROLLBACK_DEFAULT)
+        self.divergence_window = int(g.get(
+            C.GUARDIAN_DIVERGENCE_WINDOW,
+            C.GUARDIAN_DIVERGENCE_WINDOW_DEFAULT))
+        self.divergence_streak = int(g.get(
+            C.GUARDIAN_DIVERGENCE_STREAK,
+            C.GUARDIAN_DIVERGENCE_STREAK_DEFAULT))
+        self.rollback_cooldown_steps = int(g.get(
+            C.GUARDIAN_ROLLBACK_COOLDOWN,
+            C.GUARDIAN_ROLLBACK_COOLDOWN_DEFAULT))
+        self.max_rollbacks = int(g.get(C.GUARDIAN_MAX_ROLLBACKS,
+                                       C.GUARDIAN_MAX_ROLLBACKS_DEFAULT))
+        self.fp16_rescue = g.get(C.GUARDIAN_FP16_RESCUE,
+                                 C.GUARDIAN_FP16_RESCUE_DEFAULT)
+        self.max_fp16_rescues = int(g.get(
+            C.GUARDIAN_MAX_FP16_RESCUES,
+            C.GUARDIAN_MAX_FP16_RESCUES_DEFAULT))
+        self.serving_degrade = g.get(C.GUARDIAN_SERVING_DEGRADE,
+                                     C.GUARDIAN_SERVING_DEGRADE_DEFAULT)
+        self.pause_rules = tuple(
+            g.get(C.GUARDIAN_PAUSE_RULES) or DEFAULT_PAUSE_RULES)
+        self.resume_clear_steps = int(g.get(
+            C.GUARDIAN_RESUME_CLEAR_STEPS,
+            C.GUARDIAN_RESUME_CLEAR_STEPS_DEFAULT))
+        env = os.environ.get("DS_GUARDIAN")
+        if env is not None:
+            self.enabled = env.lower() in ("1", "true", "yes", "on")
+        env_j = os.environ.get("DS_GUARDIAN_JOURNAL")
+        if env_j is not None:
+            self.journal_file = env_j
+        env_r = os.environ.get("DS_GUARDIAN_MAX_ROLLBACKS")
+        if env_r is not None:
+            self.max_rollbacks = int(env_r)
+        env_c = os.environ.get("DS_GUARDIAN_COOLDOWN_STEPS")
+        if env_c is not None:
+            self.action_cooldown_steps = int(env_c)
+        if self.action_cooldown_steps < 0:
+            raise DeepSpeedConfigError(
+                f"guardian.{C.GUARDIAN_ACTION_COOLDOWN} must be >= 0, got "
+                f"{self.action_cooldown_steps}")
+        if self.divergence_streak < 1:
+            raise DeepSpeedConfigError(
+                f"guardian.{C.GUARDIAN_DIVERGENCE_STREAK} must be >= 1, "
+                f"got {self.divergence_streak}")
+        if self.divergence_window < 1:
+            raise DeepSpeedConfigError(
+                f"guardian.{C.GUARDIAN_DIVERGENCE_WINDOW} must be >= 1, "
+                f"got {self.divergence_window}")
+        if self.max_rollbacks < 0:
+            raise DeepSpeedConfigError(
+                f"guardian.{C.GUARDIAN_MAX_ROLLBACKS} must be >= 0, got "
+                f"{self.max_rollbacks}")
+        if self.rollback_cooldown_steps < 1:
+            # a 0 cooldown would let two consecutive divergent steps
+            # rollback-loop against the same intact tag
+            raise DeepSpeedConfigError(
+                f"guardian.{C.GUARDIAN_ROLLBACK_COOLDOWN} must be >= 1, "
+                f"got {self.rollback_cooldown_steps}")
+        if self.resume_clear_steps < 1:
+            raise DeepSpeedConfigError(
+                f"guardian.{C.GUARDIAN_RESUME_CLEAR_STEPS} must be >= 1, "
+                f"got {self.resume_clear_steps}")
+
+
 class DeepSpeedServingObservabilityConfig(DeepSpeedConfigObject):
     """``serving.observability`` sub-block
     (telemetry/serving_observatory.py): per-request lifecycle timelines
@@ -842,6 +939,15 @@ class DeepSpeedConfig:
             C.CHECKPOINT_FALLBACK, C.CHECKPOINT_FALLBACK_DEFAULT))
         self.checkpoint_wait_timeout_s = float(ckpt.get(
             C.CHECKPOINT_WAIT_TIMEOUT, C.CHECKPOINT_WAIT_TIMEOUT_DEFAULT))
+        self.checkpoint_persist_retries = int(ckpt.get(
+            C.CHECKPOINT_PERSIST_RETRIES,
+            C.CHECKPOINT_PERSIST_RETRIES_DEFAULT))
+        self.checkpoint_persist_backoff_s = float(ckpt.get(
+            C.CHECKPOINT_PERSIST_BACKOFF_S,
+            C.CHECKPOINT_PERSIST_BACKOFF_S_DEFAULT))
+        env_retries = os.environ.get("DS_CHECKPOINT_PERSIST_RETRIES")
+        if env_retries is not None:
+            self.checkpoint_persist_retries = int(env_retries)
         env_async = os.environ.get("DS_CHECKPOINT_ASYNC_SAVE")
         if env_async is not None:
             self.checkpoint_async_save = env_async.lower() in (
@@ -854,6 +960,14 @@ class DeepSpeedConfig:
             raise DeepSpeedConfigError(
                 f"checkpoint.{C.CHECKPOINT_WAIT_TIMEOUT} must be > 0, got "
                 f"{self.checkpoint_wait_timeout_s}")
+        if self.checkpoint_persist_retries < 0:
+            raise DeepSpeedConfigError(
+                f"checkpoint.{C.CHECKPOINT_PERSIST_RETRIES} must be >= 0, "
+                f"got {self.checkpoint_persist_retries}")
+        if self.checkpoint_persist_backoff_s < 0:
+            raise DeepSpeedConfigError(
+                f"checkpoint.{C.CHECKPOINT_PERSIST_BACKOFF_S} must be "
+                f">= 0, got {self.checkpoint_persist_backoff_s}")
 
         self.elasticity_enabled = bool((pd.get("elasticity", {}) or {}).get(
             "enabled", False))
@@ -866,6 +980,7 @@ class DeepSpeedConfig:
         self.dataloader_drop_last = pd.get(C.DATALOADER_DROP_LAST, None)
         self.data_prefetch = DeepSpeedDataPrefetchConfig(pd)
         self.comm_overlap = DeepSpeedCommOverlapConfig(pd)
+        self.guardian = DeepSpeedGuardianConfig(pd)
         self.serving = DeepSpeedServingConfig(pd)
         self.autotuning = DeepSpeedAutotuningConfig(pd)
         self.autotuning_enabled = self.autotuning.enabled

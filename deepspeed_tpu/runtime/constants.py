@@ -263,6 +263,52 @@ CHECKPOINT_FALLBACK_DEFAULT = True
 # shard files before writing the manifest (shared-filesystem gate).
 CHECKPOINT_WAIT_TIMEOUT = "rank_wait_timeout_s"
 CHECKPOINT_WAIT_TIMEOUT_DEFAULT = 300.0
+# persist_retries: transient I/O failures in the (async) persist stage are
+# retried this many times with jittered exponential backoff before the
+# failure surfaces as AsyncCheckpointError at the next drain. Retries are
+# counted into checkpoint_retries_total. DS_CHECKPOINT_PERSIST_RETRIES.
+CHECKPOINT_PERSIST_RETRIES = "persist_retries"
+CHECKPOINT_PERSIST_RETRIES_DEFAULT = 2
+CHECKPOINT_PERSIST_BACKOFF_S = "persist_retry_backoff_s"
+CHECKPOINT_PERSIST_BACKOFF_S_DEFAULT = 0.05
+
+# Guardian (runtime/guardian.py): the anomaly->action policy engine.
+# Config-gated and OFF by default — arming it means the run may take
+# emergency checkpoints, roll itself back to the newest intact tag on
+# confirmed divergence, reset a collapsed fp16 loss scale, and pause
+# serving admission under overload. Every action is rate-limited,
+# bounded, and journaled to GUARDIAN.json. DS_GUARDIAN=1/0 force-toggles.
+GUARDIAN = "guardian"
+GUARDIAN_ENABLED = "enabled"
+GUARDIAN_ENABLED_DEFAULT = False
+GUARDIAN_JOURNAL_FILE = "journal_file"      # "" -> <output_path>/GUARDIAN.json
+GUARDIAN_JOURNAL_FILE_DEFAULT = ""
+GUARDIAN_ACTION_COOLDOWN = "action_cooldown_steps"
+GUARDIAN_ACTION_COOLDOWN_DEFAULT = 25
+GUARDIAN_EMERGENCY_CHECKPOINT = "emergency_checkpoint"
+GUARDIAN_EMERGENCY_CHECKPOINT_DEFAULT = True
+GUARDIAN_EMERGENCY_RULES = "emergency_rules"  # [] -> built-in warning tier
+GUARDIAN_MAX_EMERGENCY_CHECKPOINTS = "max_emergency_checkpoints"
+GUARDIAN_MAX_EMERGENCY_CHECKPOINTS_DEFAULT = 4
+GUARDIAN_ROLLBACK = "rollback"
+GUARDIAN_ROLLBACK_DEFAULT = True
+GUARDIAN_DIVERGENCE_WINDOW = "divergence_window"    # steps of evidence
+GUARDIAN_DIVERGENCE_WINDOW_DEFAULT = 50
+GUARDIAN_DIVERGENCE_STREAK = "divergence_streak"    # nonfinite firings
+GUARDIAN_DIVERGENCE_STREAK_DEFAULT = 2
+GUARDIAN_ROLLBACK_COOLDOWN = "rollback_cooldown_steps"
+GUARDIAN_ROLLBACK_COOLDOWN_DEFAULT = 200
+GUARDIAN_MAX_ROLLBACKS = "max_rollbacks"
+GUARDIAN_MAX_ROLLBACKS_DEFAULT = 2
+GUARDIAN_FP16_RESCUE = "fp16_rescue"
+GUARDIAN_FP16_RESCUE_DEFAULT = True
+GUARDIAN_MAX_FP16_RESCUES = "max_fp16_rescues"
+GUARDIAN_MAX_FP16_RESCUES_DEFAULT = 2
+GUARDIAN_SERVING_DEGRADE = "serving_degrade"
+GUARDIAN_SERVING_DEGRADE_DEFAULT = True
+GUARDIAN_PAUSE_RULES = "pause_rules"        # [] -> built-in overload rules
+GUARDIAN_RESUME_CLEAR_STEPS = "resume_clear_steps"
+GUARDIAN_RESUME_CLEAR_STEPS_DEFAULT = 64
 
 # Eigenvalue (MoQ curvature)
 EIGENVALUE = "eigenvalue"

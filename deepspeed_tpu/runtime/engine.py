@@ -538,6 +538,47 @@ class DeepSpeedEngine:
                 self.telemetry.tracer.set_process_label(
                     f"rank {frank}", sort_index=frank)
 
+        # ---- self-healing guardian (runtime/guardian.py) ------------------
+        # anomaly->action policy engine: the monitors above classify and
+        # escalate; the guardian (when armed) subscribes to their
+        # on_anomaly hooks and performs bounded actions — emergency
+        # checkpoint, rollback, fp16 rescue, serving admission pause.
+        # Single-process only for now: a rollback swaps the LIVE train
+        # state, and coordinating that across ranks is the multi-replica
+        # failover item on the roadmap (this substrate feeds it).
+        self._guardian = None
+        self._guardian_ckpt_dir = None      # learned from save_checkpoint
+        self._guardian_data_iter = None     # learned from train_batch
+        gcfg = self.config.guardian
+        if bool(getattr(gcfg, "enabled", False)) and not self._abstract_init:
+            if dist.get_process_count() > 1:
+                logger.warning(
+                    "[guardian] enabled but running multi-process; the "
+                    "guardian's rollback/rescue actions are single-process "
+                    "only — disarming (cross-rank healing is the fleet "
+                    "failover roadmap item)")
+            else:
+                from deepspeed_tpu.runtime.guardian import Guardian
+                self._guardian = Guardian.from_config(
+                    gcfg, output_path=tcfg.output_path or "telemetry/",
+                    job_name=tcfg.job_name or "",
+                    registry=self.telemetry.registry)
+                self._guardian.emergency_save_fn = \
+                    self._guardian_emergency_save
+                self._guardian.rollback_fn = self._guardian_rollback
+                self._guardian.fp16_rescue_fn = self._guardian_fp16_rescue
+                # subscribe to every armed monitor's action hook (the
+                # serving observatory is wired by ServingEngine, which
+                # shares this instance)
+                if self.telemetry.health is not None:
+                    self.telemetry.health.on_anomaly = \
+                        self._guardian.hook("health")
+                if self._goodput is not None:
+                    self._goodput.on_anomaly = self._guardian.hook("goodput")
+                if self._fleet_monitor is not None:
+                    self._fleet_monitor.on_anomaly = \
+                        self._guardian.hook("fleet")
+
         # ---- parameters / state init --------------------------------------
         with self.telemetry.span("engine/init_state"):
             self._init_state(model_parameters, sample_batch)
@@ -2105,6 +2146,87 @@ class DeepSpeedEngine:
             self._fleet_monitor.write_snapshot(force=True, report=report)
         return report
 
+    # ----------------------------------------------------------- guardian
+    def guardian_report(self, write=False):
+        """The guardian's action journal (what ``GUARDIAN.json`` holds):
+        armed policies, rules seen, every action taken with its trigger
+        rule and outcome. ``{"enabled": False}`` when the guardian is
+        off (or disarmed by multi-process)."""
+        if self._guardian is None:
+            return {"enabled": False}
+        report = self._guardian.report()
+        if write:
+            self._guardian.write_journal()
+        return report
+
+    def _guardian_emergency_save(self, step):
+        """Guardian action (a): an extra checkpoint through the normal
+        save path (async writer when configured, one in flight). The tag
+        is prefixed so rollback can de-prioritize it — state saved
+        BECAUSE something looked wrong is of unknown health."""
+        from deepspeed_tpu.runtime.guardian import EMERGENCY_TAG_PREFIX
+        save_dir = self._guardian_ckpt_dir
+        if save_dir is None:
+            raise RuntimeError(
+                "no checkpoint directory known yet (the guardian learns "
+                "it from the first user save_checkpoint())")
+        tag = f"{EMERGENCY_TAG_PREFIX}_step{int(step)}"
+        self.save_checkpoint(save_dir, tag=tag,
+                             data_iter=self._guardian_data_iter,
+                             initiator="guardian")
+        return tag
+
+    def _guardian_rollback(self):
+        """Guardian action (b): restore the newest intact tag — params,
+        optimizer state, loss-scale state and the data-stream position —
+        through the normal load path. Prefers user tags over the
+        guardian's own emergency tags (those may hold exactly the state
+        this rollback exists to escape); the whole interval books as
+        ``checkpoint_load`` badput."""
+        from deepspeed_tpu.runtime import checkpoint_io
+        from deepspeed_tpu.runtime.guardian import EMERGENCY_TAG_PREFIX
+        save_dir = self._guardian_ckpt_dir
+        if save_dir is None:
+            raise RuntimeError(
+                "no checkpoint directory known yet (the guardian learns "
+                "it from the first user save_checkpoint())")
+        try:
+            names = os.listdir(save_dir)
+        except OSError:
+            names = []
+        emergency = [n for n in names
+                     if n.startswith(EMERGENCY_TAG_PREFIX)]
+        tag = checkpoint_io.newest_intact_tag(save_dir, exclude=emergency)
+        if tag is None and emergency:
+            tag = checkpoint_io.newest_intact_tag(save_dir)
+        if tag is None:
+            raise RuntimeError(
+                f"no intact checkpoint tag under {save_dir} to roll "
+                f"back to")
+        with self.telemetry.span("guardian/rollback", tag=str(tag)):
+            path, _ = self.load_checkpoint(
+                save_dir, tag=tag, data_iter=self._guardian_data_iter)
+        if path is None:
+            raise RuntimeError(f"rollback load of tag {tag!r} failed")
+        return tag
+
+    def _guardian_fp16_rescue(self):
+        """Guardian action (c): reset the dynamic loss scaler out of
+        collapse — an escape scale with fresh good-step count and
+        hysteresis. The LR schedule is traced INTO the compiled step
+        program, so the scaler state (same shapes/dtypes, zero
+        recompiles) is the intervention surface."""
+        if not self.config.fp16_enabled:
+            raise RuntimeError("fp16_rescue on a non-fp16 engine")
+        old_scale = float(jax.device_get(self.state.scale.loss_scale))
+        old_hyst = int(jax.device_get(self.state.scale.hysteresis))
+        new_scale = max(old_scale * 16.0, 16.0)
+        self.state = self.state._replace(scale=LossScaleState(
+            loss_scale=jnp.float32(new_scale),
+            good_steps=jnp.int32(0),
+            hysteresis=jnp.int32(max(old_hyst, 2))))
+        return f"loss_scale {old_scale:g} -> {new_scale:g}"
+
     def _lr_fn_traced(self, step):
         """LR schedule on a traced step: the four built-in schedules are
         written in jnp so they compile straight into the apply step."""
@@ -2522,6 +2644,12 @@ class DeepSpeedEngine:
             self._last_grad_norm = (
                 sample["grad_norm"] if sample is not None
                 else float(jax.device_get(self._pending_grad_norm)))
+        if self._guardian is not None:
+            # anomaly->action policies run HERE, on the main thread at
+            # the step boundary — the only place swapping the live train
+            # state (rollback, fp16 rescue) is safe. One attribute read
+            # and a truthiness check when nothing is pending.
+            self._guardian.tick(self.global_steps)
 
     def _fused_train_batch(self, data_iter, batch):
         """gas=1 fast path: one fused compiled program per global step."""
@@ -2558,6 +2686,11 @@ class DeepSpeedEngine:
     def train_batch(self, data_iter=None, batch=None):
         """One full global step: gas micro-batches + optimizer step."""
         if data_iter is not None:
+            if self._guardian is not None:
+                # rollback rewinds the LIVE loader (the same PR-7 resume
+                # machinery as load_checkpoint(data_iter=...)) — keep a
+                # handle to the caller's raw iterator, pre-prefetch-wrap
+                self._guardian_data_iter = data_iter
             data_iter = self._maybe_prefetch_iter(data_iter)
         tel = self.telemetry
         if not tel.enabled:
@@ -2915,6 +3048,13 @@ class DeepSpeedEngine:
                 _fleet_mod.reset_shipper(if_current=self._fleet)
             if self._fleet_monitor is not None:
                 self._fleet_monitor.close()
+            if self._guardian is not None:
+                try:
+                    # final journal (only when there is something to
+                    # explain) — before telemetry goes away
+                    self._guardian.close()
+                except Exception as e:
+                    logger.warning("[guardian] final journal failed: %s", e)
             self.telemetry.close()
 
     # ------------------------------------------------------------ checkpoints
@@ -2931,7 +3071,7 @@ class DeepSpeedEngine:
                             f"zero_pp_rank_{pp_rank}_mp_rank_00" + OPTIM_FILE_SUFFIX)
 
     def save_checkpoint(self, save_dir, tag=None, client_state=None,
-                        save_latest=True, data_iter=None):
+                        save_latest=True, data_iter=None, initiator="user"):
         """Shard-aware save: every process writes its addressable shards of
         params + optimizer state to its zero_pp_rank file (reference
         per-rank partition files, engine.py:2345); process 0 additionally
@@ -2950,10 +3090,18 @@ class DeepSpeedEngine:
 
         ``data_iter``: a :class:`RepeatingLoader` (or anything exposing
         ``state_dict``) whose stream position is carried in the
-        checkpoint, so a preempted run resumes its exact batch stream."""
+        checkpoint, so a preempted run resumes its exact batch stream.
+
+        ``initiator``: who asked for this save — ``"user"`` (default) or
+        ``"guardian"`` for the policy engine's emergency saves. Carried
+        on the checkpoint spans so a trace distinguishes the two."""
         if tag is None:
             tag = f"global_step{self.global_steps}"
         tag = str(tag)
+        if self._guardian is not None and initiator == "user":
+            # the guardian's emergency-save / rollback actions need a
+            # checkpoint directory; the user's own saves teach it one
+            self._guardian_ckpt_dir = save_dir
         if self._ckpt_writer is not None:
             # one save in flight, ever: drain the previous persist so two
             # saves can never interleave files or race the latest pointer
@@ -2961,13 +3109,15 @@ class DeepSpeedEngine:
             with self._led_attr("checkpoint_save"):
                 self._ckpt_writer.drain()
         with self._led_attr("checkpoint_save"), \
-                self.telemetry.span("checkpoint/save", tag=tag):
+                self.telemetry.span("checkpoint/save", tag=tag,
+                                    initiator=initiator):
             self._validate_checkpoint_tag(tag)
             os.makedirs(os.path.join(save_dir, tag), exist_ok=True)
             snapshot = self._snapshot_checkpoint(client_state, data_iter)
         if not self._ckpt_async:
             with self._led_attr("checkpoint_save"), \
-                    self.telemetry.span("checkpoint/persist", tag=tag):
+                    self.telemetry.span("checkpoint/persist", tag=tag,
+                                        initiator=initiator):
                 self._persist_checkpoint(save_dir, tag, snapshot,
                                          save_latest)
             log_dist(f"saved checkpoint {save_dir}/{tag}", ranks=[0])
@@ -2987,7 +3137,9 @@ class DeepSpeedEngine:
         if self._ckpt_writer is None:
             from deepspeed_tpu.runtime.async_checkpoint import \
                 AsyncCheckpointWriter
-            self._ckpt_writer = AsyncCheckpointWriter()
+            self._ckpt_writer = AsyncCheckpointWriter(
+                retries=self.config.checkpoint_persist_retries,
+                backoff_s=self.config.checkpoint_persist_backoff_s)
         return self._ckpt_writer
 
     def _validate_checkpoint_tag(self, tag):
@@ -3202,6 +3354,21 @@ class DeepSpeedEngine:
     def load_checkpoint(self, load_dir, tag=None, load_module_strict=True,
                         load_optimizer_states=True, load_lr_scheduler_states=True,
                         load_module_only=False, data_iter=None):
+        # the WHOLE restore interval books as checkpoint_load badput:
+        # shard reassembly and device_put after the file reads used to
+        # land in the unattributed residual (attribution is nesting-safe
+        # — the inner read intervals just shrink this one's self time)
+        with self._led_attr("checkpoint_load"):
+            return self._load_checkpoint(
+                load_dir, tag=tag, load_module_strict=load_module_strict,
+                load_optimizer_states=load_optimizer_states,
+                load_lr_scheduler_states=load_lr_scheduler_states,
+                load_module_only=load_module_only, data_iter=data_iter)
+
+    def _load_checkpoint(self, load_dir, tag=None, load_module_strict=True,
+                         load_optimizer_states=True,
+                         load_lr_scheduler_states=True,
+                         load_module_only=False, data_iter=None):
         if self._ckpt_writer is not None:
             # an in-flight async save must be durable before tags are
             # read — and its failure must surface here, not be read over

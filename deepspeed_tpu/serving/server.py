@@ -57,6 +57,19 @@ class ServingLivelockError(RuntimeError):
         self.report = report
 
 
+class ServingAdmissionPausedError(RuntimeError):
+    """``submit()`` refused a request because the guardian paused
+    admission (overload degradation). Carries the SLO rule that
+    triggered the pause in ``.rule`` — the structured reason a client
+    can act on (back off, shed, retry later)."""
+
+    def __init__(self, rule):
+        super().__init__(
+            f"admission paused by the guardian (rule {rule!r}): the "
+            f"server is shedding load; retry after recovery")
+        self.rule = rule
+
+
 @dataclasses.dataclass
 class RequestOutput:
     req_id: int
@@ -69,11 +82,15 @@ class RequestOutput:
 
 
 class ServingEngine:
-    def __init__(self, engine, config=None, registry=None, use_flash=None):
+    def __init__(self, engine, config=None, registry=None, use_flash=None,
+                 guardian=None):
         """``engine``: an ``InferenceEngine`` wrapping a GPT-2-family
         model; ``config``: ``DeepSpeedServingConfig``, a ds-config dict
         (with or without the outer ``{"serving": ...}``), or ``None`` for
-        defaults."""
+        defaults; ``guardian``: a :class:`runtime.guardian.Guardian` to
+        wire the overload-degradation policy into (falls back to the
+        wrapped engine's own, when it has one — training and serving
+        actions then share one journal)."""
         from deepspeed_tpu.runtime.config import DeepSpeedServingConfig
         if config is None:
             config = DeepSpeedServingConfig({})
@@ -123,6 +140,19 @@ class ServingEngine:
                 registry=self.registry,
                 engine_state_fn=self._engine_state)
             self.scheduler.observer = self.observatory
+        # guardian overload degradation (runtime/guardian.py): the SLO
+        # monitor's anomalies feed the guardian, whose serving policy
+        # pauses/resumes admission through the callbacks below
+        self.guardian = guardian if guardian is not None \
+            else getattr(engine, "_guardian", None)
+        self._serving_steps = 0
+        self._admission_pause_rule = None     # None = admission open
+        if self.guardian is not None and self.guardian.enabled \
+                and self.guardian.serving_degrade:
+            self.guardian.pause_fn = self._pause_admission
+            self.guardian.resume_fn = self._resume_admission
+            if self.observatory is not None:
+                self.observatory.on_anomaly = self.guardian.hook("serving")
         self._watch = CompileWatch(registry=self.registry)
         self._decode_fn = self._watch.wrap(self.runner.decode_step,
                                            name="serving_decode_step")
@@ -153,7 +183,15 @@ class ServingEngine:
                top_p=1.0, seed=0, eos_token_id=None) -> int:
         """Enqueue one request; returns its id. ``temperature<=0`` is
         greedy; otherwise temperature+top-p sampling on the request's own
-        seeded RNG lane."""
+        seeded RNG lane. Raises :class:`ServingAdmissionPausedError`
+        while the guardian has admission paused — failing fast beats
+        joining a queue that cannot drain."""
+        if self._admission_pause_rule is not None:
+            self.registry.counter(
+                "serving_requests_rejected_total",
+                "submits refused while admission was paused",
+                labels={"reason": "admission_paused"}).inc()
+            raise ServingAdmissionPausedError(self._admission_pause_rule)
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         vs = self.engine.module.config.vocab_size
         if prompt and (min(prompt) < 0 or max(prompt) >= vs):
@@ -210,7 +248,62 @@ class ServingEngine:
                     kv_occupancy=self.cache.allocator.occupancy(),
                     kv_fragmentation=self._kv_fragmentation(),
                     progress=progress)
+            if self.guardian is not None:
+                # serving's own step clock (NOT training steps): the
+                # pause policy fires here, and recovery is measured in
+                # quiet serving steps
+                self._serving_steps += 1
+                self.guardian.serving_tick(self._serving_steps)
         return progress
+
+    def _pause_admission(self, rule):
+        """Guardian overload action: refuse new submits (fail fast with
+        the rule as the structured reason) until recovery. Already-queued
+        requests keep draining — the point is to stop the queue growing,
+        not to drop accepted work."""
+        self._admission_pause_rule = str(rule)
+        self.registry.gauge(
+            "serving_admission_paused",
+            "1 while the guardian has admission paused").set(1)
+        log_dist(f"serving: admission PAUSED (rule {rule}); new submits "
+                 f"fail fast until recovery", ranks=[0])
+
+    def _resume_admission(self):
+        """Guardian recovery action: the overload rules stayed quiet for
+        ``resume_clear_steps`` serving steps."""
+        self._admission_pause_rule = None
+        self.registry.gauge(
+            "serving_admission_paused",
+            "1 while the guardian has admission paused").set(0)
+        log_dist("serving: admission RESUMED", ranks=[0])
+
+    def _fail_all_pending(self, reason):
+        """Fail every waiting AND slotted request with *reason* —
+        structured last rites instead of a silent livelock death. Slotted
+        requests release their KV blocks through the normal finish path,
+        so the pool is clean for a post-mortem restart."""
+        count = 0
+        waiting, self.scheduler.waiting = \
+            list(self.scheduler.waiting), type(self.scheduler.waiting)()
+        for req in waiting:
+            req.state = RequestState.FINISHED
+            req.finish_reason = reason
+            req.finish_t = time.perf_counter()
+            self._finished.append(req)
+            count += 1
+        for slot, req in enumerate(self.scheduler.slots):
+            if req is None:
+                continue
+            self.scheduler.finish(req, reason)
+            self._finished.append(req)
+            if self.observatory is not None:
+                self.observatory.record_finish(req, reason, slot)
+            count += 1
+        if count:
+            self.registry.counter(
+                "serving_requests_finished_total",
+                "requests completed", labels={"reason": reason}).inc(count)
+        return count
 
     def _drain_failed(self) -> bool:
         """Requests the scheduler failed at admission (prompt + generated
@@ -419,6 +512,7 @@ class ServingEngine:
         idle = 0
         while True:
             while source is not None and \
+                    self._admission_pause_rule is None and \
                     self.scheduler.num_waiting < 2 * self.max_batch:
                 try:
                     self.submit(**next(source))
@@ -432,13 +526,16 @@ class ServingEngine:
                 # the scheduler guarantees forward progress (budget
                 # shrink-to-owned-capacity + admission-infeasibility
                 # failure); a long idle spin means that invariant broke.
-                # Attach the full serving report so the forensics that
-                # motivated this guard survive the crash.
-                report = self.serving_report()
+                # Last rites BEFORE raising: every pending request fails
+                # with a structured reason (a client sees "livelock", not
+                # a hang), and the forensics snapshot is forced to disk —
+                # then the report also rides the exception.
+                n = self._fail_all_pending("livelock")
+                report = self.serving_report(write=True)
                 raise ServingLivelockError(
                     "serving made no progress for 1000 iterations — "
-                    f"waiting={self.scheduler.num_waiting} "
-                    f"active={self.scheduler.num_active} "
+                    f"failed {n} pending request(s) with reason "
+                    f"'livelock'; "
                     f"kv_free={self.cache.allocator.num_free}/"
                     f"{self.cache.allocator.num_usable} blocks "
                     "(scheduler/slot/KV state dump attached as "

@@ -555,7 +555,8 @@ class FleetMonitor:
                  step_time_skew_frac=0.25, input_wait_skew_frac=0.25,
                  checkpoint_skew_frac=0.5, checkpoint_skew_floor_ms=50.0,
                  warmup_windows=1, window_ring=128,
-                 registry=None, on_escalate=None, log_fn=None):
+                 registry=None, on_escalate=None, on_anomaly=None,
+                 log_fn=None):
         self.run_dir = run_dir
         self.job_name = job_name
         if snapshot_path is None:
@@ -573,6 +574,7 @@ class FleetMonitor:
         self.warmup_windows = int(warmup_windows)
         self.registry = registry
         self.on_escalate = on_escalate
+        self.on_anomaly = on_anomaly
         self._log = log_fn or logger.warning
 
         self._rank_next = {}          # rank -> next window index to read
@@ -595,7 +597,8 @@ class FleetMonitor:
 
     @classmethod
     def from_config(cls, tconfig, run_dir, output_path="telemetry/",
-                    job_name="", registry=None, on_escalate=None):
+                    job_name="", registry=None, on_escalate=None,
+                    on_anomaly=None):
         """Build from a parsed ``DeepSpeedTelemetryConfig``'s ``fleet_*``
         fields."""
         snap = getattr(tconfig, "fleet_snapshot_file", "") \
@@ -616,7 +619,8 @@ class FleetMonitor:
                 tconfig, "fleet_checkpoint_skew_floor_ms", 50.0),
             warmup_windows=getattr(tconfig, "fleet_warmup_windows", 1),
             window_ring=getattr(tconfig, "fleet_window_ring", 128),
-            registry=registry, on_escalate=on_escalate)
+            registry=registry, on_escalate=on_escalate,
+            on_anomaly=on_anomaly)
 
     # ------------------------------------------------------------ scanning
     def scan(self):
@@ -1018,6 +1022,11 @@ class FleetMonitor:
                 self.on_escalate()
             except Exception as e:   # forensics must never kill a step
                 logger.warning("[fleet] on_escalate hook failed: %s", e)
+        if self.on_anomaly is not None:
+            try:
+                self.on_anomaly(anoms)
+            except Exception as e:   # a policy engine must not either
+                logger.warning("[fleet] on_anomaly hook failed: %s", e)
 
     # -------------------------------------------------------------- output
     def verdict(self):

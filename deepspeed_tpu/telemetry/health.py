@@ -232,7 +232,7 @@ class HealthMonitor:
                  grad_spike_zscore=6.0, warmup_samples=8, overflow_streak=4,
                  min_scale=1.0, stall_window=50, stall_rel_delta=1e-3,
                  ring_size=256, registry=None, on_escalate=None,
-                 census_fn=None, log_fn=None):
+                 on_anomaly=None, census_fn=None, log_fn=None):
         self.job_name = job_name
         self.snapshot_path = snapshot_path
         self.bucket_names = list(bucket_names)
@@ -245,6 +245,7 @@ class HealthMonitor:
         self.stall_rel_delta = float(stall_rel_delta)
         self.registry = registry
         self.on_escalate = on_escalate
+        self.on_anomaly = on_anomaly
         self.census_fn = census_fn
         self._log = log_fn or logger.warning
 
@@ -267,7 +268,7 @@ class HealthMonitor:
 
     @classmethod
     def from_config(cls, tconfig, output_path="telemetry/", job_name="",
-                    registry=None, on_escalate=None):
+                    registry=None, on_escalate=None, on_anomaly=None):
         """Build from a parsed ``DeepSpeedTelemetryConfig``'s ``health_*``
         fields (the engine fills mesh-dependent attributes — bucket
         names, fp16 ``min_scale``, the census header — after its step
@@ -288,7 +289,8 @@ class HealthMonitor:
             stall_window=getattr(tconfig, "health_stall_window", 50),
             stall_rel_delta=getattr(tconfig, "health_stall_rel_delta", 1e-3),
             ring_size=getattr(tconfig, "health_ring_size", 256),
-            registry=registry, on_escalate=on_escalate)
+            registry=registry, on_escalate=on_escalate,
+            on_anomaly=on_anomaly)
 
     # ------------------------------------------------------------ per step
     def note_step(self, step, overflowed):
@@ -420,6 +422,11 @@ class HealthMonitor:
                 self.on_escalate()
             except Exception as e:   # forensics must never kill a step
                 logger.warning("[health] on_escalate hook failed: %s", e)
+        if self.on_anomaly is not None:
+            try:
+                self.on_anomaly(anoms)
+            except Exception as e:   # a policy engine must not either
+                logger.warning("[health] on_anomaly hook failed: %s", e)
 
     # ------------------------------------------------------------- outputs
     def verdict(self):

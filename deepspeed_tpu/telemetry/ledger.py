@@ -206,7 +206,8 @@ class GoodputLedger:
                  warmup_windows=1, window_ring=128,
                  profiler_capture=True, profiler_capture_steps=5,
                  profiler_max_captures=1, profiler_dir="goodput_profile",
-                 registry=None, on_escalate=None, log_fn=None):
+                 registry=None, on_escalate=None, on_anomaly=None,
+                 log_fn=None):
         self.enabled = bool(enabled)
         self.job_name = job_name
         self.snapshot_path = snapshot_path
@@ -220,6 +221,7 @@ class GoodputLedger:
         self.profiler_dir = profiler_dir
         self.registry = registry
         self.on_escalate = on_escalate
+        self.on_anomaly = on_anomaly
         self.breakdown_fn = None     # engine wires wall_clock_breakdown
         self._log = log_fn or logger.warning
         self._clock = time.monotonic
@@ -253,7 +255,7 @@ class GoodputLedger:
 
     @classmethod
     def from_config(cls, tconfig, output_path="telemetry/", job_name="",
-                    registry=None, on_escalate=None):
+                    registry=None, on_escalate=None, on_anomaly=None):
         """Build from a parsed ``DeepSpeedTelemetryConfig``'s
         ``goodput_*`` fields."""
         snap = getattr(tconfig, "goodput_snapshot_file", "") \
@@ -280,7 +282,8 @@ class GoodputLedger:
             profiler_max_captures=getattr(
                 tconfig, "goodput_profiler_max_captures", 1),
             profiler_dir=pdir,
-            registry=registry, on_escalate=on_escalate)
+            registry=registry, on_escalate=on_escalate,
+            on_anomaly=on_anomaly)
 
     # ---------------------------------------------------------- attribution
     def _stack(self):
@@ -524,6 +527,11 @@ class GoodputLedger:
                 self.on_escalate()
             except Exception as e:  # forensics must never kill a step
                 logger.warning("[goodput] on_escalate hook failed: %s", e)
+        if self.on_anomaly is not None:
+            try:
+                self.on_anomaly(anoms)
+            except Exception as e:  # a policy engine must not either
+                logger.warning("[goodput] on_anomaly hook failed: %s", e)
 
     # ------------------------------------------------------ profiler capture
     def _maybe_start_capture(self, step):

@@ -212,7 +212,7 @@ class ServingObservatory:
                  queue_growth_windows=3, preemption_thrash=8,
                  no_progress_steps=200, timeline_ring=64, window_ring=128,
                  trace_lanes=True, registry=None, on_escalate=None,
-                 engine_state_fn=None, log_fn=None):
+                 on_anomaly=None, engine_state_fn=None, log_fn=None):
         self.max_batch = int(max_batch)
         self.job_name = job_name
         self.snapshot_path = snapshot_path
@@ -227,6 +227,7 @@ class ServingObservatory:
         self.registry = registry
         self.on_escalate = on_escalate if on_escalate is not None \
             else _flush_trace
+        self.on_anomaly = on_anomaly
         self.engine_state_fn = engine_state_fn
         self._log = log_fn or logger.warning
 
@@ -263,7 +264,7 @@ class ServingObservatory:
     @classmethod
     def from_config(cls, obs_config, max_batch, decode_steps=1,
                     job_name="", registry=None, on_escalate=None,
-                    engine_state_fn=None):
+                    on_anomaly=None, engine_state_fn=None):
         """Build from a parsed ``serving.observability`` block
         (:class:`~deepspeed_tpu.runtime.config.
         DeepSpeedServingObservabilityConfig`)."""
@@ -282,7 +283,7 @@ class ServingObservatory:
             window_ring=obs_config.window_ring,
             trace_lanes=obs_config.trace_lanes,
             registry=registry, on_escalate=on_escalate,
-            engine_state_fn=engine_state_fn)
+            on_anomaly=on_anomaly, engine_state_fn=engine_state_fn)
 
     # ------------------------------------------------------------- clock
     def _now_ms(self):
@@ -672,6 +673,11 @@ class ServingObservatory:
                 self.on_escalate()
             except Exception as e:   # forensics must never kill a step
                 logger.warning("[serving] on_escalate hook failed: %s", e)
+        if self.on_anomaly is not None:
+            try:
+                self.on_anomaly(anoms)
+            except Exception as e:   # a policy engine must not either
+                logger.warning("[serving] on_anomaly hook failed: %s", e)
 
     # ----------------------------------------------------------- outputs
     def verdict(self):

@@ -1,0 +1,16 @@
+"""Deterministic chaos/fault-injection harness (``deepspeed_tpu.testing.chaos``).
+
+Test-support code only: nothing in the runtime imports this package, so a
+production process never pays for (or accidentally arms) an injector.
+"""
+
+from deepspeed_tpu.testing.chaos import (   # noqa: F401
+    ChaosFault,
+    DivergenceChaos,
+    FaultSchedule,
+    FilesystemChaos,
+    Injector,
+    PoolStarvationChaos,
+    SigkillChaos,
+    SlowCollateIterator,
+)

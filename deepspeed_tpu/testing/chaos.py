@@ -1,0 +1,304 @@
+"""Deterministic chaos harness: seeded, budgeted fault injectors.
+
+The guardian (``runtime/guardian.py``) closes the anomaly->action loop;
+this module is the other half of the proof — a way to MAKE the anomalies
+happen, deterministically, so an e2e test can assert that each policy
+fires, acts, and the run actually recovers.
+
+Design rules every injector follows:
+
+* **seeded** — the fault schedule is a pure function of the seed and the
+  call sequence (``random.Random(seed)``, never global randomness), so a
+  failing chaos test replays bit-identically;
+* **budgeted** — an injector stops firing after ``budget`` faults; an
+  exhausted schedule is the "transient failure" shape retry logic must
+  survive (and tests assert exhaustion explicitly);
+* **reversible** — patched call sites are recorded and restored in
+  reverse order by ``uninstall()`` (or context-manager exit); teardown
+  leaves the process exactly as found, and the unit suite asserts it.
+
+Nothing in the runtime imports this module — chaos is pulled in by tests
+(and the guardian demo CLI) only.
+"""
+
+import errno
+import os
+import random
+import signal
+import time
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class ChaosFault(OSError):
+    """The synthetic failure an injector raises. An ``OSError`` on
+    purpose: retry/fallback paths must treat it exactly like the real
+    transient I/O error it stands in for."""
+
+
+class FaultSchedule:
+    """Seeded fire/don't-fire decision stream with an error budget.
+
+    ``should_fire()`` is called once per guarded operation: it fires with
+    probability ``p`` (1.0 = every call) once ``start_after`` calls have
+    passed, and never more than ``budget`` times total. Two schedules
+    built with the same arguments make identical decisions.
+    """
+
+    def __init__(self, seed=0, p=1.0, budget=1, start_after=0):
+        self._rng = random.Random(seed)
+        self.p = float(p)
+        self.budget = int(budget)
+        self.start_after = int(start_after)
+        self.calls = 0
+        self.fired = 0
+
+    def should_fire(self):
+        self.calls += 1
+        if self.calls <= self.start_after or self.exhausted:
+            return False
+        # the RNG is consumed only on eligible calls so start_after does
+        # not shift the decision stream
+        if self.p >= 1.0 or self._rng.random() < self.p:
+            self.fired += 1
+            return True
+        return False
+
+    @property
+    def exhausted(self):
+        return self.fired >= self.budget
+
+    def describe(self):
+        return {"calls": self.calls, "fired": self.fired,
+                "budget": self.budget, "exhausted": self.exhausted}
+
+
+class Injector:
+    """Reversible monkey-patching base.
+
+    Subclasses implement ``_install()`` (declaring patches through
+    ``self._patch(obj, name, replacement)``) and optionally
+    ``_uninstall()`` for non-attribute resources (e.g. held pool
+    blocks). ``uninstall`` restores every patched attribute in reverse
+    order and is idempotent; the context-manager form guarantees
+    restoration even when the test body throws.
+    """
+
+    def __init__(self):
+        self._patches = []           # (obj, name, original), applied order
+        self.installed = False
+
+    def install(self):
+        if not self.installed:
+            self._install()
+            self.installed = True
+        return self
+
+    def uninstall(self):
+        if not self.installed:
+            return
+        try:
+            self._uninstall()
+        finally:
+            while self._patches:
+                obj, name, original = self._patches.pop()
+                setattr(obj, name, original)
+            self.installed = False
+
+    def _install(self):
+        raise NotImplementedError
+
+    def _uninstall(self):
+        pass
+
+    def _patch(self, obj, name, replacement):
+        original = getattr(obj, name)
+        self._patches.append((obj, name, original))
+        setattr(obj, name, replacement)
+        return original
+
+    def __enter__(self):
+        return self.install()
+
+    def __exit__(self, *exc):
+        self.uninstall()
+        return False
+
+
+class FilesystemChaos(Injector):
+    """Budgeted checkpoint write/rename failures.
+
+    Patches ``checkpoint_io._atomic_write`` — the single seam every
+    checkpoint byte goes through (tmp write + fsync + rename) — so a
+    fired fault aborts with :class:`ChaosFault` and the real file name is
+    never touched. ``op="write"`` fails before any bytes land;
+    ``op="rename"`` lands the bytes in a tmp sibling first and then
+    fails, leaving exactly the stray-tmp debris a real rename failure
+    leaves (readers skip tmp-marked names by contract).
+    """
+
+    def __init__(self, seed=0, p=1.0, budget=2, start_after=0, op="write"):
+        super().__init__()
+        if op not in ("write", "rename"):
+            raise ValueError(f"op must be 'write' or 'rename', got {op!r}")
+        self.schedule = FaultSchedule(seed=seed, p=p, budget=budget,
+                                      start_after=start_after)
+        self.op = op
+
+    def _install(self):
+        from deepspeed_tpu.runtime import checkpoint_io
+        orig = checkpoint_io._atomic_write
+
+        def _chaotic_atomic_write(path, write_fn):
+            if self.schedule.should_fire():
+                if self.op == "rename":
+                    tmp = f"{path}{checkpoint_io._TMP_MARK}chaos"
+                    with open(tmp, "wb") as f:
+                        write_fn(f)
+                raise ChaosFault(
+                    errno.EIO,
+                    f"chaos: injected {self.op} failure "
+                    f"({self.schedule.fired}/{self.schedule.budget}) for "
+                    f"{os.path.basename(path)}")
+            return orig(path, write_fn)
+
+        self._patch(checkpoint_io, "_atomic_write", _chaotic_atomic_write)
+
+
+class DivergenceChaos(Injector):
+    """Poison the model parameters with inf/NaN before a chosen step.
+
+    Patches the engine instance's ``train_batch`` so the Nth call (1-based
+    ``at_call``) first overwrites every leaf of one param bucket with
+    ``value``. The next forward produces a non-finite loss and the grad
+    census flags the bucket — the exact "run diverged" signature the
+    guardian's rollback policy confirms on (loss_spike + nonfinite_grads
+    streak). Restoring the checkpointed params is the only cure, which is
+    what makes this the honest rollback proof.
+    """
+
+    def __init__(self, engine, at_call, value=float("inf"), budget=1):
+        super().__init__()
+        self.engine = engine
+        self.at_call = int(at_call)
+        self.value = float(value)
+        self.budget = int(budget)
+        self.calls = 0
+        self.poisoned_steps = []
+
+    def _poison(self):
+        import jax
+        import jax.numpy as jnp
+        eng = self.engine
+        # poison the FIRST param leaf only: a realistic partial corruption
+        # (one module's weights), and the census names its bucket
+        leaves, treedef = jax.tree_util.tree_flatten(eng.state.params)
+        poisoned = [jax.device_put(jnp.full_like(leaves[0], self.value),
+                                   leaves[0].sharding)] + leaves[1:]
+        eng.state = eng.state._replace(
+            params=jax.tree_util.tree_unflatten(treedef, poisoned))
+        self.poisoned_steps.append(int(eng.global_steps))
+        logger.warning(
+            f"chaos: poisoned params with {self.value} before train_batch "
+            f"call {self.calls} (global_step {eng.global_steps})")
+
+    def _install(self):
+        eng = self.engine
+        orig = eng.train_batch
+
+        def _chaotic_train_batch(*args, **kwargs):
+            self.calls += 1
+            if self.calls == self.at_call \
+                    and len(self.poisoned_steps) < self.budget:
+                self._poison()
+            return orig(*args, **kwargs)
+
+        self._patch(eng, "train_batch", _chaotic_train_batch)
+
+
+class SlowCollateIterator:
+    """Wrap a data iterator so chosen ``__next__`` calls stall.
+
+    The injected sleep happens where a slow collate/storage stall would:
+    inside ``next()``, which the engine books as ``input_wait`` — the
+    goodput ledger's input-bound badput rules fire on exactly this.
+    State-dict passthrough keeps the wrapped loader resumable (the PR-7
+    rewind machinery sees the underlying iterator's position).
+    """
+
+    def __init__(self, base, delay_s=0.05, seed=0, p=1.0, budget=1,
+                 start_after=0):
+        self._base = base
+        self.delay_s = float(delay_s)
+        self.schedule = FaultSchedule(seed=seed, p=p, budget=budget,
+                                      start_after=start_after)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self.schedule.should_fire():
+            time.sleep(self.delay_s)
+        return next(self._base)
+
+    def state_dict(self):
+        fn = getattr(self._base, "state_dict", None)
+        return fn() if fn is not None else None
+
+    def load_state_dict(self, sd):
+        fn = getattr(self._base, "load_state_dict", None)
+        if fn is not None:
+            fn(sd)
+
+
+class SigkillChaos:
+    """SIGKILL the current process at a chosen step.
+
+    Only meaningful inside a sacrificial subprocess: the parent test
+    launches a run that calls ``maybe_kill(step)`` each step, observes
+    the kill, then asserts the NEXT run resumes from the last intact tag
+    (the crash-consistency contract checkpoint_io already pins). Not an
+    :class:`Injector` — there is nothing to restore after a SIGKILL.
+    """
+
+    def __init__(self, at_step):
+        self.at_step = int(at_step)
+
+    def maybe_kill(self, step):
+        if int(step) == self.at_step:
+            logger.warning(f"chaos: SIGKILL at step {step}")
+            os.kill(os.getpid(), signal.SIGKILL)
+
+
+class PoolStarvationChaos(Injector):
+    """Seize KV-cache blocks from a serving allocator so admission
+    starves.
+
+    Holding ``hold_blocks`` (or ``hold_frac`` of the usable pool) makes
+    waiting requests inadmissible: the queue grows, TTFT breaches — the
+    overload signature the guardian's admission-pause policy keys on.
+    ``uninstall`` returns every held block (the allocator's double-free
+    guard makes a leak loud, so the teardown assertion is structural).
+    """
+
+    def __init__(self, allocator, hold_blocks=None, hold_frac=0.9):
+        super().__init__()
+        self.allocator = allocator
+        if hold_blocks is None:
+            hold_blocks = int(allocator.num_usable * float(hold_frac))
+        self.hold_blocks = int(hold_blocks)
+        self.held = None
+
+    def _install(self):
+        n = min(self.hold_blocks, self.allocator.num_free)
+        self.held = self.allocator.allocate(n)
+        if self.held is None:      # all-or-nothing pool: hold what exists
+            self.held = []
+        logger.warning(
+            f"chaos: holding {len(self.held)} of "
+            f"{self.allocator.num_usable} KV blocks")
+
+    def _uninstall(self):
+        if self.held:
+            self.allocator.free(self.held)
+        self.held = None

@@ -1,0 +1,210 @@
+"""Chaos-harness unit tests (deepspeed_tpu/testing/chaos.py).
+
+The chaos injectors are test INFRASTRUCTURE, so their own contract gets
+pinned hardest: schedules are deterministic under a fixed seed (a failing
+chaos test must replay bit-identically), error budgets exhaust exactly,
+and teardown restores every patched call site — asserted by identity, so
+a leaked patch cannot hide behind an equal-looking wrapper.
+"""
+
+import errno
+import os
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime import checkpoint_io
+from deepspeed_tpu.serving.kv_cache import BlockAllocator
+from deepspeed_tpu.testing.chaos import (ChaosFault, FaultSchedule,
+                                         FilesystemChaos, Injector,
+                                         PoolStarvationChaos,
+                                         SigkillChaos, SlowCollateIterator)
+
+
+# ---------------------------------------------------------- FaultSchedule
+def test_schedule_deterministic_under_fixed_seed():
+    a = FaultSchedule(seed=7, p=0.4, budget=5)
+    b = FaultSchedule(seed=7, p=0.4, budget=5)
+    decisions_a = [a.should_fire() for _ in range(200)]
+    decisions_b = [b.should_fire() for _ in range(200)]
+    assert decisions_a == decisions_b
+    assert any(decisions_a), "p=0.4 over 200 calls must fire sometimes"
+    # a different seed gives a different stream (vanishingly unlikely to
+    # collide over 200 draws)
+    c = FaultSchedule(seed=8, p=0.4, budget=5)
+    assert [c.should_fire() for _ in range(200)] != decisions_a
+
+
+def test_schedule_budget_exhausts_exactly():
+    s = FaultSchedule(seed=0, p=1.0, budget=3)
+    fired = [s.should_fire() for _ in range(10)]
+    assert fired == [True, True, True] + [False] * 7
+    assert s.exhausted and s.fired == 3 and s.calls == 10
+    d = s.describe()
+    assert d["exhausted"] is True and d["budget"] == 3
+
+
+def test_schedule_start_after_does_not_shift_decisions():
+    """The RNG is consumed only on eligible calls: delaying the start
+    shifts WHEN the stream begins, not WHICH decisions it makes."""
+    base = FaultSchedule(seed=3, p=0.5, budget=100)
+    delayed = FaultSchedule(seed=3, p=0.5, budget=100, start_after=10)
+    base_stream = [base.should_fire() for _ in range(50)]
+    delayed_stream = [delayed.should_fire() for _ in range(60)]
+    assert delayed_stream[:10] == [False] * 10
+    assert delayed_stream[10:] == base_stream
+
+
+# --------------------------------------------------------------- Injector
+class _Target:
+    def ping(self):
+        return "real"
+
+
+def test_injector_install_uninstall_idempotent_and_identity_restoring():
+    tgt = _Target()
+    original = tgt.ping
+
+    class Patcher(Injector):
+        def _install(self):
+            self._patch(tgt, "ping", lambda: "chaos")
+
+    inj = Patcher()
+    inj.install()
+    inj.install()                      # idempotent: no double-record
+    assert tgt.ping() == "chaos"
+    inj.uninstall()
+    inj.uninstall()                    # idempotent: no restore-of-restore
+    assert tgt.ping() == "real"
+    assert tgt.ping == original        # IDENTITY, not just behaviour
+    assert not inj._patches
+
+
+def test_injector_context_restores_on_exception():
+    tgt = _Target()
+    original = tgt.ping
+
+    class Patcher(Injector):
+        def _install(self):
+            self._patch(tgt, "ping", lambda: "chaos")
+
+    with pytest.raises(RuntimeError):
+        with Patcher():
+            assert tgt.ping() == "chaos"
+            raise RuntimeError("test body died")
+    assert tgt.ping == original
+
+
+# -------------------------------------------------------- FilesystemChaos
+def test_filesystem_chaos_write_faults_then_restores(tmp_path):
+    original = checkpoint_io._atomic_write
+    path = str(tmp_path / "victim.bin")
+    with FilesystemChaos(budget=2, op="write") as fs:
+        for _ in range(2):
+            with pytest.raises(ChaosFault) as ei:
+                checkpoint_io._atomic_write(path, lambda f: f.write(b"x"))
+            assert ei.value.errno == errno.EIO
+            assert not os.path.exists(path)      # no bytes ever landed
+        # budget spent: the third write goes through for real
+        checkpoint_io._atomic_write(path, lambda f: f.write(b"x"))
+        assert os.path.exists(path)
+        assert fs.schedule.exhausted
+    # teardown restored the real call site by identity
+    assert checkpoint_io._atomic_write is original
+
+
+def test_filesystem_chaos_rename_leaves_tmp_debris(tmp_path):
+    """op='rename' is the nastier shape: bytes land under a tmp-marked
+    name and the final rename never happens — exactly the debris readers
+    skip by contract."""
+    path = str(tmp_path / "victim.bin")
+    with FilesystemChaos(budget=1, op="rename"):
+        with pytest.raises(ChaosFault):
+            checkpoint_io._atomic_write(path, lambda f: f.write(b"abc"))
+    assert not os.path.exists(path)
+    debris = [n for n in os.listdir(tmp_path)
+              if checkpoint_io._TMP_MARK in n]
+    assert debris, "rename chaos must leave the stray tmp sibling"
+    # a manifest-era reader skips tmp-marked names: the directory still
+    # verifies as missing/empty, never as a torn checkpoint
+    assert checkpoint_io.verify_tag(str(tmp_path))[0] != "intact"
+
+
+# ---------------------------------------------------- SlowCollateIterator
+def test_slow_collate_iterator_delays_and_passes_state(monkeypatch):
+    sleeps = []
+    import deepspeed_tpu.testing.chaos as chaos_mod
+    monkeypatch.setattr(chaos_mod.time, "sleep",
+                        lambda s: sleeps.append(s))
+
+    class Loader:
+        def __init__(self):
+            self.i = 0
+
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            self.i += 1
+            return self.i
+
+        def state_dict(self):
+            return {"i": self.i}
+
+        def load_state_dict(self, sd):
+            self.i = sd["i"]
+
+    base = Loader()
+    it = SlowCollateIterator(base, delay_s=0.25, budget=2, start_after=1)
+    assert [next(it) for _ in range(5)] == [1, 2, 3, 4, 5]
+    assert sleeps == [0.25, 0.25]          # budget=2, first call exempt
+    assert it.state_dict() == {"i": 5}     # PR-7 resume passthrough
+    it.load_state_dict({"i": 1})
+    assert next(it) == 2
+
+
+def test_slow_collate_iterator_tolerates_stateless_base():
+    it = SlowCollateIterator(iter([1, 2]), delay_s=0.0, budget=0)
+    assert it.state_dict() is None
+    it.load_state_dict({"i": 3})           # no-op, must not raise
+    assert next(it) == 1
+
+
+# ------------------------------------------------------------ SigkillChaos
+def test_sigkill_chaos_only_arms_at_its_step(monkeypatch):
+    kills = []
+    import deepspeed_tpu.testing.chaos as chaos_mod
+    monkeypatch.setattr(chaos_mod.os, "kill",
+                        lambda pid, sig: kills.append((pid, sig)))
+    k = SigkillChaos(at_step=3)
+    for step in (1, 2, 4, 5):
+        k.maybe_kill(step)
+    assert not kills
+    k.maybe_kill(3)
+    assert len(kills) == 1 and kills[0][0] == os.getpid()
+
+
+# ------------------------------------------------------ PoolStarvationChaos
+def test_pool_starvation_holds_and_returns_blocks():
+    alloc = BlockAllocator(num_blocks=17)    # 16 usable
+    free_before = alloc.num_free
+    chaos = PoolStarvationChaos(alloc, hold_frac=1.0)
+    with chaos:
+        assert len(chaos.held) == free_before
+        assert alloc.num_free == 0
+        # the starved pool refuses all-or-nothing allocation
+        assert alloc.allocate(1) is None
+    # teardown returned every block — a leak would trip the allocator's
+    # double-free guard on the next test, so assert structurally here
+    assert alloc.num_free == free_before
+    assert chaos.held is None
+
+
+def test_pool_starvation_partial_hold():
+    alloc = BlockAllocator(num_blocks=17)
+    with PoolStarvationChaos(alloc, hold_blocks=10):
+        assert alloc.num_free == alloc.num_usable - 10
+        got = alloc.allocate(3)            # the remainder still serves
+        assert got is not None and len(got) == 3
+        alloc.free(got)
+    assert alloc.num_free == alloc.num_usable

@@ -35,17 +35,19 @@ HIDDEN = 32
 
 def _engine(world=None, stage=2, async_save=False, fp16=False,
             scheduler=False, fallback=True, model=None, mp_rules=None,
-            batch_size=8, lr=1e-2):
+            batch_size=8, lr=1e-2, persist_retries=None):
     """Engine over the first *world* virtual devices (None = all 8) —
     world sizes 1/2/4/8 give the elastic dp matrix in one process."""
     groups.destroy()
     groups.initialize(devices=jax.devices()[:world] if world else None)
+    ckpt = {"async_save": async_save, "fallback_to_intact": fallback}
+    if persist_retries is not None:
+        ckpt["persist_retries"] = persist_retries
     config = {
         "train_batch_size": batch_size,
         "optimizer": {"type": "Adam", "params": {"lr": lr}},
         "zero_optimization": {"stage": stage},
-        "checkpoint": {"async_save": async_save,
-                       "fallback_to_intact": fallback},
+        "checkpoint": ckpt,
     }
     if fp16:
         # small initial scale: the point is carrying REAL dynamic-scale
@@ -158,7 +160,10 @@ class TestAsyncSave:
 
     def test_background_failure_reraises_at_next_save(self, tmp_path,
                                                       monkeypatch):
-        e = _engine(async_save=True)
+        # persist_retries=0: this test pins the fail-fast surfacing
+        # contract; with the default retry budget the retry would land
+        # after monkeypatch.undo() and quietly succeed.
+        e = _engine(async_save=True, persist_retries=0)
         e.train_batch(batch=_batch(0))
 
         def boom(obj, path, kind="checkpoint"):
@@ -177,7 +182,7 @@ class TestAsyncSave:
 
     def test_background_failure_reraises_at_close(self, tmp_path,
                                                   monkeypatch):
-        e = _engine(async_save=True)
+        e = _engine(async_save=True, persist_retries=0)
         e.train_batch(batch=_batch(0))
         monkeypatch.setattr(
             checkpoint_io, "dump_file",

@@ -1,0 +1,485 @@
+"""Guardian policy-engine tests: unit policy semantics + e2e chaos pins.
+
+Unit side drives ``notify``/``tick`` directly with synthetic anomaly
+dicts and stub callbacks — policy triggering, bounds (max actions,
+cooldown re-arm), journal discipline (an action that throws is a
+journaled failure, never an exception out of the step).
+
+E2E side is the acceptance proof: a real engine + the chaos harness per
+policy — divergence -> automatic rollback -> loss parity with an
+uninterrupted run (rtol 1e-4); persist failures -> retry -> intact
+manifest; serving overload -> admission pause -> recovery without the
+livelock guard firing.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.models.simple import SimpleModel, sample_batch
+from deepspeed_tpu.runtime import checkpoint_io
+from deepspeed_tpu.runtime.async_checkpoint import AsyncCheckpointError
+from deepspeed_tpu.runtime.config import DeepSpeedConfig, DeepSpeedConfigError
+from deepspeed_tpu.runtime.dataloader import RepeatingLoader
+from deepspeed_tpu.runtime.guardian import (EMERGENCY_TAG_PREFIX,
+                                            GUARDIAN_SCHEMA, Guardian)
+from deepspeed_tpu.testing.chaos import (DivergenceChaos, FilesystemChaos,
+                                         PoolStarvationChaos)
+from deepspeed_tpu.utils import groups
+
+HIDDEN = 32
+
+
+# ========================================================== policy units
+def _anom(rule, step, **kw):
+    return dict({"rule": rule, "step": step, "severity": "warning"}, **kw)
+
+
+def _guardian(**kw):
+    kw.setdefault("action_cooldown_steps", 0)
+    kw.setdefault("journal_path", None)       # in-memory
+    return Guardian(**kw)
+
+
+def test_rollback_requires_streak_and_spike():
+    g = _guardian(divergence_streak=2)
+    calls = []
+    g.rollback_fn = lambda: calls.append(1) or "tag"
+    # spike alone: no
+    g.notify("health", [_anom("loss_spike", 10)])
+    g.tick(10)
+    assert not calls
+    # one nonfinite step: streak of 1 < 2
+    g.notify("health", [_anom("nonfinite_grads", 11)])
+    g.tick(11)
+    assert not calls
+    # second distinct nonfinite step: confirmed
+    g.notify("health", [_anom("nonfinite_grads", 12),
+                        _anom("loss_spike", 12)])
+    g.tick(12)
+    assert calls == [1]
+    assert g.actions[-1]["action"] == "rollback"
+    assert g.actions[-1]["outcome"] == "ok"
+    assert g.actions[-1]["result"] == "tag"
+
+
+def test_rollback_evidence_expires_outside_window():
+    g = _guardian(divergence_window=5, divergence_streak=2)
+    g.rollback_fn = lambda: "tag"
+    g.notify("health", [_anom("nonfinite_grads", 10),
+                        _anom("loss_spike", 10)])
+    g.tick(10)
+    # 20 steps later: the old evidence slid out of the window
+    g.notify("health", [_anom("nonfinite_grads", 30)])
+    g.tick(30)
+    assert g.action_counts.get("rollback", 0) == 0
+
+
+def test_rollback_cooldown_rearm_prevents_loops():
+    g = _guardian(divergence_streak=1, rollback_cooldown_steps=100,
+                  max_rollbacks=5)
+    g.rollback_fn = lambda: "tag"
+
+    def diverge(step):
+        g.notify("health", [_anom("nonfinite_grads", step),
+                            _anom("loss_spike", step)])
+        g.tick(step)
+
+    diverge(10)
+    assert g.action_counts["rollback"] == 1
+    diverge(50)             # inside the cooldown: a persistently bad run
+    assert g.action_counts["rollback"] == 1, "rollback loop not re-armed"
+    diverge(111)            # cooldown passed: armed again
+    assert g.action_counts["rollback"] == 2
+
+
+def test_rollback_bounded_by_max():
+    g = _guardian(divergence_streak=1, rollback_cooldown_steps=1,
+                  max_rollbacks=2)
+    g.rollback_fn = lambda: "tag"
+    for step in (10, 20, 30, 40):
+        g.notify("health", [_anom("nonfinite_grads", step),
+                            _anom("loss_spike", step)])
+        g.tick(step)
+    assert g.action_counts["rollback"] == 2
+
+
+def test_emergency_checkpoint_first_firing_only():
+    g = _guardian(emergency_rules=("overflow_streak",))
+    tags = []
+    g.emergency_save_fn = lambda step: tags.append(step) or f"em_{step}"
+    g.notify("health", [_anom("overflow_streak", 5)])
+    g.tick(5)
+    assert tags == [5]
+    # second firing of the SAME rule is not a first warning
+    g.notify("health", [_anom("overflow_streak", 9)])
+    g.tick(9)
+    assert tags == [5]
+    # a rule outside emergency_rules never triggers one
+    g.notify("goodput", [_anom("goodput_regression", 12)])
+    g.tick(12)
+    assert tags == [5]
+
+
+def test_emergency_checkpoint_respects_max_and_cooldown():
+    g = _guardian(emergency_rules=("r1", "r2", "r3"),
+                  max_emergency_checkpoints=2, action_cooldown_steps=10)
+    g.emergency_save_fn = lambda step: "t"
+    g.notify("health", [_anom("r1", 5)])
+    g.tick(5)
+    g.notify("health", [_anom("r2", 7)])     # first firing, but cooldown
+    g.tick(7)
+    assert g.action_counts["emergency_checkpoint"] == 1
+    g.notify("health", [_anom("r2", 20)])    # r2 already seen: not first
+    g.tick(20)
+    assert g.action_counts["emergency_checkpoint"] == 1
+    g.notify("health", [_anom("r3", 30)])
+    g.tick(30)
+    assert g.action_counts["emergency_checkpoint"] == 2
+    g.notify("health", [_anom("loss_stall", 50)])   # max reached
+    g.tick(50)
+    assert g.action_counts["emergency_checkpoint"] == 2
+
+
+def test_fp16_rescue_bounded():
+    g = _guardian(max_fp16_rescues=1)
+    calls = []
+    g.fp16_rescue_fn = lambda: calls.append(1) or "scale reset"
+    for step in (5, 6):
+        g.notify("health", [_anom("loss_scale_collapse", step)])
+        g.tick(step)
+    assert calls == [1]
+
+
+def test_unwired_action_journals_skipped_never_raises():
+    g = _guardian(divergence_streak=1)
+    g.notify("health", [_anom("nonfinite_grads", 3),
+                        _anom("loss_spike", 3)])
+    g.tick(3)                                 # no rollback_fn wired
+    assert g.actions[-1]["outcome"] == "skipped:no_handler"
+    assert g.action_counts.get("rollback", 0) == 0
+
+
+def test_throwing_action_is_a_journaled_failure():
+    g = _guardian(divergence_streak=1)
+
+    def bad():
+        raise RuntimeError("no intact tag")
+
+    g.rollback_fn = bad
+    g.notify("health", [_anom("nonfinite_grads", 3),
+                        _anom("loss_spike", 3)])
+    g.tick(3)                                 # must NOT raise
+    assert g.actions[-1]["outcome"].startswith("failed:")
+    assert "no intact tag" in g.actions[-1]["outcome"]
+    assert g.action_counts.get("rollback", 0) == 0
+
+
+def test_serving_pause_and_resume_cycle():
+    g = _guardian(resume_clear_steps=3)
+    events = []
+    g.pause_fn = lambda rule: events.append(("pause", rule))
+    g.resume_fn = lambda: events.append(("resume",))
+    g.notify("serving", [_anom("queue_growth", 4)])
+    g.serving_tick(4)
+    assert g.admission_paused and events == [("pause", "queue_growth")]
+    # overload keeps firing: the quiet clock restarts, no double-pause
+    g.notify("serving", [_anom("ttft_slo_breach", 5)])
+    g.serving_tick(5)
+    assert events == [("pause", "queue_growth")]
+    g.serving_tick(6)
+    g.serving_tick(7)
+    assert g.admission_paused            # only 2 quiet steps since 5
+    g.serving_tick(8)
+    assert not g.admission_paused
+    assert events[-1] == ("resume",)
+
+
+def test_disabled_guardian_is_inert():
+    g = _guardian(enabled=False, divergence_streak=1)
+    g.rollback_fn = lambda: "tag"
+    g.notify("health", [_anom("nonfinite_grads", 3),
+                        _anom("loss_spike", 3)])
+    g.tick(3)
+    g.serving_tick(3)
+    assert not g.actions and not g.rules_seen
+
+
+def test_journal_is_strict_json_with_schema(tmp_path):
+    path = str(tmp_path / "sub" / "GUARDIAN.json")
+    g = _guardian(journal_path=path, divergence_streak=1)
+    g.rollback_fn = lambda: "tag"
+    g.notify("health", [_anom("nonfinite_grads", 3),
+                        _anom("loss_spike", 3)])
+    g.tick(3)
+    assert os.path.isfile(path)
+
+    def _fail(x):
+        raise AssertionError(f"bare {x} in journal")
+
+    doc = json.loads(open(path).read(), parse_constant=_fail)
+    assert doc["schema"] == GUARDIAN_SCHEMA
+    assert doc["action_counts"]["rollback"] == 1
+    assert doc["actions"][0]["rule"] == "loss_spike+nonfinite_grads"
+    # no torn-write debris left behind
+    assert [n for n in os.listdir(tmp_path / "sub")] == ["GUARDIAN.json"]
+
+
+def test_from_config_resolves_journal_under_output_path(tmp_path):
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "guardian": {"enabled": True},
+    })
+    g = Guardian.from_config(cfg.guardian, output_path=str(tmp_path))
+    assert g.journal_path == os.path.join(str(tmp_path), "GUARDIAN.json")
+    assert g.enabled
+
+
+def test_config_validation_rejects_rollback_loop_footgun():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({"train_batch_size": 8,
+                         "guardian": {"enabled": True,
+                                      "rollback_cooldown_steps": 0}})
+
+
+# ============================================================== e2e pins
+def _train_engine(tmp_path, guardian=None, async_save=True,
+                  persist_retries=None, backoff=None):
+    groups.destroy()
+    groups.initialize()
+    ckpt = {"async_save": async_save}
+    if persist_retries is not None:
+        ckpt["persist_retries"] = persist_retries
+    if backoff is not None:
+        ckpt["persist_retry_backoff_s"] = backoff
+    config = {
+        "train_batch_size": 8,
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 1,
+        "steps_per_print": 100,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "fp16": {"enabled": True, "loss_scale": 0,
+                 "initial_scale_power": 8},
+        "checkpoint": ckpt,
+        "telemetry": {"enabled": True, "trace": False, "jsonl": False,
+                      "prometheus": False,
+                      "output_path": str(tmp_path / "telemetry"),
+                      "health": {"enabled": True, "cadence": 1,
+                                 "warmup_samples": 2}},
+    }
+    if guardian is not None:
+        config["guardian"] = guardian
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=HIDDEN, nlayers=2),
+        config=config, sample_batch=sample_batch(8, HIDDEN))
+    return engine
+
+
+def _batches(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return [(rng.standard_normal((8, HIDDEN)).astype(np.float32),
+             rng.standard_normal((8, HIDDEN)).astype(np.float32))
+            for _ in range(n)]
+
+
+def test_e2e_divergence_rollback_loss_parity(tmp_path):
+    """The tentpole pin: chaos-poisoned params -> loss_spike + nonfinite
+    streak -> automatic rollback to the user tag -> the replayed steps
+    match an uninterrupted run's losses to rtol 1e-4."""
+    data = _batches(16)
+    total_steps = 8
+
+    # ---- truth: the same stream, never interrupted
+    truth = _train_engine(tmp_path / "truth")
+    it = RepeatingLoader(data)
+    truth_losses = {}
+    for step in range(1, total_steps + 1):
+        loss = truth.train_batch(data_iter=it)
+        truth_losses[step] = float(jax.device_get(loss))
+    truth.close()
+
+    # ---- guarded: save at step 3, poison at step 5, heal, catch up
+    eng = _train_engine(
+        tmp_path / "run",
+        guardian={"enabled": True, "action_cooldown_steps": 0,
+                  "divergence_streak": 2, "emergency_checkpoint": False,
+                  "journal_file": str(tmp_path / "GUARDIAN.json")})
+    assert eng._guardian is not None and eng._guardian.enabled
+    it = RepeatingLoader(data)
+    for _ in range(3):
+        eng.train_batch(data_iter=it)
+    eng.save_checkpoint(str(tmp_path / "ckpt"), data_iter=it)
+    eng._ckpt_writer.drain()        # manifest durable before any trouble
+    eng.train_batch(data_iter=it)               # step 4, clean
+    chaos = DivergenceChaos(eng, at_call=1)
+    with chaos:
+        eng.train_batch(data_iter=it)           # step 5: poisoned
+    # params stay non-finite (overflow skips the update) until the
+    # guardian's streak confirms and the rollback swaps the state
+    replayed = {}
+    for _ in range(20):
+        if eng.global_steps >= total_steps:
+            break
+        loss = eng.train_batch(data_iter=it)
+        replayed[eng.global_steps] = float(jax.device_get(loss))
+    assert eng.global_steps == total_steps
+
+    g = eng._guardian
+    assert g.action_counts.get("rollback", 0) == 1
+    roll = [a for a in g.actions if a["action"] == "rollback"][0]
+    assert roll["outcome"] == "ok"
+    assert roll["result"] == "global_step3"     # the USER tag, by name
+    assert chaos.poisoned_steps == [4]          # poisoned before step 5
+
+    # every replayed step matches the uninterrupted run
+    for step, loss in replayed.items():
+        if step > 3 and np.isfinite(loss):
+            assert loss == pytest.approx(truth_losses[step], rel=1e-4), \
+                f"step {step} diverged from the uninterrupted run"
+    # the FINAL step is finite and matched (the poisoned steps are gone)
+    final = replayed[total_steps]
+    assert np.isfinite(final)
+    assert final == pytest.approx(truth_losses[total_steps], rel=1e-4)
+    eng.close()
+    # the journal survived close() with the healing story in it
+    doc = json.load(open(tmp_path / "GUARDIAN.json"))
+    assert doc["schema"] == GUARDIAN_SCHEMA
+    assert doc["action_counts"]["rollback"] == 1
+
+
+def test_e2e_rollback_prefers_user_tag_over_emergency(tmp_path):
+    """An emergency tag saved mid-trouble must NOT be the rollback
+    target while an intact user tag exists — even when the emergency
+    tag is newer."""
+    eng = _train_engine(
+        tmp_path,
+        guardian={"enabled": True, "action_cooldown_steps": 0,
+                  "divergence_streak": 2,
+                  "journal_file": str(tmp_path / "GUARDIAN.json")})
+    data = _batches(12, seed=3)
+    it = RepeatingLoader(data)
+    for _ in range(2):
+        eng.train_batch(data_iter=it)
+    ckpt_dir = str(tmp_path / "ckpt")
+    eng.save_checkpoint(ckpt_dir, data_iter=it)
+    # a NEWER emergency tag (what a first-warning anomaly would write)
+    eng.save_checkpoint(ckpt_dir, tag=f"{EMERGENCY_TAG_PREFIX}_step99",
+                        data_iter=it, initiator="guardian")
+    eng._ckpt_writer.drain()
+    tag = eng._guardian_rollback()
+    assert tag == "global_step2"
+    eng.close()
+
+
+def test_e2e_persist_failure_retry_intact_manifest(tmp_path):
+    """Satellite pin: budgeted filesystem chaos exhausts inside the
+    writer's retry budget — the save survives, the manifest verifies
+    intact, and the retry counter moved."""
+    from deepspeed_tpu.telemetry.metrics import get_registry
+    eng = _train_engine(tmp_path, persist_retries=2, backoff=0.0)
+    assert eng._get_ckpt_writer().retries == 2
+    eng.train_batch(batch=_batches(1, seed=5)[0])
+    before = get_registry().counter(
+        "checkpoint_retries_total",
+        "checkpoint persist attempts retried after a transient "
+        "failure").value
+    with FilesystemChaos(budget=2, op="write"):
+        eng.save_checkpoint(str(tmp_path / "ckpt"), tag="t")
+        eng._ckpt_writer.drain()        # would re-raise a failed persist
+    status, detail = checkpoint_io.verify_tag(str(tmp_path / "ckpt" / "t"))
+    assert status == "intact", detail
+    after = get_registry().counter("checkpoint_retries_total").value
+    assert after - before >= 1
+    eng.close()
+
+
+def test_e2e_persist_failure_exhausts_budget_and_surfaces(tmp_path):
+    """With no retry budget the seed behavior is unchanged: the failure
+    surfaces at the next drain, and the tag is detectably incomplete."""
+    eng = _train_engine(tmp_path, persist_retries=0)
+    eng.train_batch(batch=_batches(1, seed=6)[0])
+    with FilesystemChaos(budget=1, op="write"):
+        eng.save_checkpoint(str(tmp_path / "ckpt"), tag="t")
+        with pytest.raises(AsyncCheckpointError):
+            eng._ckpt_writer.drain()
+    assert checkpoint_io.verify_tag(
+        str(tmp_path / "ckpt" / "t"))[0] != "intact"
+    eng.close()
+
+
+def test_e2e_overload_pause_recovery(tmp_path):
+    """Serving pin: pool starvation grows the queue -> the guardian
+    pauses admission (new submits fail fast with the rule) -> chaos
+    lifts, the backlog drains WITHOUT the livelock guard firing, and
+    admission resumes after the quiet period."""
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+    from deepspeed_tpu.serving.server import (ServingAdmissionPausedError,
+                                              ServingEngine)
+    from deepspeed_tpu.telemetry.metrics import MetricsRegistry
+    groups.destroy()
+    groups.initialize()
+    cfg = GPT2Config(vocab_size=256, n_positions=64, n_embd=32,
+                     n_layer=2, n_head=2)
+    model = GPT2LMHeadModel(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        {"input_ids": jnp.zeros((1, 8), jnp.int32)}
+                        )["params"]
+    ieng = deepspeed_tpu.init_inference(model, params=params,
+                                        dtype=jnp.float32)
+    g = Guardian(enabled=True, action_cooldown_steps=0,
+                 resume_clear_steps=3,
+                 journal_path=str(tmp_path / "GUARDIAN.json"))
+    srv = ServingEngine(
+        ieng,
+        config={"max_batch": 2, "block_size": 8,
+                "observability": {
+                    "enabled": True, "window": 2, "warmup_windows": 0,
+                    "queue_growth_windows": 1,
+                    # only the queue rule matters here; park TTFT so
+                    # compile latency can't re-trigger the pause
+                    "ttft_slo_ms": 1e9,
+                    "snapshot_file": str(tmp_path / "SERVING.json")}},
+        registry=MetricsRegistry(), guardian=g)
+    rng = np.random.default_rng(2)
+
+    def _submit():
+        return srv.submit(rng.integers(0, 256, (6,)), max_new_tokens=2)
+
+    chaos = PoolStarvationChaos(srv.cache.allocator, hold_frac=1.0)
+    chaos.install()
+    accepted = []
+    try:
+        for _ in range(16):
+            if srv._admission_pause_rule is not None:
+                break
+            accepted.append(_submit())
+            srv.step()
+        assert g.admission_paused, "queue growth never paused admission"
+        assert srv._admission_pause_rule == "queue_growth"
+        with pytest.raises(ServingAdmissionPausedError) as ei:
+            _submit()
+        assert ei.value.rule == "queue_growth"
+    finally:
+        chaos.uninstall()
+    # backlog drains normally — no ServingLivelockError
+    outs = {o.req_id: o for o in srv.serve_forever()}
+    assert set(outs) == set(accepted)
+    assert all(o.finish_reason in ("max_tokens", "eos")
+               for o in outs.values())
+    # idle serving steps keep the quiet clock running until resume
+    for _ in range(20):
+        if srv._admission_pause_rule is None:
+            break
+        srv.step()
+    assert not g.admission_paused
+    rid = _submit()                     # admission is open again
+    outs = srv.serve_forever()
+    assert [o.req_id for o in outs] == [rid]
+    assert g.action_counts.get("serving_pause") == 1
+    assert g.action_counts.get("serving_resume") == 1

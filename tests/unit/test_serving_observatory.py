@@ -560,12 +560,14 @@ def test_e2e_trace_lanes_exported(obs_serving):
 
 
 def test_e2e_livelock_error_carries_report(obs_serving):
-    """Satellite: the serve_forever no-progress guard attaches the
-    scheduler/slot/KV forensics to the exception."""
+    """Satellite: the serve_forever no-progress guard fails every
+    pending request with a structured reason (a client sees 'livelock',
+    not a hang) and attaches the scheduler/slot/KV forensics to the
+    exception."""
     cfg, eng, tmp = obs_serving
     srv = _mk(eng, tmp)
     rng = np.random.default_rng(1)
-    srv.submit(rng.integers(0, 256, (5,)), max_new_tokens=2)
+    rid = srv.submit(rng.integers(0, 256, (5,)), max_new_tokens=2)
     # break the forward-progress invariant artificially
     srv.step = lambda: False
     with pytest.raises(ServingLivelockError) as ei:
@@ -574,7 +576,12 @@ def test_e2e_livelock_error_carries_report(obs_serving):
     assert "no progress" in str(err) and ".report" in str(err)
     assert err.report["schema"] == "deepspeed_tpu.serving_health/1"
     st = err.report["engine_state"]["scheduler"]
-    assert st["waiting"] == 1 and st["waiting_req_ids"]
+    # last rites ran BEFORE the report: nothing is left pending, the
+    # stuck request finished with the structured livelock reason
+    assert st["waiting"] == 0 and st["active"] == 0
+    outs = srv.collect()
+    assert [o.req_id for o in outs] == [rid]
+    assert outs[0].finish_reason == "livelock"
     assert "kv" in err.report["engine_state"]
     assert "compile" in err.report["engine_state"]
 
@@ -593,7 +600,8 @@ def test_e2e_livelock_report_without_observability(obs_serving):
         srv.serve_forever()
     rep = ei.value.report
     assert rep["enabled"] is False
-    assert rep["engine_state"]["scheduler"]["waiting"] == 1
+    assert rep["engine_state"]["scheduler"]["waiting"] == 0
+    assert [o.finish_reason for o in srv.collect()] == ["livelock"]
 
 
 def test_e2e_disabled_path_inert(obs_serving):
