@@ -734,6 +734,109 @@ class GoodputTuner:
         return report
 
 
+# ------------------------------------------------------- serving tuning
+SERVING_TUNE_SCHEMA = "deepspeed_tpu.serving_tune/1"
+
+# the serving knobs worth searching: block granularity (sharing vs
+# fragmentation), static batch width, multi-step decode amortisation,
+# and prefill chunk (TTFT vs decode-stall). Values are demo-scale —
+# callers pass their own space for real models.
+SERVING_SEARCH_SPACE = {
+    "block_size": [8, 16],
+    "max_batch": [2, 4],
+    "decode_steps": [1, 4],
+    "prefill_chunk": [8, 32],
+}
+
+
+def tune_serving(engine, requests, space=None, ttft_slo_ms=None,
+                 base_config=None, report_file=None):
+    """Pick a serving config by replaying a request trace: tok/s under a
+    TTFT constraint.
+
+    Unlike the training tuner there is no AOT pruning stage — a serving
+    candidate's programs are tiny (one decode step + one prefill chunk)
+    and the real cost differences (preemption churn, chunk/TTFT
+    tradeoff, multi-step frozen units) only show up by RUNNING the
+    trace. So: full grid over ``space`` (default
+    ``SERVING_SEARCH_SPACE``), one fresh ``ServingEngine`` per candidate
+    over the SAME live ``InferenceEngine`` (weights are shared; pools
+    are rebuilt per candidate and torn down after), each replaying
+    ``requests`` (a list of ``submit()``-kwargs dicts, e.g. from
+    ``tests/perf/serving_bench.py``'s trace generator).
+
+    Scoring: generated tok/s, with candidates whose TTFT p50 exceeds
+    ``ttft_slo_ms`` rejected (reason ``"ttft"``). If EVERY candidate
+    breaches the constraint the best tok/s survivor still wins (flagged
+    ``feasible: false``) — a router would rather run a breaching replica
+    than no replica. Returns ``(winner_config, report)``; the per-replica
+    entry point for heterogeneous router fleets."""
+    from deepspeed_tpu.serving.server import ServingEngine
+    from deepspeed_tpu.telemetry.metrics import MetricsRegistry
+
+    space = dict(space or SERVING_SEARCH_SPACE)
+    dims = sorted(space)
+    requests = list(requests)
+    t_start = time.perf_counter()
+    entries = []
+    for values in itertools.product(*(space[d] for d in dims)):
+        overrides = dict(zip(dims, values))
+        cand_cfg = {**(base_config or {}), **overrides}
+        entry = {"config": cand_cfg, "status": "probed",
+                 "reject_reason": None}
+        entries.append(entry)
+        srv = ServingEngine(engine, config=copy.deepcopy(cand_cfg),
+                            registry=MetricsRegistry())
+        try:
+            t0 = time.perf_counter()
+            for kw in requests:
+                srv.submit(**kw)
+            outs = srv.serve_forever()
+            elapsed = time.perf_counter() - t0
+        finally:
+            srv.close()
+        tokens = sum(len(o.tokens) for o in outs)
+        ttfts = sorted(o.ttft_s for o in outs if o.ttft_s is not None)
+        p50_ms = (1000.0 * ttfts[len(ttfts) // 2]) if ttfts else None
+        entry.update({
+            "tokens": tokens,
+            "elapsed_s": round(elapsed, 6),
+            "tokens_per_s": round(tokens / elapsed, 3) if elapsed else 0.0,
+            "ttft_p50_ms": None if p50_ms is None else round(p50_ms, 3),
+            "preemptions": sum(o.preemptions for o in outs),
+        })
+        if ttft_slo_ms is not None and p50_ms is not None \
+                and p50_ms > ttft_slo_ms:
+            entry["status"] = "rejected"
+            entry["reject_reason"] = "ttft"
+        logger.info("tune_serving %s: %.1f tok/s ttft_p50 %s ms%s",
+                    overrides, entry["tokens_per_s"], entry["ttft_p50_ms"],
+                    " (REJECTED: ttft)" if entry["status"] == "rejected"
+                    else "")
+    feasible = [e for e in entries if e["status"] == "probed"]
+    pool = feasible or entries
+    winner = max(pool, key=lambda e: e["tokens_per_s"])
+    report = {
+        "schema": SERVING_TUNE_SCHEMA,
+        "space": space,
+        "ttft_slo_ms": ttft_slo_ms,
+        "requests": len(requests),
+        "elapsed_s": round(time.perf_counter() - t_start, 3),
+        "candidates": entries,
+        "winner": {"config": winner["config"],
+                   "tokens_per_s": winner["tokens_per_s"],
+                   "ttft_p50_ms": winner["ttft_p50_ms"],
+                   "feasible": bool(feasible)},
+    }
+    if report_file:
+        d = os.path.dirname(report_file)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(report_file, "w") as f:
+            json.dump(report, f, indent=1, default=repr, allow_nan=False)
+    return winner["config"], report
+
+
 # ------------------------------------------------------------------ CLI
 def main(argv=None):
     import argparse

@@ -105,6 +105,12 @@ def telemetry_report():
     row("serving observatory", True,
         "(serving.observability block; slot-step ledger + SLO rules -> "
         "SERVING_HEALTH.json)")
+    row("serving prefix cache (COW)", True,
+        "(serving.prefix_cache block; DS_SERVING_PREFIX_CACHE=1; "
+        "refcounted block sharing + copy-on-write forks)")
+    row("serving router (SLO-aware)", True,
+        "(serving.router block; prefix-affinity placement + "
+        "ttft_slo_breach failover across replicas)")
     row("fleet flight recorder", True,
         "(telemetry.fleet block; per-rank record shipping + skew/desync "
         "sentinels -> FLEET_HEALTH.json; bench_diff CLI)")

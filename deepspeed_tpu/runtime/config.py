@@ -437,7 +437,7 @@ class DeepSpeedServingObservabilityConfig(DeepSpeedConfigObject):
     """``serving.observability`` sub-block
     (telemetry/serving_observatory.py): per-request lifecycle timelines
     + per-slot Chrome-trace lanes, the slot-step attribution ledger
-    (decode_useful/prefill/recompute/frozen/idle, sums to
+    (decode_useful/cached_prefill/prefill/recompute/frozen/idle, sums to
     ``steps x max_batch x decode_steps`` by construction), and windowed
     SLO rules escalating warn-once -> throttled ``SERVING_HEALTH.json``
     -> trace flush.
@@ -512,6 +512,70 @@ class DeepSpeedServingObservabilityConfig(DeepSpeedConfigObject):
                 f"{self.ttft_slo_ms}")
 
 
+class DeepSpeedServingPrefixCacheConfig(DeepSpeedConfigObject):
+    """``serving.prefix_cache`` sub-block (serving/kv_cache.py
+    ``PrefixCache``): content-addressed LRU index of FULL KV blocks,
+    mapped read-only at admission with copy-on-write forks on divergent
+    writes. ``capacity_blocks`` 0 leaves the index uncapped (it is still
+    bounded by the block pool — every resident entry holds exactly one
+    allocator reference, and refcount-1 entries are reclaimed before any
+    preemption fires).
+
+    Env override (sweep ergonomics): ``DS_SERVING_PREFIX_CACHE`` = 1/0
+    force-toggles ``enabled``."""
+
+    def __init__(self, serving_dict):
+        p = serving_dict.get(C.SERVING_PREFIX_CACHE, {}) or {}
+        self.enabled = p.get(C.SERVING_PREFIX_ENABLED,
+                             C.SERVING_PREFIX_ENABLED_DEFAULT)
+        self.capacity_blocks = int(
+            p.get(C.SERVING_PREFIX_CAPACITY_BLOCKS,
+                  C.SERVING_PREFIX_CAPACITY_BLOCKS_DEFAULT))
+        env = os.environ.get("DS_SERVING_PREFIX_CACHE")
+        if env is not None:
+            self.enabled = env.lower() in ("1", "true", "yes", "on")
+        if self.capacity_blocks < 0:
+            raise DeepSpeedConfigError(
+                f"serving.prefix_cache.capacity_blocks must be >= 0 "
+                f"(0 = uncapped), got {self.capacity_blocks}")
+
+
+class DeepSpeedServingRouterConfig(DeepSpeedConfigObject):
+    """``serving.router`` sub-block (serving/router.py
+    ``ServingRouter``): admission scoring weights over per-replica
+    signals (queue depth, KV occupancy, recent SLO breaches) plus
+    prefix-affinity. ``breach_penalty`` dominates the load terms by
+    design — a breaching replica only receives work when every replica
+    is breaching (failover, not permanent blacklist)."""
+
+    def __init__(self, serving_dict):
+        r = serving_dict.get(C.SERVING_ROUTER, {}) or {}
+        self.replicas = int(r.get(C.SERVING_ROUTER_REPLICAS,
+                                  C.SERVING_ROUTER_REPLICAS_DEFAULT))
+        self.affinity_weight = float(
+            r.get(C.SERVING_ROUTER_AFFINITY_WEIGHT,
+                  C.SERVING_ROUTER_AFFINITY_WEIGHT_DEFAULT))
+        self.queue_weight = float(
+            r.get(C.SERVING_ROUTER_QUEUE_WEIGHT,
+                  C.SERVING_ROUTER_QUEUE_WEIGHT_DEFAULT))
+        self.occupancy_weight = float(
+            r.get(C.SERVING_ROUTER_OCCUPANCY_WEIGHT,
+                  C.SERVING_ROUTER_OCCUPANCY_WEIGHT_DEFAULT))
+        self.breach_penalty = float(
+            r.get(C.SERVING_ROUTER_BREACH_PENALTY,
+                  C.SERVING_ROUTER_BREACH_PENALTY_DEFAULT))
+        if self.replicas < 1:
+            raise DeepSpeedConfigError(
+                f"serving.router.replicas must be >= 1, got "
+                f"{self.replicas}")
+        for name in ("affinity_weight", "queue_weight",
+                     "occupancy_weight", "breach_penalty"):
+            if getattr(self, name) < 0:
+                raise DeepSpeedConfigError(
+                    f"serving.router.{name} must be >= 0, got "
+                    f"{getattr(self, name)}")
+
+
 class DeepSpeedServingConfig(DeepSpeedConfigObject):
     """``serving`` block (serving/): continuous-batching inference server
     over a paged KV cache. ``num_blocks`` 0 auto-sizes the pool so the
@@ -539,6 +603,8 @@ class DeepSpeedServingConfig(DeepSpeedConfigObject):
         self.decode_steps = int(s.get(C.SERVING_DECODE_STEPS,
                                       C.SERVING_DECODE_STEPS_DEFAULT))
         self.observability = DeepSpeedServingObservabilityConfig(s)
+        self.prefix_cache = DeepSpeedServingPrefixCacheConfig(s)
+        self.router = DeepSpeedServingRouterConfig(s)
         for env, attr in (("DS_SERVING_MAX_BATCH", "max_batch"),
                           ("DS_SERVING_BLOCK_SIZE", "block_size"),
                           ("DS_SERVING_PREFILL_CHUNK", "prefill_chunk")):

@@ -527,11 +527,49 @@ SERVING_ATTENTION_IMPL_DEFAULT = "paged"
 SERVING_DECODE_STEPS = "decode_steps"
 SERVING_DECODE_STEPS_DEFAULT = 1
 
+# serving.prefix_cache: block-level shared-prefix KV reuse
+# (serving/kv_cache.py PrefixCache). FULL prompt blocks are
+# content-addressed by a chain hash of (parent digest, token ids,
+# position base) salted with attention_impl|kv_dtype into a bounded LRU
+# index; admission maps hits read-only into the slot's block table
+# (prefill starts at the first uncached token), the first divergent
+# write copy-on-write-forks the block, and refcount-1 (cache-only)
+# blocks are reclaimed before any preemption fires. capacity_blocks 0
+# -> uncapped (bounded by the pool itself). DS_SERVING_PREFIX_CACHE=1/0
+# force-toggles `enabled`.
+SERVING_PREFIX_CACHE = "prefix_cache"
+SERVING_PREFIX_ENABLED = "enabled"
+SERVING_PREFIX_ENABLED_DEFAULT = False
+SERVING_PREFIX_CAPACITY_BLOCKS = "capacity_blocks"
+SERVING_PREFIX_CAPACITY_BLOCKS_DEFAULT = 0
+
+# serving.router: SLO-aware multi-replica admission (serving/router.py).
+# Each request is scored per replica as
+#   affinity_weight * matched-prefix-blocks
+#   - queue_weight * queue_depth - occupancy_weight * kv_occupancy
+#   - breach_penalty * (recent ttft_slo_breach or queue_growth)
+# and lands on the argmax; `breach_penalty` is sized so a breaching
+# replica only wins when every replica is breaching (failover, not
+# blacklist). replicas is the engine count a ServingRouter.build spins
+# up when the caller does not hand it engines.
+SERVING_ROUTER = "router"
+SERVING_ROUTER_REPLICAS = "replicas"
+SERVING_ROUTER_REPLICAS_DEFAULT = 1
+SERVING_ROUTER_AFFINITY_WEIGHT = "affinity_weight"
+SERVING_ROUTER_AFFINITY_WEIGHT_DEFAULT = 4.0
+SERVING_ROUTER_QUEUE_WEIGHT = "queue_weight"
+SERVING_ROUTER_QUEUE_WEIGHT_DEFAULT = 1.0
+SERVING_ROUTER_OCCUPANCY_WEIGHT = "occupancy_weight"
+SERVING_ROUTER_OCCUPANCY_WEIGHT_DEFAULT = 2.0
+SERVING_ROUTER_BREACH_PENALTY = "breach_penalty"
+SERVING_ROUTER_BREACH_PENALTY_DEFAULT = 100.0
+
 # serving.observability: the serving observatory
 # (telemetry/serving_observatory.py). Per-request lifecycle timelines
 # (exported as per-slot Chrome-trace lanes when the tracer is live), a
 # slot-step ledger decomposing every scheduler step's
-# max_batch x decode_steps slot micro-units into decode_useful / prefill
+# max_batch x decode_steps slot micro-units into decode_useful /
+# cached_prefill / prefill
 # / recompute / frozen / idle (sums to steps x max_batch x K by
 # construction), and windowed SLO rules (ttft_slo_breach, queue_growth,
 # preemption_thrash, decode_stall, no_progress) escalating warn-once ->

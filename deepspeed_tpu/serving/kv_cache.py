@@ -11,11 +11,26 @@ compiles the decode step exactly once for the whole serving lifetime.
 
 Split of responsibilities:
 
-* ``BlockAllocator`` — host-side free-list over block ids. Block 0 is
-  reserved as the *null* block: inactive batch slots (and the padded tail
-  of a prefill chunk) route their writes there, which keeps the compiled
-  step branch-free. ``free``/``allocate`` are guarded against leaks and
-  double-frees — the scheduler tests pin those invariants.
+* ``BlockAllocator`` — host-side free-list over block ids, REFCOUNTED:
+  a block may be mapped read-only into several requests' tables at once
+  (shared-prefix reuse) and returns to the free list only when its last
+  holder releases it. Block 0 is reserved as the *null* block: inactive
+  batch slots (and the padded tail of a prefill chunk) route their
+  writes there, which keeps the compiled step branch-free, and it is
+  never refcounted or handed out. ``free``/``allocate``/``share`` are
+  guarded against leaks, double-frees and foreign frees — the guard
+  names the holding request and the refcount at failure so an
+  accounting bug fails loudly instead of silently corrupting another
+  request's cache.
+* ``PrefixCache`` — content-addressed index over FULL blocks: each full
+  block is keyed by a chain digest of ``(parent_digest, token_ids,
+  position_base)`` salted with the attention impl + KV dtype, in a
+  bounded LRU. Admission walks a prompt against it and maps every hit
+  read-only (prefill then starts at the first uncached token); the
+  index holds one reference per resident block, so a block whose last
+  *request* finished stays reusable until LRU eviction or
+  ``reclaim()`` — which the scheduler calls before any preemption
+  fires.
 * ``PagedKVCache`` — owns the device pools (per layer: K, V, and for the
   int8 KV layout the per-row fp32 scales, riding the same lane-dim
   convention as ops/transformer/decode.py) plus the scatter/gather
@@ -30,6 +45,9 @@ bound — so paging costs one extra copy of the *live* window while buying
 the capacity sharing that makes continuous batching admissible.
 """
 
+import hashlib
+from collections import OrderedDict
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -42,11 +60,14 @@ class BlockAllocatorError(RuntimeError):
 
 
 class BlockAllocator:
-    """Free-list allocator over ``num_blocks`` pool blocks.
+    """Refcounted free-list allocator over ``num_blocks`` pool blocks.
 
     Block 0 is reserved (the null/trash block) and never handed out.
-    ``allocate`` is all-or-nothing; ``free`` rejects double-frees and
-    foreign ids so an accounting bug fails loudly instead of silently
+    ``allocate`` is all-or-nothing; ``share`` adds a reference to an
+    already-live block (shared-prefix mapping); ``free`` drops one
+    reference and recycles the block at zero. Double-frees and foreign
+    frees raise with the holding request and the refcount at failure
+    named, so an accounting bug fails loudly instead of silently
     corrupting another request's cache.
     """
 
@@ -59,7 +80,20 @@ class BlockAllocator:
         # LIFO free list: recently-freed blocks are re-used first (their
         # pool pages are hot)
         self._free = list(range(num_blocks - 1, 0, -1))
-        self._allocated = set()
+        # block id -> live reference count (the historical name is kept:
+        # the membership/len reads the tests pin still hold)
+        self._allocated = {}
+        # block id -> one owner label per reference (len == refcount);
+        # labels are request ids / "prefix-cache" / None, purely for the
+        # failure messages — policy never reads them
+        self._owners = {}
+        # block id -> label that dropped the LAST reference (what a
+        # double-free names as the probable culprit)
+        self._last_freed_by = {}
+
+    @staticmethod
+    def _label(owner):
+        return "<anonymous>" if owner is None else f"request {owner!r}"
 
     @property
     def num_usable(self) -> int:
@@ -74,46 +108,272 @@ class BlockAllocator:
         return len(self._allocated)
 
     def occupancy(self) -> float:
-        """Fraction of usable blocks currently owned by requests."""
+        """Fraction of usable blocks currently holding live references
+        (request tables AND prefix-cache residency)."""
         return len(self._allocated) / max(1, self.num_usable)
 
     def can_allocate(self, n: int) -> bool:
         return n <= len(self._free)
 
-    def allocate(self, n: int):
-        """Return ``n`` block ids, or ``None`` when the pool can't cover
-        the request (all-or-nothing; no partial grants)."""
+    def refcount(self, block: int) -> int:
+        return self._allocated.get(block, 0)
+
+    def allocate(self, n: int, owner=None):
+        """Return ``n`` block ids (each with refcount 1), or ``None``
+        when the pool can't cover the request (all-or-nothing; no
+        partial grants)."""
         if n < 0:
             raise ValueError(f"allocate({n})")
         if n > len(self._free):
             return None
         blocks = [self._free.pop() for _ in range(n)]
-        self._allocated.update(blocks)
+        for b in blocks:
+            self._allocated[b] = 1
+            self._owners[b] = [owner]
         return blocks
 
-    def free(self, blocks):
+    def share(self, blocks, owner=None):
+        """Add one reference apiece to already-live blocks (a read-only
+        shared-prefix mapping). The null block and free blocks are
+        rejected — sharing dead storage is an indexing bug."""
         for b in blocks:
+            if b == 0:
+                raise BlockAllocatorError(
+                    "share of the reserved null block 0 — the null block "
+                    "is never refcounted")
             if b not in self._allocated:
                 raise BlockAllocatorError(
+                    f"share of block {b} which is not allocated "
+                    f"(refcount 0) — stale prefix-index entry?")
+            self._allocated[b] += 1
+            self._owners[b].append(owner)
+
+    def free(self, blocks, owner=None):
+        """Drop one reference per block; a block returns to the free
+        list when its last reference goes. With ``owner`` given, the
+        reference released must actually be held by that owner."""
+        for b in blocks:
+            rc = self._allocated.get(b, 0)
+            if rc == 0:
+                culprit = self._last_freed_by.get(b)
+                hint = (f"; last released by {self._label(culprit)}"
+                        if b in self._last_freed_by else "")
+                raise BlockAllocatorError(
                     f"free of block {b} which is not allocated "
-                    f"(double-free or foreign id)")
-            self._allocated.remove(b)
-            self._free.append(b)
+                    f"(refcount 0{hint}) — double-free or foreign id")
+            owners = self._owners[b]
+            if owner is not None and owner not in owners:
+                holders = ", ".join(self._label(o) for o in owners)
+                raise BlockAllocatorError(
+                    f"free of block {b} by {self._label(owner)} which "
+                    f"holds no reference to it (refcount {rc}, held by "
+                    f"{holders}) — foreign id")
+            owners.remove(owner if owner in owners else owners[-1])
+            if rc == 1:
+                del self._allocated[b]
+                del self._owners[b]
+                self._last_freed_by[b] = owner
+                self._free.append(b)
+            else:
+                self._allocated[b] = rc - 1
 
     def check_consistency(self):
         """Invariant check used by the tests: free ∪ allocated is exactly
-        the usable id space and the two sets are disjoint."""
+        the usable id space, the two sets are disjoint, and every live
+        block carries one owner label per reference."""
         free = set(self._free)
         if len(free) != len(self._free):
             raise BlockAllocatorError("duplicate ids on the free list")
-        if free & self._allocated:
+        live = set(self._allocated)
+        if free & live:
             raise BlockAllocatorError(
-                f"ids both free and allocated: {free & self._allocated}")
+                f"ids both free and allocated: {free & live}")
         universe = set(range(1, self.num_blocks))
-        if free | self._allocated != universe:
+        if free | live != universe:
             raise BlockAllocatorError(
-                f"leaked ids: {universe - (free | self._allocated)}")
+                f"leaked ids: {universe - (free | live)}")
+        if 0 in live:
+            raise BlockAllocatorError("null block 0 acquired a refcount")
+        for b, rc in self._allocated.items():
+            if rc < 1 or len(self._owners.get(b, ())) != rc:
+                raise BlockAllocatorError(
+                    f"block {b}: refcount {rc} != {len(self._owners[b])} "
+                    f"owner labels")
         return True
+
+
+class PrefixCache:
+    """Content-addressed shared-prefix index over FULL KV blocks.
+
+    Every full block a request writes is registered under a *chain
+    digest* — ``H(parent_digest, token_ids, position_base)`` with the
+    cache salt (attention impl, KV dtype, block size) folded into the
+    root — so a hit certifies the ENTIRE prefix up to and including the
+    block, not just its own tokens (position_base makes the digest
+    absolute-position-aware; learned position embeddings mean the same
+    tokens at a different offset are different KV). Admission walks a
+    prompt block-by-block against the index and maps every hit
+    read-only; the index holds ONE allocator reference per resident
+    block, so finished requests' prefixes stay warm until LRU eviction
+    (capacity bound) or :meth:`reclaim` — the scheduler's
+    cheaper-than-preemption block source.
+
+    int8-KV pools share bit-exactly: quantize-on-write makes a block's
+    stored bytes a deterministic function of (tokens, positions,
+    params), so a reader cannot tell a shared block from one it wrote
+    itself.
+    """
+
+    OWNER = "prefix-cache"
+
+    def __init__(self, allocator, block_size, capacity_blocks=0, salt=""):
+        self.allocator = allocator
+        self.block_size = int(block_size)
+        # 0 = bounded only by the pool itself
+        self.capacity_blocks = int(capacity_blocks)
+        self._root = hashlib.blake2b(
+            f"prefix/{salt}/{block_size}".encode(),
+            digest_size=16).digest()
+        # digest -> block id, insertion/touch-ordered (last = hottest)
+        self._index = OrderedDict()
+        self._digest_of = {}            # block id -> digest (evict path)
+        self.hits = 0                   # full prompt blocks mapped from
+        self.misses = 0                 # ... / not found at admission
+        self.insertions = 0
+        self.evictions = 0
+        self.cow_forks = 0
+
+    # ----------------------------------------------------------- hashing
+    @property
+    def root_digest(self):
+        return self._root
+
+    def chain_digest(self, parent, tokens, position_base):
+        h = hashlib.blake2b(digest_size=16)
+        h.update(self._root if parent is None else parent)
+        h.update(np.asarray(tokens, np.int64).tobytes())
+        h.update(int(position_base).to_bytes(8, "little", signed=False))
+        return h.digest()
+
+    # ------------------------------------------------------------ lookup
+    def _walk(self, tokens, touch):
+        """Longest chain of FULL blocks of ``tokens`` present in the
+        index: ``(block_ids, digests)``. ``touch`` refreshes LRU."""
+        bs = self.block_size
+        blocks, digests = [], []
+        parent = self._root
+        for j in range(len(tokens) // bs):
+            d = self.chain_digest(parent, tokens[j * bs:(j + 1) * bs],
+                                  j * bs)
+            b = self._index.get(d)
+            if b is None:
+                break
+            if touch:
+                self._index.move_to_end(d)
+            blocks.append(b)
+            digests.append(d)
+            parent = d
+        return blocks, digests
+
+    def lookup(self, tokens):
+        """Admission walk (LRU-touching). Returns the matched leading
+        ``(block_ids, digests)`` — counters are booked separately via
+        :meth:`record_lookup` once the admission actually lands, so a
+        blocked FCFS head retrying every iteration doesn't inflate the
+        hit rate."""
+        return self._walk(tokens, touch=True)
+
+    def match_blocks(self, tokens) -> int:
+        """Pure peek (no LRU touch, no counters): how many leading full
+        blocks of ``tokens`` this cache holds. The router's
+        prefix-affinity signal."""
+        return len(self._walk(tokens, touch=False)[0])
+
+    def record_lookup(self, hit_blocks, full_blocks):
+        self.hits += hit_blocks
+        self.misses += max(0, full_blocks - hit_blocks)
+
+    # ------------------------------------------------------------ insert
+    def insert(self, parent, tokens, position_base, block) -> bytes:
+        """Register one FULL block under its chain digest and take the
+        index's reference. Returns the digest (the caller threads it as
+        the next block's parent). A digest already resident keeps its
+        existing block (first writer wins — later identical blocks are
+        NOT swapped in, so live sharers never see a remap); over
+        capacity the LRU tail is reclaimed first, and when nothing is
+        reclaimable the insert is skipped (never steals live blocks)."""
+        d = self.chain_digest(parent, tokens, position_base)
+        if d in self._index:
+            self._index.move_to_end(d)
+            return d
+        if block == 0:
+            raise BlockAllocatorError(
+                "prefix-index insert of the reserved null block 0")
+        if self.capacity_blocks and len(self._index) >= self.capacity_blocks:
+            if self.reclaim(
+                    len(self._index) - self.capacity_blocks + 1) == 0:
+                return d        # bound holds; chain digest still valid
+        self.allocator.share([block], owner=self.OWNER)
+        self._index[d] = block
+        self._digest_of[block] = d
+        self.insertions += 1
+        return d
+
+    # ---------------------------------------------------------- eviction
+    def resident_blocks(self) -> int:
+        return len(self._index)
+
+    def reclaimable_blocks(self) -> int:
+        """Resident blocks whose ONLY reference is the index's own."""
+        rc = self.allocator.refcount
+        return sum(1 for b in self._index.values() if rc(b) == 1)
+
+    def shared_blocks(self) -> int:
+        """Resident blocks currently mapped by at least one request —
+        the ``serving_prefix_blocks_shared`` gauge."""
+        rc = self.allocator.refcount
+        return sum(1 for b in self._index.values() if rc(b) > 1)
+
+    def reclaim(self, n: int) -> int:
+        """Drop up to ``n`` cold cache-only entries (LRU first),
+        returning their blocks to the free list. Entries still mapped by
+        a request are skipped — reclaim never breaks a live table. The
+        scheduler calls this BEFORE preempting anyone: a cold cached
+        block is free capacity, a preemption is recompute debt."""
+        if n <= 0:
+            return 0
+        freed = 0
+        for d in list(self._index):
+            if freed >= n:
+                break
+            b = self._index[d]
+            if self.allocator.refcount(b) != 1:
+                continue        # a request still maps it
+            del self._index[d]
+            del self._digest_of[b]
+            self.allocator.free([b], owner=self.OWNER)
+            self.evictions += 1
+            freed += 1
+        return freed
+
+    def drop_all(self) -> int:
+        """Release every cache-only entry (teardown / leak checks)."""
+        return self.reclaim(len(self._index))
+
+    def stats(self):
+        total = self.hits + self.misses
+        return {
+            "resident_blocks": len(self._index),
+            "reclaimable_blocks": self.reclaimable_blocks(),
+            "shared_blocks": self.shared_blocks(),
+            "hits": self.hits,
+            "misses": self.misses,
+            "hit_rate": round(self.hits / total, 6) if total else 0.0,
+            "insertions": self.insertions,
+            "evictions": self.evictions,
+            "cow_forks": self.cow_forks,
+            "capacity_blocks": self.capacity_blocks,
+        }
 
 
 class PagedKVCache:
@@ -139,6 +399,21 @@ class PagedKVCache:
         self.int8_kv = bool(int8_kv)
         self.dtype = jnp.int8 if int8_kv else dtype
         self.allocator = BlockAllocator(num_blocks)
+        # shared-prefix index (None = prefix caching off). The scheduler
+        # reads this attribute; the server attaches it from the
+        # serving.prefix_cache config block.
+        self.prefix_cache = None
+
+    def attach_prefix_cache(self, capacity_blocks=0, attention_impl=""):
+        """Arm shared-prefix reuse: the salt folds in everything that
+        makes two bit-identical token prefixes produce different block
+        BYTES (attention impl, KV dtype, block size), so a cache can
+        never serve a block written under a different layout."""
+        self.prefix_cache = PrefixCache(
+            self.allocator, self.block_size,
+            capacity_blocks=capacity_blocks,
+            salt=f"{attention_impl}|{jnp.dtype(self.dtype).name}")
+        return self.prefix_cache
 
     # -------------------------------------------------- pool construction
     def init_pools(self, sharding=None):

@@ -14,6 +14,12 @@ the null block (``n_valid`` masks them). The planner covers
 decode input, so its KV is written by the decode step that samples the
 first generated token (TTFT therefore includes exactly one decode step
 after the last chunk).
+
+Prefix-cache composition: admission may pre-set ``cached_len`` past 0
+when whole prompt blocks were matched read-only from the prefix index
+(scheduler ``_admit``). ``remaining`` then naturally plans chunks from
+the first uncached token — a fully-cached prefix needs ZERO chunk
+dispatches here, just the block-table copy the scheduler already did.
 """
 
 import numpy as np

@@ -117,6 +117,28 @@ class PagedGPT2Runner:
         # never touched
         self._decode = jax.jit(self._decode_impl, donate_argnums=(2,))
         self._prefill = jax.jit(self._prefill_impl, donate_argnums=(2,))
+        # copy-on-write block fork (prefix cache): ONE device block copy
+        # across every pool leaf (all layers in one update apiece, the
+        # same stacked layout the write scatters ride). A third tiny
+        # program — deliberately NOT part of decode/prefill, whose
+        # signatures the one-program acceptance pins.
+        self._copy_block = jax.jit(self._copy_block_impl,
+                                   donate_argnums=(0,))
+
+    # -------------------------------------------------------- block copy
+    @staticmethod
+    def _copy_block_impl(pools, src, dst):
+        """``pools[leaf][:, dst] = pools[leaf][:, src]`` for every leaf
+        (K, V and the int8 scales ride the same ``[L, N, ...]`` block
+        dim). src/dst are traced int32 scalars, so every fork reuses one
+        compiled program."""
+        return {name: p.at[:, dst].set(
+            jax.lax.dynamic_index_in_dim(p, src, axis=1, keepdims=False))
+            for name, p in pools.items()}
+
+    def copy_block(self, pools, src, dst):
+        """Fork one block's bytes: the COW path's single device op."""
+        return self._copy_block(pools, jnp.int32(src), jnp.int32(dst))
 
     # ------------------------------------------------------------ layers
     def _qkv(self, p, s, x):

@@ -22,6 +22,19 @@ hands the server an active mask. Policy:
   instead of stalling the batch for its whole forward (prefill covers
   ``prompt[:-1]``; the final prompt token is the request's first decode
   input — its KV is written by the decode step itself).
+* **Shared-prefix admission** (when the cache carries a
+  ``PrefixCache``): the prompt is walked block-by-block against the
+  content-addressed index and every leading hit is mapped READ-ONLY
+  into the new table (one refcount apiece) — prefill then starts at
+  the first uncached token, so a cache-hit prefix costs one block-table
+  copy and zero chunk dispatches. A fully-cached prompt must still
+  rewrite its final position (that forward produces the first sampled
+  logits), which lands inside the last shared block: the scheduler
+  plans a copy-on-write fork (fresh block + one device block copy,
+  executed by the server before the request's first dispatch). Cold
+  cache-only blocks are reclaimed before ANY preemption fires, so the
+  preemption-by-eviction path and its recompute accounting compose
+  unchanged.
 
 The scheduler is pure host-side bookkeeping: it never touches device
 state. The server (serving/server.py) turns its ``StepPlan`` into the
@@ -60,6 +73,18 @@ class Request:
     # re-prefilled positions below it are RECOMPUTE (their KV existed
     # before a preemption threw it away)
     next_input: Optional[int] = None     # token the next decode step embeds
+    # --- shared-prefix state (kv_cache.PrefixCache) ---
+    shared_blocks: int = 0      # leading table entries mapped READ-ONLY
+    # from the prefix index this admission; every KV write lands at
+    # >= shared_blocks * block_size (asserted at admission)
+    prefix_hit_blocks: int = 0  # blocks served from the index at the
+    # last admission (the ledger's cached_prefill attribution)
+    indexed_blocks: int = 0     # leading full blocks already registered
+    prefix_digest: Optional[bytes] = None   # chain digest after them
+    cow_fork: Optional[tuple] = None        # (src_block, table_index):
+    # a pending copy-on-write fork — the server device-copies src into
+    # block_table[table_index] and releases the src reference before
+    # this request's first dispatch
     slot: Optional[int] = None
     admit_seq: int = -1
     preemptions: int = 0
@@ -81,9 +106,11 @@ class Request:
 @dataclasses.dataclass
 class StepPlan:
     """One scheduler iteration: one prefill chunk per still-prefilling
-    slot (earliest-admitted first) + the decode slot set."""
+    slot (earliest-admitted first) + the decode slot set + the pending
+    copy-on-write forks the server must execute FIRST."""
     prefill: List[Request] = dataclasses.field(default_factory=list)
     decode_slots: List[int] = dataclasses.field(default_factory=list)
+    cow_forks: List[Request] = dataclasses.field(default_factory=list)
 
     @property
     def has_work(self) -> bool:
@@ -161,16 +188,36 @@ class ContinuousBatchingScheduler:
             (r for r in self.slots
              if r is not None and r.state is RequestState.PREFILL),
             key=lambda r: r.admit_seq)
+        # pending COW forks, collected AFTER capacity growth for the same
+        # reason as the prefill plan: a fork whose request a later slot's
+        # eviction removed is cleaned up by _preempt, not dispatched
+        plan.cow_forks = [r for r in self.slots
+                          if r is not None and r.cow_fork is not None]
         return plan
 
+    def _allocate_reclaiming(self, n, owner):
+        """All-or-nothing allocate, reclaiming cold prefix-cache blocks
+        first when the free list is short. Cheaper than preemption in
+        strictly every case: a reclaimed block costs nothing, an evicted
+        request costs its whole prefix again as recompute."""
+        blocks = self.allocator.allocate(n, owner=owner)
+        pc = self.cache.prefix_cache
+        if blocks is None and pc is not None:
+            if pc.reclaim(n - self.allocator.num_free) > 0:
+                blocks = self.allocator.allocate(n, owner=owner)
+        return blocks
+
     def _admit(self):
+        pc = self.cache.prefix_cache
+        bs = self.cache.block_size
         while self.waiting:
             try:
                 free = self.slots.index(None)
             except ValueError:
                 return
             req = self.waiting[0]
-            need = self.cache.blocks_for(len(req.full_prompt))
+            full = req.full_prompt
+            need = self.cache.blocks_for(len(full))
             if need > self.allocator.num_usable:
                 # can NEVER fit (a preempted request whose prompt +
                 # generated tokens outgrew the pool): fail it instead of
@@ -183,17 +230,58 @@ class ContinuousBatchingScheduler:
                 if self.observer is not None:
                     self.observer.on_admission_fail(req)
                 continue
-            blocks = self.allocator.allocate(need)
+            # shared-prefix walk: map every leading full block the index
+            # holds read-only into this request's table. A fully-cached
+            # prompt still needs position len(full)-1 REWRITTEN (the
+            # last token's forward produces the first sampled logits),
+            # and that position lives inside the last shared block — the
+            # one divergent write, resolved by a copy-on-write fork.
+            shared, digests = pc.lookup(full) if pc is not None else ([], [])
+            k = len(shared)
+            fork = k > 0 and k * bs >= len(full)
+            fresh_needed = need - k + (1 if fork else 0)
+            if k:
+                # take the shared references BEFORE allocating: the
+                # allocate path may reclaim cold refcount-1 cache
+                # entries, and the blocks just matched are exactly that
+                # until this incref pins them
+                self.allocator.share(shared, owner=req.req_id)
+            blocks = self._allocate_reclaiming(fresh_needed, req.req_id)
             if blocks is None:
+                if k:       # roll the mapping back — all-or-nothing
+                    self.allocator.free(shared, owner=req.req_id)
                 return                      # strict FCFS: head blocks tail
             self.waiting.popleft()
-            req.block_table = blocks
-            req.cached_len = 0
+            if pc is not None:
+                pc.record_lookup(k, len(full) // bs)
+            if fork:
+                # need == k here (k*bs >= len(full) and a match can never
+                # cover more tokens than the prompt has, so k*bs ==
+                # len(full)): the table is the shared chain with its last
+                # block replaced by the fresh fork target; the src
+                # reference taken above is released by the server once
+                # the device copy lands
+                req.block_table = shared[:-1] + blocks
+                req.cached_len = len(full) - 1
+                req.shared_blocks = k - 1
+                req.cow_fork = (shared[-1], k - 1)
+            else:
+                req.block_table = shared + blocks
+                req.cached_len = k * bs
+                req.shared_blocks = k
+                req.cow_fork = None
+            assert req.cached_len >= req.shared_blocks * bs, \
+                "KV write position inside the read-only shared prefix"
+            req.prefix_hit_blocks = k
+            req.indexed_blocks = k
+            req.prefix_digest = (digests[-1] if k
+                                 else (pc.root_digest if pc else None))
             req.slot = free
             req.admit_seq = self._admit_counter
             self._admit_counter += 1
-            req.next_input = req.full_prompt[-1]
-            req.state = (RequestState.PREFILL if len(req.full_prompt) > 1
+            req.next_input = full[-1]
+            req.state = (RequestState.PREFILL
+                         if len(full) - 1 - req.cached_len > 0
                          else RequestState.RUNNING)
             self.slots[free] = req
             if self.observer is not None:
@@ -221,7 +309,11 @@ class ContinuousBatchingScheduler:
             while self.cache.blocks_for(
                     min(req.cached_len + req.step_budget,
                         self.max_model_len)) > len(req.block_table):
-                grown = self.allocator.allocate(1)
+                # reclaim-before-preempt rides inside the allocate: a
+                # cold cached block is free capacity, so no preemption
+                # ever fires while the prefix index holds reclaimable
+                # blocks
+                grown = self._allocate_reclaiming(1, req.req_id)
                 if grown is not None:
                     req.block_table.extend(grown)
                     continue
@@ -261,8 +353,7 @@ class ContinuousBatchingScheduler:
         req.max_cached_len = max(req.max_cached_len, req.cached_len)
         if self.observer is not None:
             self.observer.on_preempt(req, reason, req.cached_len)
-        self.allocator.free(req.block_table)
-        req.block_table = []
+        self._release_blocks(req)
         req.cached_len = 0
         self.slots[req.slot] = None
         req.slot = None
@@ -274,10 +365,25 @@ class ContinuousBatchingScheduler:
         # front of the line: it was admitted before anything still waiting
         self.waiting.appendleft(req)
 
+    def _release_blocks(self, req: Request):
+        """Drop every block reference *req* holds — its table AND a
+        pending COW fork's source. Frees are refcount decrements: blocks
+        a sharer or the prefix index still references stay live, so
+        preempting (or finishing) one sharer never perturbs another
+        sharer's table — the sharing tests pin exactly that."""
+        if req.cow_fork is not None:
+            # fork planned but the device copy never ran (preempted or
+            # failed in the same schedule that admitted it): release the
+            # source reference the admission took
+            self.allocator.free([req.cow_fork[0]], owner=req.req_id)
+            req.cow_fork = None
+        self.allocator.free(req.block_table, owner=req.req_id)
+        req.block_table = []
+        req.shared_blocks = 0
+
     # ------------------------------------------------------------ finish
     def finish(self, req: Request, reason: str):
-        self.allocator.free(req.block_table)
-        req.block_table = []
+        self._release_blocks(req)
         self.slots[req.slot] = None
         req.slot = None
         req.state = RequestState.FINISHED

@@ -153,11 +153,25 @@ class ServingEngine:
             self.guardian.resume_fn = self._resume_admission
             if self.observatory is not None:
                 self.observatory.on_anomaly = self.guardian.hook("serving")
+        # shared-prefix KV reuse (serving.prefix_cache block): the
+        # scheduler reads cache.prefix_cache at admission; the server
+        # executes the planned COW forks and registers full blocks as
+        # prefill/decode completes them
+        pc_cfg = getattr(config, "prefix_cache", None)
+        if pc_cfg is not None and pc_cfg.enabled:
+            self.cache.attach_prefix_cache(
+                capacity_blocks=pc_cfg.capacity_blocks,
+                attention_impl=config.attention_impl)
         self._watch = CompileWatch(registry=self.registry)
         self._decode_fn = self._watch.wrap(self.runner.decode_step,
                                            name="serving_decode_step")
         self._prefill_fn = self._watch.wrap(self.runner.prefill_chunk,
                                             name="serving_prefill_chunk")
+        # the COW fork's device copy is its OWN compiled program (one
+        # signature for the serving lifetime — src/dst are traced
+        # scalars), never a third decode/prefill signature
+        self._copy_fn = self._watch.wrap(self.runner.copy_block,
+                                         name="serving_block_copy")
         self.prefill = ChunkedPrefill(self._prefill_fn,
                                       chunk_size=config.prefill_chunk)
         from jax.sharding import NamedSharding, PartitionSpec
@@ -232,6 +246,11 @@ class ServingEngine:
             # input; collected DURING the step because finished requests
             # vacate their slots before the step ends
             acts = {}
+            # COW forks first: a forked request may decode THIS step, and
+            # its table already names the fork target — the copy must
+            # land before any dispatch reads or writes it
+            for req in plan.cow_forks:
+                progress |= self._run_cow_fork(req)
             for req in plan.prefill:
                 progress |= self._run_prefill(req, acts)
             if plan.decode_slots:
@@ -319,6 +338,51 @@ class ServingEngine:
                 "requests completed", labels={"reason": "capacity"}).inc()
         return True
 
+    def _run_cow_fork(self, req) -> bool:
+        """Execute one planned copy-on-write fork: device-copy the shared
+        source block into the request's private fork target, then release
+        the source reference the admission pinned. One compiled program,
+        one block of traffic — the whole cost of diverging from a shared
+        prefix."""
+        src, idx = req.cow_fork
+        with trace_span("serving_cow_fork", req=req.req_id):
+            with self.engine.mesh:
+                self.pools = self._copy_fn(
+                    self.pools, np.int32(src),
+                    np.int32(req.block_table[idx]))
+        self.cache.allocator.free([src], owner=req.req_id)
+        req.cow_fork = None
+        pc = self.cache.prefix_cache
+        if pc is not None:
+            pc.cow_forks += 1
+        self.registry.counter(
+            "serving_prefix_cow_forks_total",
+            "copy-on-write block forks (first divergent write to a "
+            "shared block)").inc()
+        return True
+
+    def _index_blocks(self, req):
+        """Register every newly-FULL block of *req* in the prefix index
+        (chain digest extended block by block). Called after prefill
+        chunks and decode deliveries — generated tokens index too, so a
+        follow-up turn carrying this request's output as context hits,
+        and a preempted request re-admits onto its own still-cached
+        blocks instead of recomputing them."""
+        pc = self.cache.prefix_cache
+        if pc is None:
+            return
+        bs = self.cache.block_size
+        n_full = min(req.cached_len // bs, len(req.block_table))
+        if req.indexed_blocks >= n_full:
+            return
+        full = req.full_prompt
+        while req.indexed_blocks < n_full:
+            b = req.indexed_blocks
+            req.prefix_digest = pc.insert(
+                req.prefix_digest, full[b * bs:(b + 1) * bs], b * bs,
+                req.block_table[b])
+            req.indexed_blocks += 1
+
     def _run_prefill(self, req, acts=None) -> bool:
         slot, start = req.slot, req.cached_len
         t0 = time.perf_counter_ns()
@@ -340,8 +404,14 @@ class ServingEngine:
                 "tokens re-prefilled because a preemption evicted their "
                 "KV").inc(n_recompute)
         if acts is not None:
-            acts[slot] = ("recompute" if n_recompute else "prefill",
-                          n_valid)
+            # cached_prefill: this chunk exists because the cache DIDN'T
+            # cover the whole prompt — the tail of a prefix-hit
+            # admission. Still useful work (recompute outranks it: a
+            # re-prefilled position is waste whatever got it admitted)
+            acts[slot] = ("recompute" if n_recompute
+                          else ("cached_prefill" if req.prefix_hit_blocks
+                                else "prefill"), n_valid)
+        self._index_blocks(req)
         if self.observatory is not None:
             self.observatory.record_prefill(req, slot, start, n_valid,
                                             n_recompute, t0, t1, done)
@@ -420,6 +490,9 @@ class ServingEngine:
                 break
         if not delivered:
             return 0
+        # register newly-full blocks BEFORE any finish releases the
+        # table: a finished request's prefix stays warm in the index
+        self._index_blocks(req)
         req.last_token_t = now
         if req.first_token_t is None:
             req.first_token_t = now
@@ -470,6 +543,23 @@ class ServingEngine:
         self.registry.gauge("serving_kv_occupancy",
                             "fraction of usable KV blocks allocated").set(
                                 self.cache.allocator.occupancy())
+        pc = self.cache.prefix_cache
+        if pc is not None:
+            for name, help_, total in (
+                    ("serving_prefix_cache_hits_total",
+                     "full prompt blocks served read-only from the "
+                     "prefix index at admission", pc.hits),
+                    ("serving_prefix_cache_misses_total",
+                     "full prompt blocks the prefix index did not hold "
+                     "at admission", pc.misses)):
+                c = self.registry.counter(name, help_)
+                delta = total - c.value
+                if delta > 0:
+                    c.inc(delta)
+            self.registry.gauge(
+                "serving_prefix_blocks_shared",
+                "resident prefix-index blocks currently mapped by at "
+                "least one live request").set(pc.shared_blocks())
         for reason, total in self.scheduler.preemptions_by_reason.items():
             # labeled by WHY the eviction happened (capacity_growth: a
             # running slot needed a block and the pool was dry; admission
@@ -594,8 +684,34 @@ class ServingEngine:
                 "fragmentation": round(self._kv_fragmentation(), 4),
                 "pool_bytes": self.cache.pool_bytes(),
             },
+            "prefix_cache": (None if self.cache.prefix_cache is None
+                             else self.cache.prefix_cache.stats()),
             "compile": self.compile_stats(),
         }
+
+    def router_signals(self):
+        """The per-replica admission signals a :class:`ServingRouter`
+        scores: queue/occupancy pressure plus whether the PR-9 SLO rules
+        fired RECENTLY (within the last two observation windows —
+        treating an incident from an hour ago as live would park a
+        healthy replica forever). With observability off the SLO flags
+        stay False and routing degrades to load + affinity."""
+        sig = {
+            "queue_depth": self.scheduler.num_waiting,
+            "active": self.scheduler.num_active,
+            "kv_occupancy": self.cache.allocator.occupancy(),
+            "ttft_slo_breach": False,
+            "queue_growth": False,
+        }
+        obs = self.observatory
+        if obs is not None:
+            horizon = obs.steps_seen - 2 * obs.window
+            for a in obs.anomalies:
+                if a.get("step", 0) >= horizon and \
+                        a.get("rule") in ("ttft_slo_breach",
+                                          "queue_growth"):
+                    sig[a["rule"]] = True
+        return sig
 
     def serving_report(self, write=False):
         """The structured serving forensics dict: the observatory report

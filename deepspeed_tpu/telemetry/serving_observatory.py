@@ -22,6 +22,9 @@ counterpart, three pieces sharing one window clock:
 
   ==================  ====================================================
   ``decode_useful``   a kept generated token (the goodput of serving)
+  ``cached_prefill``  caching prompt tokens for a request whose prefix
+                      was partly mapped read-only from the prefix cache
+                      (prefill the cache already shortened)
   ``prefill``         caching fresh prompt tokens
   ``recompute``       re-caching tokens a preemption evicted (the chunk
                       re-covers previously-cached positions)
@@ -69,10 +72,13 @@ from deepspeed_tpu.telemetry import tracer as _tracer_mod
 from deepspeed_tpu.telemetry.health import json_safe
 from deepspeed_tpu.utils.logging import logger
 
-SERVING_HEALTH_SCHEMA = "deepspeed_tpu.serving_health/1"
+SERVING_HEALTH_SCHEMA = "deepspeed_tpu.serving_health/2"
 
-SLOT_CATEGORIES = ("decode_useful", "prefill", "recompute", "frozen",
-                   "idle")
+# cached_prefill: prompt tokens a chunk advanced for a request whose
+# prefix was partly served read-only from the prefix cache — useful
+# work, split out so hit-rate shows up in the ledger, not just counters
+SLOT_CATEGORIES = ("decode_useful", "cached_prefill", "prefill",
+                   "recompute", "frozen", "idle")
 # wasted = everything that burned a slot without advancing a request
 WASTE_CATEGORIES = ("recompute", "frozen", "idle")
 
@@ -149,7 +155,8 @@ class SlotStepLedger:
 
     def account(self, acts, occupied):
         """Book one scheduler step. ``acts`` maps slot →
-        ``("prefill"|"recompute", n_valid)`` or ``("decode", delivered)``;
+        ``("prefill"|"cached_prefill"|"recompute", n_valid)`` or
+        ``("decode", delivered)``;
         ``occupied`` is the set of slots still holding a request (a slot
         neither acted nor occupied is idle; occupied-but-unscheduled is
         frozen — an invariant breach worth seeing, not hiding)."""
@@ -402,8 +409,10 @@ class ServingObservatory:
             self._now_ms(), "prefill_chunk", slot=slot, start=int(start),
             n_valid=int(n_valid), recompute=int(n_recompute),
             done=bool(done))
-        self._lane_span(slot, "recompute" if n_recompute else "prefill",
-                        t0_ns, t1_ns, tokens=int(n_valid),
+        kind = ("recompute" if n_recompute else
+                ("cached_prefill" if getattr(req, "prefix_hit_blocks", 0)
+                 else "prefill"))
+        self._lane_span(slot, kind, t0_ns, t1_ns, tokens=int(n_valid),
                         recompute=int(n_recompute))
 
     def record_decode(self, dispatch_by_slot, t0_ns, t1_ns):
@@ -633,6 +642,7 @@ class ServingObservatory:
                           f"tokens — the KV pool is too small for the "
                           f"admitted load"})
         useful = (window["slot_units"]["decode_useful"]
+                  + window["slot_units"]["cached_prefill"]
                   + window["slot_units"]["prefill"]
                   + window["slot_units"]["recompute"])
         if window["active"]["max"] > 0 and useful == 0:

@@ -33,6 +33,16 @@ baseline's) and REFUSES to write a regen where continuous batching does
 not win, the categories don't sum, or serving wastes as much as the
 static baseline.
 
+A second, shared-prefix trace (a pool of long common prefixes + short
+unique tails — the system-prompt/few-shot production shape) replays
+twice at EQUAL config, prefix cache off then on, and the artifact's
+``prefix_cache`` section carries the A/B: hit rate, COW forks, peak
+shared blocks, and TTFT p50 both ways. The regen refuses an artifact
+where the cached run's TTFT p50 is not strictly better or either run's
+slot-step categories stop summing exactly. A router section reports
+aggregate tok/s for 1 vs 2 cache-armed replicas behind the
+prefix-affinity ServingRouter on the same trace shape.
+
 Run:  JAX_PLATFORMS=cpu python tests/perf/serving_bench.py        # laptop
       python tests/perf/serving_bench.py                          # TPU
 Env:  SERVING_BENCH_OUT (default SERVING_BENCH.json at the repo root),
@@ -40,7 +50,10 @@ Env:  SERVING_BENCH_OUT (default SERVING_BENCH.json at the repo root),
       SERVING_BENCH_N (requests, default 96), SERVING_BENCH_BATCH
       (max batch, default 8), SERVING_BENCH_KV (auto|int8),
       SERVING_BENCH_ATTN (gather|paged), SERVING_BENCH_DECODE_STEPS
-      (tokens per decode dispatch, default 8).
+      (tokens per decode dispatch, default 8),
+      SERVING_BENCH_PREFIX_N / _PREFIX_POOL / _PREFIX_LEN / _REUSE
+      (shared-prefix trace: requests 64, pool 4, prefix length 96,
+      reuse ratio 0.9), SERVING_BENCH_ROUTER_N (router trace size, 32).
 """
 
 import dataclasses
@@ -93,6 +106,32 @@ def build_trace(n, vocab, max_batch, seed=0):
                      int(g)) for p, g in zip(prompt_lens, gen_lens)]
 
 
+def build_prefix_trace(n, vocab, prefix_pool=4, prefix_len=96,
+                       reuse_ratio=0.9, seed=1):
+    """Shared-prefix trace: a pool of ``prefix_pool`` common prefixes of
+    ``prefix_len`` tokens (system prompts / few-shot templates); each
+    request draws one + a short unique tail with probability
+    ``reuse_ratio``, else a fully unique prompt. Tails stop at 31 tokens
+    so with block_size 32 every FULL prompt block belongs to the shared
+    prefix — the trace measures prefix reuse, not accidental tail
+    collisions. Deterministic in ``seed``."""
+    rng = np.random.default_rng(seed)
+    prefixes = [rng.integers(0, vocab, (prefix_len,)).astype(np.int32)
+                for _ in range(prefix_pool)]
+    out = []
+    for _ in range(n):
+        if rng.random() < reuse_ratio:
+            head = prefixes[int(rng.integers(prefix_pool))]
+            tail = rng.integers(
+                0, vocab, (int(rng.integers(8, 32)),)).astype(np.int32)
+            prompt = np.concatenate([head, tail])
+        else:
+            prompt = rng.integers(
+                0, vocab, (int(rng.integers(16, 129)),)).astype(np.int32)
+        out.append(TraceReq(prompt, int(rng.integers(8, 17))))
+    return out
+
+
 def run_baseline(eng, trace, max_batch):
     """Batch-synchronous: FCFS groups of max_batch, padded prompts,
     decode to the batch max gen. Returns (elapsed_s, ttfts_s, waste)."""
@@ -125,9 +164,11 @@ def run_baseline(eng, trace, max_batch):
     return elapsed, ttfts, 1.0 - useful / decoded
 
 
-def run_serving(make_engine, trace):
+def run_serving(make_engine, trace, sample=None):
     """Continuous batching: submit the whole trace at t=0, drive step()
-    while sampling KV occupancy."""
+    while sampling KV occupancy (plus an optional per-step ``sample``
+    hook — the prefix A/B uses it to catch peak shared blocks, which
+    are 0 again once the trace drains)."""
     srv = make_engine()
     # warm both compiled programs outside the timed window
     srv.submit(trace[0].prompt[:9], max_new_tokens=2)
@@ -147,12 +188,68 @@ def run_serving(make_engine, trace):
     while srv.scheduler.has_work():
         srv.step()
         occ.append(srv.cache.allocator.occupancy())
+        if sample is not None:
+            sample(srv)
     elapsed = time.perf_counter() - t0
     outs = {o.req_id: o for o in srv.collect()}
     assert set(rids) == set(outs), "trace must fully drain"
     assert all(len(outs[r].tokens) == t.gen
                for r, t in zip(rids, trace)), "wrong token counts"
     return srv, elapsed, [outs[r].ttft_s for r in rids], occ, warm
+
+
+def slot_steps_of(srv, warm, max_batch, K):
+    """The timed trace's slot-step attribution (warm-up diffed out):
+    integer micro-units, so the sums-to-total check is EXACT."""
+    units_all, steps_all = srv.observatory.ledger.totals()
+    units = {c: units_all[c] - warm["slot_units"][c] for c in units_all}
+    sched_steps = steps_all - warm["slot_steps"]
+    total_units = sum(units.values())
+    wasted_units = units["idle"] + units["frozen"] + units["recompute"]
+    return {
+        "steps": sched_steps,
+        "max_batch": max_batch,
+        "decode_steps": K,
+        "units": units,
+        "total_units": total_units,
+        "expected_units": sched_steps * max_batch * K,
+        "sums_exact": total_units == sched_steps * max_batch * K,
+        "wasted_frac": round(wasted_units / max(1, total_units), 4),
+    }
+
+
+def run_router(eng, serving_cfg, trace, n_replicas, make_registry):
+    """Aggregate throughput of ``n_replicas`` cache-armed replicas
+    behind the prefix-affinity router (fresh engines per run; every
+    replica warmed outside the timed window)."""
+    import copy
+
+    from deepspeed_tpu.serving.router import ServingRouter
+    from deepspeed_tpu.serving.server import ServingEngine
+    engines = [ServingEngine(eng, config=copy.deepcopy(serving_cfg),
+                             registry=make_registry())
+               for _ in range(n_replicas)]
+    router = ServingRouter(engines)
+    for e in engines:
+        e.submit(trace[0].prompt[:9], max_new_tokens=2)
+    while any(e.scheduler.has_work() for e in engines):
+        router.step()
+    router.collect()
+    t0 = time.perf_counter()
+    rids = [router.submit(r.prompt, max_new_tokens=r.gen) for r in trace]
+    outs = {o.req_id: o for o in router.serve_forever()}
+    elapsed = time.perf_counter() - t0
+    assert set(rids) == set(outs), "router trace must fully drain"
+    useful = sum(r.gen for r in trace)
+    hit_rates = [e.cache.prefix_cache.stats()["hit_rate"]
+                 for e in engines]
+    return {
+        "replicas": n_replicas,
+        "elapsed_s": round(elapsed, 4),
+        "aggregate_tok_s": round(useful / elapsed, 1),
+        "routed_by_replica": list(router.routed_by_replica),
+        "prefix_hit_rate_by_replica": hit_rates,
+    }
 
 
 def main():
@@ -219,26 +316,81 @@ def main():
 
     tok_hist = registry.histogram("serving_token_latency_ms")
     stats = srv.compile_stats()
-    # slot-step attribution of the TIMED trace (warm-up diffed out):
-    # integer micro-units, so the sums-to-total check is EXACT
-    units_all, steps_all = srv.observatory.ledger.totals()
-    units = {c: units_all[c] - warm["slot_units"][c] for c in units_all}
-    sched_steps = steps_all - warm["slot_steps"]
     K = serving_cfg["decode_steps"]
-    total_units = sum(units.values())
-    wasted_units = units["idle"] + units["frozen"] + units["recompute"]
-    slot_steps = {
-        "steps": sched_steps,
-        "max_batch": max_batch,
-        "decode_steps": K,
-        "units": units,
-        "total_units": total_units,
-        "expected_units": sched_steps * max_batch * K,
-        "sums_exact": total_units == sched_steps * max_batch * K,
-        "wasted_frac": round(wasted_units / max(1, total_units), 4),
+    slot_steps = slot_steps_of(srv, warm, max_batch, K)
+    sched_steps, total_units = slot_steps["steps"], slot_steps["total_units"]
+
+    # ---- shared-prefix A/B: equal config, prefix cache off then on
+    ptrace = build_prefix_trace(
+        int(os.environ.get("SERVING_BENCH_PREFIX_N", "64")),
+        cfg.vocab_size,
+        prefix_pool=int(os.environ.get("SERVING_BENCH_PREFIX_POOL", "4")),
+        prefix_len=int(os.environ.get("SERVING_BENCH_PREFIX_LEN", "96")),
+        reuse_ratio=float(os.environ.get("SERVING_BENCH_REUSE", "0.9")))
+    srv_off, off_s, off_ttfts, _, off_warm = run_serving(
+        lambda: ServingEngine(eng, config=dict(serving_cfg),
+                              registry=MetricsRegistry()), ptrace)
+    off_slots = slot_steps_of(srv_off, off_warm, max_batch, K)
+    shared_peak = [0]
+
+    def sample_shared(s):
+        shared_peak[0] = max(shared_peak[0],
+                             s.cache.prefix_cache.shared_blocks())
+    cache_cfg = {**serving_cfg, "prefix_cache": {"enabled": True}}
+    srv_on, on_s, on_ttfts, _, on_warm = run_serving(
+        lambda: ServingEngine(eng, config=dict(cache_cfg),
+                              registry=MetricsRegistry()), ptrace,
+        sample=sample_shared)
+    on_slots = slot_steps_of(srv_on, on_warm, max_batch, K)
+    pc_stats = srv_on.cache.prefix_cache.stats()
+    off_p50 = _exact_percentile(off_ttfts, .5) * 1e3
+    on_p50 = _exact_percentile(on_ttfts, .5) * 1e3
+    prefix_section = {
+        "trace": {
+            "n_requests": len(ptrace),
+            "prefix_pool": int(os.environ.get(
+                "SERVING_BENCH_PREFIX_POOL", "4")),
+            "prefix_len": int(os.environ.get(
+                "SERVING_BENCH_PREFIX_LEN", "96")),
+            "reuse_ratio": float(os.environ.get(
+                "SERVING_BENCH_REUSE", "0.9")),
+            "seed": 1,
+        },
+        "hit_rate": pc_stats["hit_rate"],
+        "hits": pc_stats["hits"],
+        "misses": pc_stats["misses"],
+        "cow_forks": pc_stats["cow_forks"],
+        "blocks_shared_peak": shared_peak[0],
+        "insertions": pc_stats["insertions"],
+        "ttft_p50_ms": {"cache_off": round(off_p50, 2),
+                        "cache_on": round(on_p50, 2)},
+        "ttft_improvement": round(off_p50 / on_p50, 3),
+        "elapsed_s": {"cache_off": round(off_s, 4),
+                      "cache_on": round(on_s, 4)},
+        "prefill_chunks": {
+            "cache_off": int(srv_off.registry.counter(
+                "serving_prefill_chunks_total").value
+                - off_warm["serving_prefill_chunks_total"]),
+            "cache_on": int(srv_on.registry.counter(
+                "serving_prefill_chunks_total").value
+                - on_warm["serving_prefill_chunks_total"])},
+        "slot_steps": {"cache_off": off_slots, "cache_on": on_slots},
+        "compile": srv_on.compile_stats(),
     }
+
+    # ---- router: aggregate tok/s vs replica count, same trace shape
+    rtrace = build_prefix_trace(
+        int(os.environ.get("SERVING_BENCH_ROUTER_N", "32")),
+        cfg.vocab_size, seed=2)
+    router_section = {
+        "trace_requests": len(rtrace),
+        "useful_tokens": sum(r.gen for r in rtrace),
+        "runs": [run_router(eng, cache_cfg, rtrace, n, MetricsRegistry)
+                 for n in (1, 2)],
+    }
+
     doc = {
-        "schema": "deepspeed_tpu.serving_bench/2",
+        "schema": "deepspeed_tpu.serving_bench/3",
         "scenario": {
             "model": name, "n_embd": cfg.n_embd, "n_layer": cfg.n_layer,
             "backend": jax.default_backend(), "kv_cache": kv,
@@ -281,6 +433,8 @@ def main():
             "slot_steps": slot_steps,
             "compile": stats,
         },
+        "prefix_cache": prefix_section,
+        "router": router_section,
     }
     doc["speedup"] = round(doc["serving"]["tok_s"]
                            / doc["baseline"]["tok_s"], 3)
@@ -308,6 +462,24 @@ def main():
               "below the static baseline's "
               f"{doc['baseline']['wasted_decode_frac']:.1%} — continuous "
               "batching stopped paying for itself", file=sys.stderr)
+        sys.exit(1)
+    if on_p50 >= off_p50:
+        print("REFUSING to write artifact: prefix cache ON gave TTFT "
+              f"p50 {on_p50:.1f} ms, not better than cache OFF's "
+              f"{off_p50:.1f} ms at equal config — the cache stopped "
+              "paying for itself", file=sys.stderr)
+        sys.exit(1)
+    for label, ss in (("cache_off", off_slots), ("cache_on", on_slots)):
+        if not ss["sums_exact"]:
+            print(f"REFUSING to write artifact: {label} slot-step "
+                  f"categories sum to {ss['total_units']} units, "
+                  f"expected {ss['expected_units']} — the "
+                  "by-construction invariant broke", file=sys.stderr)
+            sys.exit(1)
+    pc_compile = prefix_section["compile"]
+    if pc_compile["decode_signatures"] != 1 or pc_compile["retraces"]:
+        print("REFUSING to write artifact: cache-on run's decode "
+              f"program count != 1 ({pc_compile})", file=sys.stderr)
         sys.exit(1)
     out = os.environ.get("SERVING_BENCH_OUT") or os.path.join(
         os.path.dirname(__file__), "..", "..", "SERVING_BENCH.json")

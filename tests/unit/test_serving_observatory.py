@@ -74,8 +74,9 @@ def test_ledger_sums_by_construction():
     assert sum(units.values()) == steps * 3 * 4        # EXACT, integers
     # step 1: slot0 3 useful + 1 frozen, slot1 4 prefill, slot2 idle;
     # step 2: slot0 4 useful, slot1 idle, slot2 frozen; step 3: 12 idle
-    assert units == {"decode_useful": 7, "prefill": 4, "recompute": 0,
-                     "frozen": 5, "idle": 20}
+    assert units == {"decode_useful": 7, "cached_prefill": 0,
+                     "prefill": 4, "recompute": 0, "frozen": 5,
+                     "idle": 20}
     assert led.wasted_fraction() == (5 + 20) / 36
 
 
@@ -325,7 +326,7 @@ def test_snapshot_is_strict_json(tmp_path):
     with open(path) as f:
         doc = json.load(f, parse_constant=lambda tok: pytest.fail(
             f"snapshot carries bare {tok!r}"))
-    assert doc["schema"] == "deepspeed_tpu.serving_health/1"
+    assert doc["schema"] == "deepspeed_tpu.serving_health/2"
     assert doc["anomalies"]
 
 
@@ -574,7 +575,7 @@ def test_e2e_livelock_error_carries_report(obs_serving):
         srv.serve_forever()
     err = ei.value
     assert "no progress" in str(err) and ".report" in str(err)
-    assert err.report["schema"] == "deepspeed_tpu.serving_health/1"
+    assert err.report["schema"] == "deepspeed_tpu.serving_health/2"
     st = err.report["engine_state"]["scheduler"]
     # last rites ran BEFORE the report: nothing is left pending, the
     # stuck request finished with the structured livelock reason
