@@ -23,6 +23,11 @@ Config via env:
   BENCH_GOODPUT 1 (default) arms the wall-clock goodput ledger (host-side
                 only, no ticks inside the timed loop) and writes
                 GOODPUT_BENCH.json; 0 disables it
+  BENCH_ANATOMY 0 (default) | 1 profiles 3 post-warmup steps OUTSIDE the
+                timed loop with jax.profiler, post-processes the trace
+                into measured per-category device seconds
+                (ANATOMY_BENCH.json, gitignored) and emits the
+                measured-vs-predicted drift in the JSON line
   BENCH_PREFETCH 1 (default) feeds the timed loop through the async input
                 pipeline (data_prefetch: host collate workers + device
                 double-buffering, runtime/prefetch.py) so the H2D copy
@@ -313,6 +318,15 @@ def main():
     # forced report after the rounds writes FLEET_BENCH.json.
     fleet_on = telemetry_on and os.environ.get(
         "BENCH_FLEET", "0").lower() in ("1", "true", "yes")
+    # Step anatomy (telemetry/step_anatomy.py): OFF by default — the
+    # profiler capture runs 3 EXTRA steps after the timed loop (outside
+    # it, so the headline is untouched) but jax.profiler's one-time init
+    # is seconds of host work. When on, ANATOMY_BENCH.json (gitignored —
+    # machine-local measured timings, unlike the committed demo
+    # artifact) holds the measured per-category device seconds and the
+    # JSON line carries the measured-vs-predicted drift.
+    anatomy_on = telemetry_on and (not layered) and os.environ.get(
+        "BENCH_ANATOMY", "0").lower() in ("1", "true", "yes")
     bench_dir = os.path.dirname(os.path.abspath(__file__))
     telemetry_dir = os.path.join(bench_dir, "telemetry")
     ds_config = {
@@ -649,6 +663,31 @@ def main():
         except Exception as e:   # the tracker must never sink a bench
             print(f"# optimizer microbench unavailable: {e}", flush=True)
 
+    # measured step anatomy: 3 profiled steps AFTER (outside) the timed
+    # loop, post-processed into per-category device seconds + the
+    # measured-vs-predicted drift against the CostExplorer roofline
+    anatomy_drift = None
+    if anatomy_on and hasattr(engine, "profile_step"):
+        try:
+            ar = engine.profile_step(3, write=False)
+            if ar.get("enabled"):
+                with open(os.path.join(bench_dir, "ANATOMY_BENCH.json"),
+                          "w") as f:
+                    json.dump({
+                        "bench": name,
+                        "step_time_ms": round(med_step_ms, 1),
+                        "anatomy": ar}, f, indent=1, default=repr,
+                        allow_nan=False)
+                anatomy_drift = {
+                    r["category"]: (round(r["drift"], 4)
+                                    if r["drift"] is not None else None)
+                    for r in ar.get("measured_vs_predicted", [])}
+            else:
+                print(f"# anatomy capture skipped: {ar.get('reason')}",
+                      flush=True)
+        except Exception as e:   # forensics must never sink a bench
+            print(f"# anatomy profile unavailable: {e}", flush=True)
+
     input_wait_frac = None
     if goodput_on and hasattr(engine, "goodput_report"):
         try:
@@ -704,6 +743,10 @@ def main():
         # window records (BENCH_FLEET=1; FLEET_BENCH.json holds the
         # aggregated report)
         "fleet": fleet_on,
+        # measured-vs-predicted per-category drift from the profiled
+        # post-loop steps (BENCH_ANATOMY=1; None off / unavailable —
+        # predicted sides are None on hosts without chip specs)
+        "anatomy_drift": anatomy_drift,
     }))
 
     # telemetry artifact next to BENCH_*.json: where the trace/sink files

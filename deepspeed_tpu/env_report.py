@@ -127,6 +127,14 @@ def telemetry_report():
     except Exception:
         row("jax.profiler programmatic capture", False)
     try:
+        from deepspeed_tpu.telemetry.xplane import parse_xspace
+        del parse_xspace
+        row("step anatomy (xplane parser)", True,
+            "(telemetry.anatomy block; engine.profile_step(n) -> "
+            "STEP_ANATOMY.json; dependency-free .xplane.pb reader)")
+    except Exception:
+        row("step anatomy (xplane parser)", False)
+    try:
         from jax import monitoring
         row("jax.monitoring listener",
             hasattr(monitoring, "register_event_duration_secs_listener"))

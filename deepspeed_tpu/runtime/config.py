@@ -195,6 +195,18 @@ class DeepSpeedTelemetryConfig(DeepSpeedConfigObject):
             C.GOODPUT_PROFILER_MAX_CAPTURES_DEFAULT)
         self.goodput_profiler_dir = g.get(C.GOODPUT_PROFILER_DIR,
                                           C.GOODPUT_PROFILER_DIR_DEFAULT)
+        # anatomy sub-block (telemetry/step_anatomy.py): measured device-
+        # time attribution from bounded jax.profiler captures. Flattened
+        # onto anatomy_* attributes.
+        an = t.get(C.TELEMETRY_ANATOMY, {}) or {}
+        self.anatomy_enabled = an.get(C.ANATOMY_ENABLED,
+                                      C.ANATOMY_ENABLED_DEFAULT)
+        self.anatomy_capture_steps = int(an.get(
+            C.ANATOMY_CAPTURE_STEPS, C.ANATOMY_CAPTURE_STEPS_DEFAULT))
+        self.anatomy_keep_raw_traces = int(an.get(
+            C.ANATOMY_KEEP_RAW_TRACES, C.ANATOMY_KEEP_RAW_TRACES_DEFAULT))
+        self.anatomy_report_file = an.get(C.ANATOMY_REPORT_FILE,
+                                          C.ANATOMY_REPORT_FILE_DEFAULT)
         # fleet sub-block (telemetry/fleet.py): cross-rank flight recorder
         # — per-rank window-record shipping + rank-0 skew/desync
         # sentinels. Flattened onto fleet_* attributes.
@@ -246,6 +258,10 @@ class DeepSpeedTelemetryConfig(DeepSpeedConfigObject):
         if env_g is not None:
             self.goodput_enabled = env_g.lower() in ("1", "true", "yes",
                                                      "on")
+        env_an = os.environ.get("DS_TELEMETRY_ANATOMY")
+        if env_an is not None:
+            self.anatomy_enabled = env_an.lower() in ("1", "true", "yes",
+                                                      "on")
         env_f = os.environ.get("DS_TELEMETRY_FLEET")
         if env_f is not None:
             self.fleet_enabled = env_f.lower() in ("1", "true", "yes",
@@ -256,6 +272,14 @@ class DeepSpeedTelemetryConfig(DeepSpeedConfigObject):
         env_fr = os.environ.get("DS_TELEMETRY_FLEET_RANK")
         if env_fr is not None:
             self.fleet_rank = int(env_fr)
+        if self.anatomy_capture_steps < 1:
+            raise DeepSpeedConfigError(
+                f"telemetry.anatomy.capture_steps must be >= 1, got "
+                f"{self.anatomy_capture_steps}")
+        if self.anatomy_keep_raw_traces < 0:
+            raise DeepSpeedConfigError(
+                f"telemetry.anatomy.keep_raw_traces must be >= 0, got "
+                f"{self.anatomy_keep_raw_traces}")
         if self.fleet_cadence < 0:
             raise DeepSpeedConfigError(
                 f"telemetry.fleet.cadence must be >= 0, got "

@@ -201,6 +201,23 @@ GOODPUT_PROFILER_MAX_CAPTURES_DEFAULT = 1
 GOODPUT_PROFILER_DIR = "profiler_dir"       # "" -> <output_path>/goodput_profile
 GOODPUT_PROFILER_DIR_DEFAULT = ""
 
+# telemetry.anatomy: step-anatomy profiler (telemetry/step_anatomy.py).
+# When enabled, engine.profile_step(n) / ServingEngine.profile_window(n)
+# run a bounded jax.profiler capture, post-process the XSpace trace with
+# the dependency-free xplane parser, and write a schema-pinned
+# STEP_ANATOMY.json (measured per-category device seconds joined to the
+# HLO census + CostExplorer rooflines). Inert unless profile_step is
+# called: no imports, no overhead on the train path.
+TELEMETRY_ANATOMY = "anatomy"
+ANATOMY_ENABLED = "enabled"
+ANATOMY_ENABLED_DEFAULT = True
+ANATOMY_CAPTURE_STEPS = "capture_steps"     # default steps per profile_step
+ANATOMY_CAPTURE_STEPS_DEFAULT = 3
+ANATOMY_KEEP_RAW_TRACES = "keep_raw_traces"  # newest N raw trace dirs kept
+ANATOMY_KEEP_RAW_TRACES_DEFAULT = 2
+ANATOMY_REPORT_FILE = "report_file"  # "" -> <output_path>/STEP_ANATOMY.json
+ANATOMY_REPORT_FILE_DEFAULT = ""
+
 # telemetry.fleet: cross-rank flight recorder (telemetry/fleet.py). Every
 # rank ships window records (atomic files) into a shared run directory;
 # fleet rank 0 merges them and runs the cross-rank sentinels —

@@ -30,7 +30,9 @@ the zero_to_fp32 converter work unchanged.
 """
 
 import contextlib
+import glob
 import os
+import shutil
 import time
 from typing import Any, NamedTuple, Optional
 
@@ -2038,6 +2040,152 @@ class DeepSpeedEngine:
         if write:
             led.write_snapshot(force=True, report=report)
         return report
+
+    # --------------------------------------------------- step anatomy
+    def profile_step(self, steps=None, batch=None, out=None, write=True):
+        """Measured device-time attribution for *steps* train steps.
+
+        Runs a bounded ``jax.profiler`` capture around N annotated
+        ``train_batch`` calls, post-processes the XSpace trace with the
+        dependency-free xplane parser, joins the per-op device events to
+        the engine's own compiled HLO (``op_name`` module paths, census
+        collectives, CostExplorer roofline floors) and writes the
+        schema-pinned ``STEP_ANATOMY.json``. The capture reuses the
+        already-primed step signature — zero additional train-step
+        compiles. Inert (returns ``{"enabled": False}``) when
+        ``telemetry.anatomy`` is off or the profiler is unavailable.
+
+        ``batch`` defaults to the last trained batch; when the engine
+        has never stepped, one warmup step runs OUTSIDE the capture
+        window so compile time never pollutes the measured anatomy."""
+        from deepspeed_tpu.telemetry import step_anatomy
+        from deepspeed_tpu.telemetry.ledger import (
+            profiler_available, _start_trace, _stop_trace)
+        tcfg = self.config.telemetry
+        if not getattr(tcfg, "anatomy_enabled", True):
+            return {"enabled": False,
+                    "reason": "telemetry.anatomy.enabled is false"}
+        if not profiler_available():
+            return {"enabled": False,
+                    "reason": "jax.profiler programmatic capture "
+                              "unavailable"}
+        steps = int(steps if steps is not None
+                    else getattr(tcfg, "anatomy_capture_steps", 3))
+        if batch is None:
+            batch = self._last_batch
+        assert batch is not None, (
+            "profile_step before any train step needs an example batch: "
+            "pass batch=...")
+        if self.global_steps == 0:
+            # prime the compiled signature outside the window: the XLA
+            # compile would otherwise dominate (and distort) step 0
+            self.train_batch(batch=batch)
+        outdir = getattr(tcfg, "output_path", "") or "telemetry/"
+        trace_dir = os.path.join(outdir, "anatomy_profile")
+        os.makedirs(trace_dir, exist_ok=True)
+        try:
+            _start_trace(trace_dir)
+        except Exception as e:
+            return {"enabled": False,
+                    "reason": f"profiler start_trace failed: {e}"}
+        try:
+            from jax.profiler import TraceAnnotation
+            for i in range(steps):
+                with TraceAnnotation(step_anatomy.STEP_MARK, step=i):
+                    loss = self.train_batch(batch=batch)
+                    # block INSIDE the annotation so the device work of
+                    # this step lands inside its window
+                    jax.block_until_ready(loss)
+        finally:
+            try:
+                _stop_trace()
+            except Exception as e:
+                logger.warning("[anatomy] stop_trace failed: %s", e)
+        report = step_anatomy.summarize_capture(
+            trace_dir, **self._anatomy_join_inputs())
+        if report is None:
+            return {"enabled": False,
+                    "reason": f"profiler wrote no .xplane.pb under "
+                              f"{trace_dir}"}
+        report["enabled"] = True
+        report.setdefault("source", {})["global_step"] = self.global_steps
+        if write:
+            path = out or getattr(tcfg, "anatomy_report_file", "") \
+                or os.path.join(outdir, "STEP_ANATOMY.json")
+            step_anatomy.write_report(report, path)
+            report["report_path"] = path
+        self._export_anatomy_lanes(report, trace_dir, outdir)
+        # cap retained raw trace runs (the summary JSON survives)
+        keep = int(getattr(tcfg, "anatomy_keep_raw_traces", 2))
+        runs = sorted(
+            (r for r in glob.glob(os.path.join(
+                trace_dir, "plugins", "profile", "*")) if os.path.isdir(r)),
+            key=os.path.getmtime, reverse=True)
+        for stale in runs[keep:]:
+            shutil.rmtree(stale, ignore_errors=True)
+        return report
+
+    def _anatomy_join_inputs(self):
+        """The engine-owned join inputs for a step-anatomy capture: HLO
+        op table + bucket names + roofline floors + census collective
+        schedule. Everything is best-effort and NEVER compiles — a
+        missing artifact just degrades attribution to name heuristics."""
+        op_table = None
+        schedule = None
+        try:
+            aot = (self._aot_step_for("fused_train_step")
+                   or self._aot_step_for("micro_step"))
+            if aot is not None and aot.compiled is not None:
+                from deepspeed_tpu.telemetry import step_anatomy
+                from deepspeed_tpu.telemetry.hlo_census import (
+                    collective_schedule_positions)
+                hlo_text = aot.compiled.as_text()
+                op_table = step_anatomy.hlo_op_table(hlo_text)
+                schedule = collective_schedule_positions(hlo_text)
+        except Exception as e:
+            logger.warning("[anatomy] HLO op-table join unavailable: %s", e)
+        floors = None
+        try:
+            if self._cost_census is not None:
+                floors = self.explain_step().get("bound_floors_s")
+        except Exception as e:
+            logger.warning("[anatomy] roofline floors unavailable: %s", e)
+        buckets = (list(self._health_spec.names)
+                   if self._health_spec is not None else None)
+        return {"op_table": op_table, "bucket_names": buckets,
+                "predicted_floors": floors,
+                "schedule_positions": schedule}
+
+    def _export_anatomy_lanes(self, report, trace_dir, outdir):
+        """Merge the capture's per-device lanes into the Chrome trace
+        (tracer spans + device lanes, via fleet.merge_traces) when span
+        tracing is on. Best-effort: a merge failure only costs the
+        merged view, never the report."""
+        tel = self.telemetry
+        if not (tel.enabled and getattr(tel, "tracer", None) is not None
+                and tel.tracer.enabled):
+            return
+        try:
+            from deepspeed_tpu.telemetry import step_anatomy, xplane
+            from deepspeed_tpu.telemetry.fleet import merge_traces
+            files = xplane.find_xplane_files(trace_dir)
+            if not files:
+                return
+            _, lanes = step_anatomy.extract_events(
+                xplane.parse_xspace_file(files[0]))
+            if not lanes:
+                return
+            dev_path = os.path.join(outdir, "anatomy_device.trace.json")
+            step_anatomy.write_device_trace(dev_path, lanes)
+            host_path = tel.tracer.export(
+                os.path.join(outdir, "anatomy_host.trace.json"))
+            merged = merge_traces(
+                os.path.join(outdir, "anatomy_merged.trace.json"),
+                [host_path, dev_path])
+            report["merged_trace"] = merged
+        except Exception as e:
+            logger.warning("[anatomy] device-lane trace merge failed: %s",
+                           e)
 
     # --------------------------------------------------- fleet recorder
     def _resolve_desync(self):

@@ -20,6 +20,7 @@ one decode program across a heterogeneous trace".
 """
 
 import dataclasses
+import os
 import time
 from typing import List, Optional
 
@@ -728,6 +729,63 @@ class ServingEngine:
             return report
         return {"schema": SERVING_HEALTH_SCHEMA, "enabled": False,
                 "engine_state": self._engine_state()}
+
+    def profile_window(self, steps=3, out=None, write=True):
+        """Measured device-time anatomy for *steps* scheduler
+        iterations — the serving analog of ``engine.profile_step``.
+
+        Runs a bounded ``jax.profiler`` capture around N annotated
+        ``step()`` calls (blocking on the KV pools inside each
+        annotation so device work lands in-window), post-processes the
+        trace with the xplane parser and writes the schema-pinned
+        report (default ``telemetry/STEP_ANATOMY.serving.json``).
+        Inert (``{"enabled": False}``) when the profiler is
+        unavailable or ``DS_TELEMETRY_ANATOMY=0``."""
+        import jax
+        from deepspeed_tpu.telemetry import step_anatomy
+        from deepspeed_tpu.telemetry.ledger import (
+            profiler_available, _start_trace, _stop_trace)
+        env = os.environ.get("DS_TELEMETRY_ANATOMY")
+        if env is not None and env.lower() not in ("1", "true", "yes",
+                                                   "on"):
+            return {"enabled": False,
+                    "reason": "DS_TELEMETRY_ANATOMY disabled"}
+        if not profiler_available():
+            return {"enabled": False,
+                    "reason": "jax.profiler programmatic capture "
+                              "unavailable"}
+        outdir = os.path.dirname(out) if out else "telemetry/"
+        trace_dir = os.path.join(outdir or ".", "anatomy_profile_serving")
+        os.makedirs(trace_dir, exist_ok=True)
+        try:
+            _start_trace(trace_dir)
+        except Exception as e:
+            return {"enabled": False,
+                    "reason": f"profiler start_trace failed: {e}"}
+        try:
+            from jax.profiler import TraceAnnotation
+            for i in range(int(steps)):
+                with TraceAnnotation(step_anatomy.STEP_MARK, step=i):
+                    self.step()
+                    jax.block_until_ready(self.pools)
+        finally:
+            try:
+                _stop_trace()
+            except Exception:
+                pass
+        report = step_anatomy.summarize_capture(trace_dir)
+        if report is None:
+            return {"enabled": False,
+                    "reason": f"profiler wrote no .xplane.pb under "
+                              f"{trace_dir}"}
+        report["enabled"] = True
+        report.setdefault("source", {})["surface"] = "serving"
+        if write:
+            path = out or os.path.join(
+                outdir or ".", "STEP_ANATOMY.serving.json")
+            step_anatomy.write_report(report, path)
+            report["report_path"] = path
+        return report
 
     def close(self):
         """Teardown: force the observatory's final forensics snapshot.

@@ -34,6 +34,16 @@ Four pieces (see the per-module docstrings):
   (cross-replica parameter checksums), escalating to
   FLEET_HEALTH.json; ``merge_traces`` joins per-rank Chrome traces into
   per-rank process lanes (``python -m deepspeed_tpu.telemetry.fleet``);
+* ``xplane`` / ``step_anatomy`` — measured device-time attribution:
+  a dependency-free wire-format parser for the XSpace protobuf
+  ``jax.profiler`` writes, and the StepAnatomy join (per-op device
+  seconds -> categories/modules vs the CostExplorer roofline) behind
+  ``engine.profile_step`` / ``ServingEngine.profile_window`` ->
+  STEP_ANATOMY.json (``python -m deepspeed_tpu.telemetry.step_anatomy``
+  is the CLI). Deliberately NOT imported here: the parser only loads
+  when a capture is post-processed (lazy ``__getattr__`` below), so
+  engine init never pays for it — tests/perf/telemetry_overhead.py
+  pins that;
 * ``bench_diff`` — bench-regression differ over committed BENCH_r*.json
   rounds (``python -m deepspeed_tpu.telemetry.bench_diff`` exits
   non-zero past the regression threshold).
@@ -92,4 +102,15 @@ __all__ = [
     "FleetMonitor", "FleetShipper", "build_desync_checksum_fn",
     "get_shipper", "merge_traces", "set_shipper",
     "get_manager", "set_manager",
+    "xplane", "step_anatomy",
 ]
+
+
+def __getattr__(name):
+    # lazy submodule access (PEP 562): telemetry.xplane / .step_anatomy
+    # stay un-imported until a capture is actually post-processed
+    if name in ("xplane", "step_anatomy"):
+        import importlib
+        return importlib.import_module(f"deepspeed_tpu.telemetry.{name}")
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")

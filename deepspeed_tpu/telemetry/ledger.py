@@ -47,8 +47,10 @@ sleep into the data iterator and writes the resulting ledger (the
 committed repo-root ``GOODPUT.json`` example).
 """
 
+import glob
 import json
 import os
+import shutil
 import threading
 import time
 from collections import deque
@@ -206,6 +208,7 @@ class GoodputLedger:
                  warmup_windows=1, window_ring=128,
                  profiler_capture=True, profiler_capture_steps=5,
                  profiler_max_captures=1, profiler_dir="goodput_profile",
+                 keep_raw_traces=2,
                  registry=None, on_escalate=None, on_anomaly=None,
                  log_fn=None):
         self.enabled = bool(enabled)
@@ -219,6 +222,7 @@ class GoodputLedger:
         self.profiler_capture_steps = int(profiler_capture_steps)
         self.profiler_max_captures = int(profiler_max_captures)
         self.profiler_dir = profiler_dir
+        self.keep_raw_traces = int(keep_raw_traces)
         self.registry = registry
         self.on_escalate = on_escalate
         self.on_anomaly = on_anomaly
@@ -252,6 +256,8 @@ class GoodputLedger:
         self._captures_done = 0
         self._capture_stop_after = -1
         self._capture_warned = False
+        self._last_capture_report = None
+        self._last_capture_top = None
 
     @classmethod
     def from_config(cls, tconfig, output_path="telemetry/", job_name="",
@@ -282,6 +288,7 @@ class GoodputLedger:
             profiler_max_captures=getattr(
                 tconfig, "goodput_profiler_max_captures", 1),
             profiler_dir=pdir,
+            keep_raw_traces=getattr(tconfig, "anatomy_keep_raw_traces", 2),
             registry=registry, on_escalate=on_escalate,
             on_anomaly=on_anomaly)
 
@@ -568,6 +575,50 @@ class GoodputLedger:
             _stop_trace()
         except Exception as e:
             logger.warning("[goodput] stop_trace failed: %s", e)
+            return
+        self._postprocess_capture()
+
+    def _postprocess_capture(self):
+        """Raw trace dirs used to dead-end on disk (write-only: nothing
+        in the repo could read them back). Post-process the capture into
+        an attributed step-anatomy summary, reference it from the
+        escalation entry that triggered it, and cap retained raw dirs."""
+        try:
+            from deepspeed_tpu.telemetry import step_anatomy
+            report = step_anatomy.summarize_capture(self.profiler_dir)
+            if report is not None:
+                path = os.path.join(self.profiler_dir,
+                                    "CAPTURE_ANATOMY.json")
+                step_anatomy.write_report(report, path)
+                cats = {c: s for c, s in
+                        (report.get("categories_s") or {}).items()
+                        if c != "idle_gap"}
+                top = max(cats, key=cats.get) if any(
+                    v > 0 for v in cats.values()) else None
+                self._last_capture_report = path
+                self._last_capture_top = top
+                if self.anomalies:
+                    # the newest anomaly is the one whose escalation
+                    # started this capture (captures are 1-at-a-time)
+                    self.anomalies[-1]["capture_report"] = path
+                    self.anomalies[-1]["capture_top_category"] = top
+                self._log("[goodput] capture post-processed -> %s "
+                          "(top device category: %s)", path, top)
+                self.write_snapshot(force=True)
+            self._prune_raw_traces()
+        except Exception as e:   # forensics must never kill a step
+            logger.warning("[goodput] capture post-process failed: %s", e)
+
+    def _prune_raw_traces(self, keep=None):
+        """Delete all but the newest *keep* raw profiler run dirs under
+        ``profiler_dir/plugins/profile/`` (the summary JSON survives)."""
+        keep = self.keep_raw_traces if keep is None else int(keep)
+        runs = glob.glob(os.path.join(
+            self.profiler_dir, "plugins", "profile", "*"))
+        runs = [r for r in runs if os.path.isdir(r)]
+        runs.sort(key=os.path.getmtime, reverse=True)
+        for stale in runs[keep:]:
+            shutil.rmtree(stale, ignore_errors=True)
 
     # --------------------------------------------------------------- outputs
     def verdict(self, totals=None, elapsed=None):
@@ -648,6 +699,8 @@ class GoodputLedger:
                 "capture_steps": self.profiler_capture_steps,
                 "max_captures": self.profiler_max_captures,
                 "dir": self.profiler_dir,
+                "last_capture_report": self._last_capture_report,
+                "last_capture_top_category": self._last_capture_top,
             },
             "anomalies": list(self.anomalies),
             "windows": list(self.ring),

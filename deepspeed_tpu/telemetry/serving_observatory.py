@@ -91,9 +91,9 @@ RULE_SEVERITY = {
 }
 _SEVERITY_ORDER = ("critical", "warning", "watch")
 
-# synthetic Chrome-trace lane ids: far above any real thread id the
-# tracer's own host spans use, so the slot lanes group cleanly
-_LANE_TID_BASE = 1_000_000
+# synthetic Chrome-trace lane tids come from the tracer's process-scoped
+# registry (tracer.allocate_lane_tid), so slot lanes can never collide
+# with fleet-rank or profiler device lanes in a merged trace
 
 
 def _flush_trace():
@@ -305,8 +305,9 @@ class ServingObservatory:
     # ----------------------------------------------------- Chrome lanes
     def _lane_tid(self, slot):
         # slot lanes 0..max_batch-1; the queue-wait lane sits after them
-        return _LANE_TID_BASE + (self.max_batch if slot is None
-                                 else int(slot))
+        from deepspeed_tpu.telemetry.tracer import allocate_lane_tid
+        return allocate_lane_tid(("serving", "queue" if slot is None
+                                  else int(slot)))
 
     def _name_lanes(self, tracer):
         """One-time thread_name metadata so the lanes read as

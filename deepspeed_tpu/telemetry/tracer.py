@@ -165,6 +165,42 @@ class Tracer:
         return path
 
 
+# ---------------------------------------------------------------- lane tids
+#
+# Synthetic trace lanes (serving slots, fleet ranks, profiler device
+# lanes) need tids that cannot collide with real thread idents or with
+# each other — two subsystems both hard-coding "base + index" produced
+# duplicate (pid, tid) pairs with conflicting thread_name metadata in
+# merged traces. One process-scoped registry hands out a stable tid per
+# lane key instead: the same key always maps to the same tid, distinct
+# keys never share one.
+
+_LANE_TID_BASE = 1_000_000
+_LANE_LOCK = threading.Lock()
+_LANE_TIDS = {}
+_LANE_NEXT = [_LANE_TID_BASE]
+
+
+def allocate_lane_tid(key):
+    """Return the process-unique synthetic tid for lane *key* (any
+    hashable; idempotent — repeated calls with the same key return the
+    same tid)."""
+    with _LANE_LOCK:
+        tid = _LANE_TIDS.get(key)
+        if tid is None:
+            tid = _LANE_NEXT[0]
+            _LANE_NEXT[0] += 1
+            _LANE_TIDS[key] = tid
+        return tid
+
+
+def _reset_lane_tids():
+    """Test hook: forget all lane-tid assignments."""
+    with _LANE_LOCK:
+        _LANE_TIDS.clear()
+        _LANE_NEXT[0] = _LANE_TID_BASE
+
+
 # Module-level default tracer: DISABLED until a TelemetryManager (or a
 # test) installs an enabled one. Library code (engine, checkpoint_io)
 # calls ``trace_span`` unconditionally; the cost without telemetry is one

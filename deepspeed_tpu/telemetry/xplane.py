@@ -1,0 +1,364 @@
+"""Dependency-free XSpace/XPlane protobuf reader.
+
+``jax.profiler.stop_trace`` serialises a ``tensorflow.profiler.XSpace``
+protobuf to ``<logdir>/plugins/profile/<run>/<host>.xplane.pb``.  Reading
+it back normally requires tensorflow or tensorboard-plugin-profile; this
+module instead decodes the protobuf *wire format* by hand (varint +
+length-delimited scanning, same house style as the HLO-text parser in
+``hlo_census``) so the repo can post-process its own traces with zero
+extra dependencies.
+
+It intentionally imports neither ``tensorflow`` nor ``tensorboard`` (a
+static guard in ``tests/perf/telemetry_overhead.py`` pins this).
+
+Field numbers (stable since the schema is append-only upstream):
+
+    XSpace:         planes=1 errors=2 warnings=3 hostnames=4
+    XPlane:         id=1 name=2 lines=3 event_metadata=4 (map)
+                    stat_metadata=5 (map) stats=6
+    XLine:          id=1 name=2 timestamp_ns=3 events=4 duration_ps=9
+                    display_id=10 display_name=11
+    XEvent:         metadata_id=1 offset_ps=2 duration_ps=3 stats=4
+                    num_occurrences=5 (oneof with offset_ps)
+    XStat:          metadata_id=1 double=2 uint64=3 int64=4 str=5
+                    bytes=6 ref=7
+    XEventMetadata: id=1 name=2 metadata=3 display_name=4 stats=5
+                    child_id=6
+    XStatMetadata:  id=1 name=2 description=3
+
+Proto map entries are repeated messages with key=1, value=2.
+"""
+
+import glob
+import os
+import struct
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple, Union
+
+__all__ = [
+    "XplaneParseError",
+    "XStat",
+    "XEvent",
+    "XLine",
+    "XPlane",
+    "XSpace",
+    "parse_xspace",
+    "parse_xspace_file",
+    "find_xplane_files",
+]
+
+
+class XplaneParseError(ValueError):
+    """Raised when the wire stream is malformed or truncated.
+
+    The message always names the absolute byte offset at which decoding
+    failed so a corrupt capture can be triaged with a hex dump.
+    """
+
+
+# ---------------------------------------------------------------------------
+# wire-format primitives
+# ---------------------------------------------------------------------------
+
+_WIRE_VARINT = 0
+_WIRE_64BIT = 1
+_WIRE_LEN = 2
+_WIRE_32BIT = 5
+
+
+def _read_varint(buf: bytes, pos: int, end: int) -> Tuple[int, int]:
+    """Decode one base-128 varint; returns (value, new_pos)."""
+    result = 0
+    shift = 0
+    start = pos
+    while True:
+        if pos >= end:
+            raise XplaneParseError(
+                f"truncated varint at byte offset {start}")
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            return result, pos
+        shift += 7
+        if shift > 63:
+            raise XplaneParseError(
+                f"varint wider than 64 bits at byte offset {start}")
+
+
+def _zigzag_signed(value: int) -> int:
+    """Reinterpret a 64-bit varint as two's-complement int64.
+
+    (int64 fields are NOT zigzag on the wire — negative values are sent
+    as 10-byte two's-complement varints.)
+    """
+    if value >= 1 << 63:
+        value -= 1 << 64
+    return value
+
+
+def _iter_fields(buf: bytes, pos: int, end: int):
+    """Yield (field_number, wire_type, payload, value_offset) tuples.
+
+    ``payload`` is an int for varint fields, a memoryview-compatible
+    bytes slice for length-delimited / fixed fields.
+    """
+    while pos < end:
+        key, pos = _read_varint(buf, pos, end)
+        field_no = key >> 3
+        wire = key & 0x7
+        if field_no == 0:
+            raise XplaneParseError(
+                f"illegal field number 0 at byte offset {pos}")
+        if wire == _WIRE_VARINT:
+            val, pos = _read_varint(buf, pos, end)
+            yield field_no, wire, val, pos
+        elif wire == _WIRE_LEN:
+            length, pos = _read_varint(buf, pos, end)
+            if pos + length > end:
+                raise XplaneParseError(
+                    f"length-delimited field overruns buffer at byte "
+                    f"offset {pos} (need {length} bytes, have {end - pos})")
+            yield field_no, wire, (pos, pos + length), pos
+            pos += length
+        elif wire == _WIRE_64BIT:
+            if pos + 8 > end:
+                raise XplaneParseError(
+                    f"truncated fixed64 at byte offset {pos}")
+            yield field_no, wire, buf[pos:pos + 8], pos
+            pos += 8
+        elif wire == _WIRE_32BIT:
+            if pos + 4 > end:
+                raise XplaneParseError(
+                    f"truncated fixed32 at byte offset {pos}")
+            yield field_no, wire, buf[pos:pos + 4], pos
+            pos += 4
+        else:
+            raise XplaneParseError(
+                f"unsupported wire type {wire} at byte offset {pos}")
+
+
+# ---------------------------------------------------------------------------
+# decoded model
+# ---------------------------------------------------------------------------
+
+@dataclass
+class XStat:
+    metadata_id: int = 0
+    value: Union[int, float, str, bytes, None] = None
+    # for ref_value stats the value is the *referenced stat-metadata name*
+    is_ref: bool = False
+
+
+@dataclass
+class XEvent:
+    metadata_id: int = 0
+    offset_ps: int = 0
+    duration_ps: int = 0
+    num_occurrences: int = 0
+    stats: List[XStat] = field(default_factory=list)
+
+
+@dataclass
+class XLine:
+    id: int = 0
+    name: str = ""
+    display_name: str = ""
+    timestamp_ns: int = 0
+    duration_ps: int = 0
+    events: List[XEvent] = field(default_factory=list)
+
+
+@dataclass
+class XPlane:
+    id: int = 0
+    name: str = ""
+    lines: List[XLine] = field(default_factory=list)
+    event_metadata: Dict[int, dict] = field(default_factory=dict)
+    stat_metadata: Dict[int, str] = field(default_factory=dict)
+    stats: List[XStat] = field(default_factory=list)
+
+    def event_name(self, event: XEvent) -> str:
+        md = self.event_metadata.get(event.metadata_id)
+        return md["name"] if md else ""
+
+    def event_stats(self, event: XEvent) -> Dict[str, object]:
+        """Resolve an event's stats to {stat_name: python value}."""
+        out = {}
+        for st in event.stats:
+            name = self.stat_metadata.get(st.metadata_id, "")
+            if not name:
+                continue
+            if st.is_ref and isinstance(st.value, int):
+                out[name] = self.stat_metadata.get(st.value, "")
+            else:
+                out[name] = st.value
+        return out
+
+
+@dataclass
+class XSpace:
+    planes: List[XPlane] = field(default_factory=list)
+    hostnames: List[str] = field(default_factory=list)
+    errors: List[str] = field(default_factory=list)
+    warnings: List[str] = field(default_factory=list)
+
+    def find_plane(self, name: str) -> Optional[XPlane]:
+        for p in self.planes:
+            if p.name == name:
+                return p
+        return None
+
+
+# ---------------------------------------------------------------------------
+# message decoders
+# ---------------------------------------------------------------------------
+
+def _decode_str(buf: bytes, span: Tuple[int, int], where: str) -> str:
+    try:
+        return bytes(buf[span[0]:span[1]]).decode("utf-8", "replace")
+    except Exception as exc:  # pragma: no cover - decode("replace") is total
+        raise XplaneParseError(
+            f"undecodable {where} string at byte offset {span[0]}: {exc}")
+
+
+def _decode_stat(buf: bytes, span: Tuple[int, int]) -> XStat:
+    stat = XStat()
+    for fno, wire, payload, off in _iter_fields(buf, span[0], span[1]):
+        if fno == 1 and wire == _WIRE_VARINT:
+            stat.metadata_id = payload
+        elif fno == 2 and wire == _WIRE_64BIT:
+            stat.value = struct.unpack("<d", payload)[0]
+        elif fno == 3 and wire == _WIRE_VARINT:
+            stat.value = payload
+        elif fno == 4 and wire == _WIRE_VARINT:
+            stat.value = _zigzag_signed(payload)
+        elif fno == 5 and wire == _WIRE_LEN:
+            stat.value = _decode_str(buf, payload, "stat")
+        elif fno == 6 and wire == _WIRE_LEN:
+            stat.value = bytes(buf[payload[0]:payload[1]])
+        elif fno == 7 and wire == _WIRE_VARINT:
+            stat.value = payload
+            stat.is_ref = True
+    return stat
+
+
+def _decode_event(buf: bytes, span: Tuple[int, int]) -> XEvent:
+    ev = XEvent()
+    for fno, wire, payload, off in _iter_fields(buf, span[0], span[1]):
+        if fno == 1 and wire == _WIRE_VARINT:
+            ev.metadata_id = payload
+        elif fno == 2 and wire == _WIRE_VARINT:
+            ev.offset_ps = _zigzag_signed(payload)
+        elif fno == 3 and wire == _WIRE_VARINT:
+            ev.duration_ps = _zigzag_signed(payload)
+        elif fno == 4 and wire == _WIRE_LEN:
+            ev.stats.append(_decode_stat(buf, payload))
+        elif fno == 5 and wire == _WIRE_VARINT:
+            ev.num_occurrences = _zigzag_signed(payload)
+    return ev
+
+
+def _decode_line(buf: bytes, span: Tuple[int, int]) -> XLine:
+    line = XLine()
+    for fno, wire, payload, off in _iter_fields(buf, span[0], span[1]):
+        if fno == 1 and wire == _WIRE_VARINT:
+            line.id = _zigzag_signed(payload)
+        elif fno == 2 and wire == _WIRE_LEN:
+            line.name = _decode_str(buf, payload, "line name")
+        elif fno == 3 and wire == _WIRE_VARINT:
+            line.timestamp_ns = _zigzag_signed(payload)
+        elif fno == 4 and wire == _WIRE_LEN:
+            line.events.append(_decode_event(buf, payload))
+        elif fno == 9 and wire == _WIRE_VARINT:
+            line.duration_ps = _zigzag_signed(payload)
+        elif fno == 11 and wire == _WIRE_LEN:
+            line.display_name = _decode_str(buf, payload, "display name")
+    return line
+
+
+def _decode_event_metadata(buf: bytes, span: Tuple[int, int]) -> dict:
+    md = {"id": 0, "name": "", "display_name": ""}
+    for fno, wire, payload, off in _iter_fields(buf, span[0], span[1]):
+        if fno == 1 and wire == _WIRE_VARINT:
+            md["id"] = _zigzag_signed(payload)
+        elif fno == 2 and wire == _WIRE_LEN:
+            md["name"] = _decode_str(buf, payload, "event metadata name")
+        elif fno == 4 and wire == _WIRE_LEN:
+            md["display_name"] = _decode_str(buf, payload, "display name")
+    return md
+
+
+def _decode_map_entry(buf: bytes, span: Tuple[int, int]):
+    """Proto map entry: key=1 (varint here), value=2 (message span)."""
+    key = 0
+    value_span = None
+    for fno, wire, payload, off in _iter_fields(buf, span[0], span[1]):
+        if fno == 1 and wire == _WIRE_VARINT:
+            key = _zigzag_signed(payload)
+        elif fno == 2 and wire == _WIRE_LEN:
+            value_span = payload
+    return key, value_span
+
+
+def _decode_plane(buf: bytes, span: Tuple[int, int]) -> XPlane:
+    plane = XPlane()
+    for fno, wire, payload, off in _iter_fields(buf, span[0], span[1]):
+        if fno == 1 and wire == _WIRE_VARINT:
+            plane.id = _zigzag_signed(payload)
+        elif fno == 2 and wire == _WIRE_LEN:
+            plane.name = _decode_str(buf, payload, "plane name")
+        elif fno == 3 and wire == _WIRE_LEN:
+            plane.lines.append(_decode_line(buf, payload))
+        elif fno == 4 and wire == _WIRE_LEN:
+            key, vspan = _decode_map_entry(buf, payload)
+            if vspan is not None:
+                plane.event_metadata[key] = _decode_event_metadata(buf, vspan)
+        elif fno == 5 and wire == _WIRE_LEN:
+            key, vspan = _decode_map_entry(buf, payload)
+            if vspan is not None:
+                name = ""
+                for f2, w2, p2, _ in _iter_fields(buf, vspan[0], vspan[1]):
+                    if f2 == 2 and w2 == _WIRE_LEN:
+                        name = _decode_str(buf, p2, "stat metadata name")
+                plane.stat_metadata[key] = name
+        elif fno == 6 and wire == _WIRE_LEN:
+            plane.stats.append(_decode_stat(buf, payload))
+    return plane
+
+
+def parse_xspace(data: bytes) -> XSpace:
+    """Decode a serialized XSpace protobuf from memory."""
+    space = XSpace()
+    for fno, wire, payload, off in _iter_fields(data, 0, len(data)):
+        if fno == 1 and wire == _WIRE_LEN:
+            space.planes.append(_decode_plane(data, payload))
+        elif fno == 2 and wire == _WIRE_LEN:
+            space.errors.append(_decode_str(data, payload, "error"))
+        elif fno == 3 and wire == _WIRE_LEN:
+            space.warnings.append(_decode_str(data, payload, "warning"))
+        elif fno == 4 and wire == _WIRE_LEN:
+            space.hostnames.append(_decode_str(data, payload, "hostname"))
+    return space
+
+
+def parse_xspace_file(path: str) -> XSpace:
+    with open(path, "rb") as f:
+        return parse_xspace(f.read())
+
+
+def find_xplane_files(logdir: str) -> List[str]:
+    """Locate ``.xplane.pb`` files under a profiler logdir.
+
+    ``jax.profiler.stop_trace`` writes
+    ``<logdir>/plugins/profile/<run>/<host>.xplane.pb``; bare files
+    directly under ``logdir`` are accepted too (test fixtures).  Newest
+    run first.
+    """
+    hits = sorted(
+        glob.glob(os.path.join(logdir, "plugins", "profile",
+                               "*", "*.xplane.pb")),
+        key=os.path.getmtime, reverse=True)
+    hits += sorted(glob.glob(os.path.join(logdir, "*.xplane.pb")),
+                   key=os.path.getmtime, reverse=True)
+    return hits

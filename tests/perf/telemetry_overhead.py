@@ -44,6 +44,13 @@ Asserts:
   from a background writer that never touches the device, the ledger
   still sums to elapsed, and the DISABLED shipper's note/attribute
   surfaces fit the <2 µs budget;
+* ``telemetry.anatomy`` (step-anatomy profiler): engine init never
+  imports the xplane parser or the anatomy join (lazy PEP 562 access
+  only — pinned both statically over telemetry/__init__.py and live via
+  sys.modules after a full engine build), a run that never calls
+  ``profile_step`` carries no anatomy state, and ``profile_step`` itself
+  adds ZERO new train-step signatures (the capture reuses the primed
+  dispatch);
 * ``guardian``: an ARMED guardian with no anomalies is free — a 20-step
   run with guardian + health on still compiles the train step exactly
   ONCE (the guardian owns zero compiled programs, statically guarded:
@@ -626,6 +633,63 @@ def check_fleet_no_device_access():
           "demo and the traced desync builder)")
 
 
+def check_anatomy_inert(steps=5):
+    """ISSUE-15 acceptance guard: the step-anatomy profiler is free
+    until asked for. Statically, telemetry/__init__.py must not import
+    xplane/step_anatomy at module level; live, a full engine build plus
+    a training run must leave both modules out of sys.modules; and when
+    ``profile_step`` IS invoked, the capture reuses the primed train-step
+    dispatch — zero new compiled signatures, zero backend compiles."""
+    import ast
+
+    import deepspeed_tpu.telemetry as tel_mod
+    with open(tel_mod.__file__) as f:
+        tree = ast.parse(f.read())
+    offenders = []
+    for node in tree.body:
+        mods = []
+        if isinstance(node, ast.Import):
+            mods = [a.name for a in node.names]
+        elif isinstance(node, ast.ImportFrom):
+            mods = [node.module or ""]
+        offenders += [m for m in mods if m.endswith(".xplane")
+                      or m.endswith(".step_anatomy")]
+    assert not offenders, (
+        f"telemetry/__init__.py eagerly imports {offenders} — the "
+        f"anatomy stack must load only when a capture is post-processed")
+
+    for mod in ("deepspeed_tpu.telemetry.xplane",
+                "deepspeed_tpu.telemetry.step_anatomy"):
+        sys.modules.pop(mod, None)
+    engine, batch = _tiny_engine(ce_enabled=True, health_enabled=True)
+    for _ in range(steps):
+        engine.train_batch(batch=batch)
+    for mod in ("deepspeed_tpu.telemetry.xplane",
+                "deepspeed_tpu.telemetry.step_anatomy"):
+        assert mod not in sys.modules, (
+            f"{mod} was imported during engine init/steps — the disabled "
+            f"anatomy path must never load the parser")
+
+    from deepspeed_tpu.telemetry.ledger import profiler_available
+    if not profiler_available():
+        print("anatomy path: lazy imports pinned; profiler unavailable, "
+              "skipping the capture-compile check")
+        return
+    before = _backend_compiles(engine)
+    report = engine.profile_step(2, batch=batch)
+    after = _backend_compiles(engine)
+    assert report.get("enabled") is True, report.get("reason")
+    assert after == before, (
+        f"profile_step added {int(after - before)} backend compiles — "
+        f"the capture must reuse the primed step signature")
+    wall = report["device_wall_s"]
+    total = sum(report["categories_s"].values())
+    assert wall > 0 and abs(total - wall) <= 0.01 * wall
+    print(f"anatomy path: lazy imports pinned, 0 extra compiles across a "
+          f"2-step capture, categories sum to wall "
+          f"({total * 1e3:.2f} / {wall * 1e3:.2f} ms)")
+
+
 def check_guardian_armed_zero_overhead(steps=20, cadence=5):
     """ISSUE-13 acceptance guard: guardian ARMED (with health feeding
     it) on a healthy run — still exactly ONE train-step compile over 20
@@ -746,6 +810,7 @@ def main(iters=200_000):
     check_fleet_no_device_access()
     check_fleet_zero_extra_compiles()
     check_fleet_disabled_inert()
+    check_anatomy_inert()
     check_guardian_armed_zero_overhead()
     check_guardian_disabled_inert()
     print("OK")

@@ -538,8 +538,8 @@ def test_e2e_trace_lanes_exported(obs_serving):
     """With the PR-1 tracer live, the observatory exports per-slot lanes:
     named synthetic tids carrying prefill/decode spans and lifecycle
     instants."""
-    from deepspeed_tpu.telemetry.serving_observatory import _LANE_TID_BASE
-    from deepspeed_tpu.telemetry.tracer import Tracer, set_tracer
+    from deepspeed_tpu.telemetry.tracer import (_LANE_TID_BASE, Tracer,
+                                                set_tracer)
     cfg, eng, tmp = obs_serving
     tracer = Tracer(enabled=True)
     old = set_tracer(tracer)
