@@ -27,6 +27,10 @@ import numpy as np
 from deepspeed_tpu.runtime.zero.partition import estimate_zero_mem
 from deepspeed_tpu.utils.logging import logger
 
+# warn-once latch for the host-RSS budget refusal in
+# Autotuner._detect_device_memory (a staticmethod, so module state)
+_WARNED_HOST_BUDGET = False
+
 
 class BaseTuner:
     """Experiment-ordering policy (reference index_based_tuner.py)."""
@@ -172,20 +176,29 @@ class Autotuner:
         pre-flight uses (``cost_explorer.device_hbm_bytes``: allocator
         ``bytes_limit``, else the chip peak table) so stage pruning and
         the HBM watermark pre-flight agree on the budget, then the
-        telemetry registry's host-RSS fallback
-        (``metrics.device_memory_stats``) for CPU/virtual meshes — a
-        lower bound of the host budget, better than a made-up constant;
-        runs that care (tests, benches) pass an explicit budget."""
+        telemetry registry's ``device_memory_stats`` — but only when
+        its source is a real device backend: the host-RSS fallbacks are
+        REFUSED (warn-once), because pruning ZeRO stages against process
+        RSS would accept configs a real chip rejects. CPU/virtual
+        meshes fall to the 16 GiB default; runs that care (tests,
+        benches) pass an explicit budget."""
+        global _WARNED_HOST_BUDGET
         from deepspeed_tpu.telemetry.cost_explorer import device_hbm_bytes
         from deepspeed_tpu.telemetry.metrics import device_memory_stats
         hbm = device_hbm_bytes()
         if hbm:
             return int(hbm)
         stats = device_memory_stats()
-        for key in ("bytes_limit", "host_rss_bytes",
-                    "host_peak_rss_bytes"):
-            if stats.get(key):
-                return int(stats[key])
+        if stats.get("source") == "device" and stats.get("bytes_limit"):
+            return int(stats["bytes_limit"])
+        if stats.get("source", "").startswith("host") and \
+                not _WARNED_HOST_BUDGET:
+            _WARNED_HOST_BUDGET = True
+            logger.warning(
+                "[autotuning] device-memory detection found only %s — "
+                "refusing to treat host RSS as an HBM budget; using the "
+                "16 GiB default (pass device_memory_bytes explicitly to "
+                "override)", stats["source"])
         return 16 << 30
 
     # ------------------------------------------------------------- pruning

@@ -564,6 +564,20 @@ class GoodputTuner:
                 "artifact_reused": reused,
                 "aot_fallback_calls": fallbacks,
             }
+            # measured residency (memory observatory armed via the trial
+            # config / DS_TELEMETRY_MEMORY): record the measured peak and
+            # its drift against THIS candidate's stage-1 watermark, so
+            # "hbm" rejections become calibratable against real bytes
+            mem = engine._memory
+            if mem is not None:
+                engine._memory_tick(force=True)
+                if mem.measured_peak_bytes:
+                    cand.probe["hbm_peak_bytes"] = mem.measured_peak_bytes
+                    if cand.hbm_watermark_bytes:
+                        n_dev = len(jax.local_devices())
+                        cand.probe["watermark_drift"] = round(
+                            mem.measured_peak_bytes
+                            / (cand.hbm_watermark_bytes * n_dev) - 1.0, 4)
             cand.status = "probed"
             logger.info(
                 "[autotune] probe %d %s: step %.2f ms, goodput %.3f -> "

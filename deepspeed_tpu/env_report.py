@@ -135,6 +135,18 @@ def telemetry_report():
     except Exception:
         row("step anatomy (xplane parser)", False)
     try:
+        from deepspeed_tpu.telemetry.pprof import parse_profile
+        del parse_profile
+        import jax.profiler as _jp
+        ok = hasattr(_jp, "device_memory_profile")
+        row("memory observatory (pprof)", ok,
+            "(telemetry.memory block; DS_TELEMETRY_MEMORY=1; "
+            "engine.memory_report -> MEMORY_ANATOMY.json; "
+            "dependency-free pprof reader)"
+            if ok else "(jax.profiler.device_memory_profile missing)")
+    except Exception:
+        row("memory observatory (pprof)", False)
+    try:
         from jax import monitoring
         row("jax.monitoring listener",
             hasattr(monitoring, "register_event_duration_secs_listener"))

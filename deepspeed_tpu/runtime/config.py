@@ -94,8 +94,8 @@ class DeepSpeedTelemetryConfig(DeepSpeedConfigObject):
     Env overrides (sweep ergonomics, applied after JSON): ``DS_TELEMETRY``
     = 1/0 force-toggles ``enabled``; ``DS_TELEMETRY_DIR`` overrides
     ``output_path``; ``DS_COST_EXPLORER`` / ``DS_TELEMETRY_HEALTH`` /
-    ``DS_TELEMETRY_GOODPUT`` = 1/0 force-toggle the cost-explorer /
-    health / goodput sub-blocks."""
+    ``DS_TELEMETRY_GOODPUT`` / ``DS_TELEMETRY_MEMORY`` = 1/0 force-toggle
+    the cost-explorer / health / goodput / memory sub-blocks."""
 
     def __init__(self, param_dict):
         t = param_dict.get(C.TELEMETRY, {}) or {}
@@ -241,6 +241,32 @@ class DeepSpeedTelemetryConfig(DeepSpeedConfigObject):
                                           C.FLEET_SNAPSHOT_FILE_DEFAULT)
         self.fleet_background_ship = fl.get(
             C.FLEET_BACKGROUND_SHIP, C.FLEET_BACKGROUND_SHIP_DEFAULT)
+        # memory sub-block (telemetry/memory_observatory.py): HBM residency
+        # observatory — measured buffer attribution + leak/drift/frag/oom
+        # sentinels. Flattened onto memory_* attributes.
+        m = t.get(C.TELEMETRY_MEMORY, {}) or {}
+        self.memory_enabled = m.get(C.MEMORY_ENABLED,
+                                    C.MEMORY_ENABLED_DEFAULT)
+        self.memory_cadence = int(m.get(C.MEMORY_CADENCE,
+                                        C.MEMORY_CADENCE_DEFAULT))
+        self.memory_snapshot_file = m.get(C.MEMORY_SNAPSHOT_FILE,
+                                          C.MEMORY_SNAPSHOT_FILE_DEFAULT)
+        self.memory_report_file = m.get(C.MEMORY_REPORT_FILE,
+                                        C.MEMORY_REPORT_FILE_DEFAULT)
+        self.memory_leak_windows = int(m.get(
+            C.MEMORY_LEAK_WINDOWS, C.MEMORY_LEAK_WINDOWS_DEFAULT))
+        self.memory_warmup_windows = int(m.get(
+            C.MEMORY_WARMUP_WINDOWS, C.MEMORY_WARMUP_WINDOWS_DEFAULT))
+        self.memory_drift_threshold = float(m.get(
+            C.MEMORY_DRIFT_THRESHOLD, C.MEMORY_DRIFT_THRESHOLD_DEFAULT))
+        self.memory_frag_threshold = float(m.get(
+            C.MEMORY_FRAG_THRESHOLD, C.MEMORY_FRAG_THRESHOLD_DEFAULT))
+        self.memory_headroom = float(m.get(C.MEMORY_HEADROOM,
+                                           C.MEMORY_HEADROOM_DEFAULT))
+        self.memory_budget_bytes = int(m.get(
+            C.MEMORY_BUDGET_BYTES, C.MEMORY_BUDGET_BYTES_DEFAULT))
+        self.memory_ring_size = int(m.get(C.MEMORY_RING_SIZE,
+                                          C.MEMORY_RING_SIZE_DEFAULT))
         env = os.environ.get("DS_TELEMETRY")
         if env is not None:
             self.enabled = env.lower() in ("1", "true", "yes", "on")
@@ -272,6 +298,10 @@ class DeepSpeedTelemetryConfig(DeepSpeedConfigObject):
         env_fr = os.environ.get("DS_TELEMETRY_FLEET_RANK")
         if env_fr is not None:
             self.fleet_rank = int(env_fr)
+        env_m = os.environ.get("DS_TELEMETRY_MEMORY")
+        if env_m is not None:
+            self.memory_enabled = env_m.lower() in ("1", "true", "yes",
+                                                    "on")
         if self.anatomy_capture_steps < 1:
             raise DeepSpeedConfigError(
                 f"telemetry.anatomy.capture_steps must be >= 1, got "
@@ -302,6 +332,38 @@ class DeepSpeedTelemetryConfig(DeepSpeedConfigObject):
             raise DeepSpeedConfigError(
                 f"telemetry.fleet.window_ring must be >= 1, got "
                 f"{self.fleet_window_ring}")
+        if self.memory_cadence < 0:
+            raise DeepSpeedConfigError(
+                f"telemetry.memory.cadence must be >= 0, got "
+                f"{self.memory_cadence}")
+        if self.memory_leak_windows < 2:
+            raise DeepSpeedConfigError(
+                f"telemetry.memory.leak_windows must be >= 2, got "
+                f"{self.memory_leak_windows}")
+        if self.memory_warmup_windows < 0:
+            raise DeepSpeedConfigError(
+                f"telemetry.memory.warmup_windows must be >= 0, got "
+                f"{self.memory_warmup_windows}")
+        if not 0.0 < self.memory_drift_threshold:
+            raise DeepSpeedConfigError(
+                f"telemetry.memory.drift_threshold must be > 0, got "
+                f"{self.memory_drift_threshold}")
+        if not 0.0 < self.memory_frag_threshold <= 1.0:
+            raise DeepSpeedConfigError(
+                f"telemetry.memory.frag_threshold must be in (0, 1], got "
+                f"{self.memory_frag_threshold}")
+        if not 0.0 < self.memory_headroom <= 1.0:
+            raise DeepSpeedConfigError(
+                f"telemetry.memory.headroom must be in (0, 1], got "
+                f"{self.memory_headroom}")
+        if self.memory_budget_bytes < 0:
+            raise DeepSpeedConfigError(
+                f"telemetry.memory.budget_bytes must be >= 0, got "
+                f"{self.memory_budget_bytes}")
+        if self.memory_ring_size < 1:
+            raise DeepSpeedConfigError(
+                f"telemetry.memory.ring_size must be >= 1, got "
+                f"{self.memory_ring_size}")
 
 
 class DeepSpeedDataPrefetchConfig(DeepSpeedConfigObject):

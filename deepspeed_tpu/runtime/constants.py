@@ -258,6 +258,43 @@ FLEET_SNAPSHOT_FILE_DEFAULT = ""
 FLEET_BACKGROUND_SHIP = "background_ship"   # write records off-thread
 FLEET_BACKGROUND_SHIP_DEFAULT = True
 
+# telemetry.memory: HBM residency observatory (telemetry/memory_observatory
+# .py). At cadence the engine/serving tick fetches one
+# jax.profiler.device_memory_profile(), decodes it with the dependency-free
+# pprof parser, attributes every live buffer to
+# {params, optimizer_state, kv_pool, activations_workspace, other} (exact-sum
+# by construction; params/opt-state bucketed through build_bucket_spec), and
+# runs the residency sentinels — hbm_leak, watermark_drift (measured peak vs
+# the cost-explorer pre-flight, both directions), kv_fragmentation, and
+# oom_risk (critical; the budget is a real HBM limit only — host-RSS
+# fallbacks are refused). Escalation: warn-once -> throttled
+# MEMORY_HEALTH.json -> on_anomaly hook. engine.memory_report(write=True)
+# writes MEMORY_ANATOMY.json. DS_TELEMETRY_MEMORY=1/0 force-toggles
+# `enabled`.
+TELEMETRY_MEMORY = "memory"
+MEMORY_ENABLED = "enabled"
+MEMORY_ENABLED_DEFAULT = False
+MEMORY_CADENCE = "cadence"                  # windows every N steps; 0 -> steps_per_print
+MEMORY_CADENCE_DEFAULT = 0
+MEMORY_SNAPSHOT_FILE = "snapshot_file"      # "" -> <output_path>/MEMORY_HEALTH.json
+MEMORY_SNAPSHOT_FILE_DEFAULT = ""
+MEMORY_REPORT_FILE = "report_file"          # "" -> <output_path>/MEMORY_ANATOMY.json
+MEMORY_REPORT_FILE_DEFAULT = ""
+MEMORY_LEAK_WINDOWS = "leak_windows"        # monotone-growth windows before hbm_leak fires
+MEMORY_LEAK_WINDOWS_DEFAULT = 4
+MEMORY_WARMUP_WINDOWS = "warmup_windows"    # windows before the rules arm
+MEMORY_WARMUP_WINDOWS_DEFAULT = 2
+MEMORY_DRIFT_THRESHOLD = "drift_threshold"  # |measured/predicted - 1| that flags
+MEMORY_DRIFT_THRESHOLD_DEFAULT = 0.25
+MEMORY_FRAG_THRESHOLD = "frag_threshold"    # KV pool fragmentation that flags
+MEMORY_FRAG_THRESHOLD_DEFAULT = 0.5
+MEMORY_HEADROOM = "headroom"                # oom_risk fires above headroom x budget
+MEMORY_HEADROOM_DEFAULT = 0.92
+MEMORY_BUDGET_BYTES = "budget_bytes"        # 0 -> detect (device memory_stats only)
+MEMORY_BUDGET_BYTES_DEFAULT = 0
+MEMORY_RING_SIZE = "ring_size"              # live-bytes window ring buffer size
+MEMORY_RING_SIZE_DEFAULT = 64
+
 # Checkpoint
 CHECKPOINT = "checkpoint"
 CHECKPOINT_TAG_VALIDATION = "tag_validation"

@@ -484,6 +484,18 @@ class DeepSpeedEngine:
         self._health_cadence = int(getattr(tcfg, "health_cadence", 0) or 0)
         self._health_spec = None
 
+        # ---- HBM residency observatory (telemetry/memory_observatory) -----
+        # Host-side only — the cadence tick fetches the runtime's own
+        # allocator bookkeeping (device_memory_profile is a host RPC, not
+        # a program change or a device sync), so rank-0-only gating
+        # through the manager is safe, like goodput.
+        self._memory = getattr(self.telemetry, "memory", None)
+        self._memory_cadence = int(getattr(tcfg, "memory_cadence", 0) or 0)
+        self._memory_last_obs_step = -1
+        self._memory_inventory = None    # cached expected-bytes accounting
+        self._memory_budget_checked = False
+        self._memory_warned_fetch = False
+
         # ---- fleet flight recorder (telemetry/fleet.py) -------------------
         # Cross-rank by design: the SHIPPER runs on EVERY rank (per-rank
         # window records into the shared run dir are the whole point), so
@@ -580,6 +592,8 @@ class DeepSpeedEngine:
                 if self._fleet_monitor is not None:
                     self._fleet_monitor.on_anomaly = \
                         self._guardian.hook("fleet")
+                if self._memory is not None:
+                    self._memory.on_anomaly = self._guardian.hook("memory")
 
         # ---- parameters / state init --------------------------------------
         with self.telemetry.span("engine/init_state"):
@@ -1982,6 +1996,159 @@ class DeepSpeedEngine:
             mon.write_snapshot(force=True)
         return mon.report()
 
+    # ------------------------------------------- HBM residency observatory
+    @staticmethod
+    def _leaf_device_bytes(arr):
+        """Physical device bytes one state leaf pins across this
+        process's addressable devices — shard bytes x addressable
+        shards. Pure metadata arithmetic (shape/dtype/sharding), never a
+        device sync; a replicated leaf on an 8-device mesh costs 8x its
+        logical nbytes in HBM, which is what the profile's live total
+        sees (plain ``arr.nbytes`` would undercount it 8x)."""
+        try:
+            sh = arr.sharding
+            shard = sh.shard_shape(tuple(arr.shape))
+            n = len(sh.addressable_devices)
+            return int(np.prod(shard, dtype=np.int64)) * \
+                int(arr.dtype.itemsize) * n
+        except Exception:
+            return int(getattr(arr, "nbytes", 0) or 0)
+
+    def _memory_build_inventory(self):
+        """Expected device bytes for the engine-owned pools, split
+        through the PR-3 bucket names. Static after init (the accounting
+        is shape metadata), so it is built once and cached. Optimizer
+        moments and the grad-accumulation pool mirror the param tree, so
+        their leaves map back to the same module buckets by path
+        component; unmatched leaves fold into ``(other)``."""
+        if self._memory_inventory is not None:
+            return self._memory_inventory
+        from deepspeed_tpu.telemetry.health import (_path_component,
+                                                    build_bucket_spec)
+        spec = self._health_spec or build_bucket_spec(
+            self.state.params,
+            depth=int(getattr(self.config.telemetry,
+                              "health_bucket_depth", 8)))
+        flat, _ = jax.tree_util.tree_flatten_with_path(self.state.params)
+        param_buckets = {name: 0 for name in spec.names}
+        for (path, leaf), b in zip(flat, spec.leaf_buckets):
+            param_buckets[spec.names[b]] += self._leaf_device_bytes(leaf)
+
+        def bucket_of(path):
+            comps = {_path_component(e) for e in path}
+            for name in spec.names:
+                if all(p in comps for p in name.split("/")):
+                    return name
+            return "(other)"
+
+        opt_buckets = {name: 0 for name in spec.names}
+        opt_bytes = 0
+        for tree in (self.state.opt_state,
+                     getattr(self.state, "acc_grads", None)):
+            oflat, _ = jax.tree_util.tree_flatten_with_path(tree)
+            for path, leaf in oflat:
+                b = self._leaf_device_bytes(leaf)
+                opt_bytes += b
+                name = bucket_of(path)
+                opt_buckets[name] = opt_buckets.get(name, 0) + b
+        self._memory_inventory = {
+            "totals": {"params": sum(param_buckets.values()),
+                       "optimizer_state": opt_bytes,
+                       "kv_pool": 0},
+            "param_buckets": param_buckets,
+            "opt_buckets": {k: v for k, v in opt_buckets.items() if v},
+        }
+        return self._memory_inventory
+
+    def _memory_arm(self, mon):
+        """Fill the monitor's census/mesh-dependent fields lazily: the
+        pre-flight watermark prediction (once the cost explorer has
+        censused a step program) and the HBM budget — a real device
+        ``memory_stats`` limit only; the host-RSS fallbacks are refused
+        (warn-once) because process RSS is not an HBM budget."""
+        if mon.predicted_bytes is None:
+            hdr = self._census_header()
+            if hdr and hdr.get("hbm_watermark_bytes"):
+                per_dev = int(hdr["hbm_watermark_bytes"])
+                n = int(hdr.get("n_devices") or 1)
+                mon.set_prediction(
+                    per_dev * n, source="cost_explorer.preflight",
+                    detail={"hbm_watermark_bytes_per_device": per_dev,
+                            "n_devices": n,
+                            "program": hdr.get("program")})
+        if mon.budget_bytes is None and not self._memory_budget_checked:
+            self._memory_budget_checked = True
+            from deepspeed_tpu.telemetry.metrics import device_memory_stats
+            stats = device_memory_stats()
+            src = stats.get("source")
+            if src == "device" and stats.get("bytes_limit"):
+                mon.set_budget(
+                    int(stats["bytes_limit"]) * len(jax.local_devices()),
+                    source="jax.memory_stats")
+            elif src in ("host_rss", "host_peak_rss"):
+                mon.refuse_host_budget(src)
+
+    def _memory_tick(self, force=False):
+        """Fetch + attribute one device-memory profile at the memory
+        cadence (default ``steps_per_print``) — a host RPC into the
+        runtime's allocator bookkeeping, never a device sync and never a
+        program change (the train step stays byte-identical; the
+        telemetry_overhead guard pins 0 extra compiles). Rank 0 only
+        (the monitor gates it)."""
+        mon = self._memory
+        if mon is None:
+            return None
+        cadence = self._memory_cadence or self.steps_per_print()
+        if not force and self.global_steps % cadence != 0:
+            return None
+        if self._memory_last_obs_step == self.global_steps:
+            return mon.last_sample
+        self._memory_last_obs_step = self.global_steps
+        self._memory_arm(mon)
+        try:
+            from deepspeed_tpu.telemetry import memory_observatory as _mo
+            from deepspeed_tpu.telemetry import pprof as _pprof
+            sample = _mo.profile_sample(_pprof.fetch_device_memory_profile())
+        except Exception as e:
+            if not self._memory_warned_fetch:
+                self._memory_warned_fetch = True
+                logger.warning(
+                    "[memory] device memory profile unavailable on this "
+                    "backend: %s — residency windows disabled", e)
+            return None
+        inv = self._memory_build_inventory()
+        sample["step"] = self.global_steps
+        sample["inventory"] = inv["totals"]
+        sample["param_buckets"] = inv["param_buckets"]
+        sample["opt_buckets"] = inv["opt_buckets"]
+        mon.observe(sample)
+        reg = self.telemetry.registry
+        if reg is not None:
+            for name, c in mon.last_attribution["categories"].items():
+                reg.gauge("memory_live_bytes",
+                          "attributed live device bytes",
+                          labels={"category": name}).set(c["bytes"])
+            reg.gauge("memory_peak_bytes",
+                      "measured peak live device bytes").set(
+                          mon.measured_peak_bytes)
+        return sample
+
+    def memory_report(self, write=False):
+        """The HBM residency report (what MEMORY_ANATOMY.json holds):
+        exact-sum category/bucket attribution of the live profile, the
+        measured-vs-predicted watermark drift, budget state, anomaly
+        history and the window ring. Forces one profile fetch so the
+        report is current even between cadences. ``write=True`` also
+        writes the report file. ``{"enabled": False}`` when
+        ``telemetry.memory`` is off or this is not rank 0."""
+        mon = self._memory
+        if mon is None:
+            return {"enabled": False}
+        self._memory_tick(force=True)
+        if write:
+            mon.write_report()
+        return mon.report()
+
     # --------------------------------------------------- goodput ledger
     def _led_attr(self, category):
         """Goodput wall-clock attribution context for *category*; the
@@ -2784,6 +2951,7 @@ class DeepSpeedEngine:
             # sampled); the stats fetch below is cadence-gated
             mon.note_step(self.global_steps, overflowed)
         sample = self._health_tick()
+        self._memory_tick()
         if self.global_steps % self.steps_per_print() == 0 \
                 and self._pending_grad_norm is not None:
             # the print path pays the device sync anyway; cache the float.

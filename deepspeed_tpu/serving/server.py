@@ -163,6 +163,17 @@ class ServingEngine:
             self.cache.attach_prefix_cache(
                 capacity_blocks=pc_cfg.capacity_blocks,
                 attention_impl=config.attention_impl)
+        # HBM residency observatory (telemetry/memory_observatory.py):
+        # shared with the train engine's manager — the serving tick adds
+        # THIS server's paged-KV pool to the inventory, so the
+        # kv_fragmentation rule reads the allocator's own numbers (the
+        # same ones serving_report and the gauges book). None when
+        # telemetry.memory is off: one attribute check per step.
+        self._memory = getattr(engine, "_memory", None)
+        _spp = getattr(engine, "steps_per_print", None)
+        self._memory_cadence = (getattr(engine, "_memory_cadence", 0)
+                                or (_spp() if callable(_spp) else 0) or 10)
+        self._memory_steps = 0
         self._watch = CompileWatch(registry=self.registry)
         self._decode_fn = self._watch.wrap(self.runner.decode_step,
                                            name="serving_decode_step")
@@ -274,6 +285,7 @@ class ServingEngine:
                 # quiet serving steps
                 self._serving_steps += 1
                 self.guardian.serving_tick(self._serving_steps)
+            self._memory_tick()
         return progress
 
     def _pause_admission(self, rule):
@@ -544,6 +556,15 @@ class ServingEngine:
         self.registry.gauge("serving_kv_occupancy",
                             "fraction of usable KV blocks allocated").set(
                                 self.cache.allocator.occupancy())
+        self.registry.gauge("serving_kv_free_blocks",
+                            "usable KV blocks currently free").set(
+                                self.cache.allocator.num_free)
+        if self.observatory is not None:
+            self.registry.gauge(
+                "serving_kv_fragmentation",
+                "fraction of allocated KV positions no token has been "
+                "written to (block-granularity over-allocation)").set(
+                    self._kv_fragmentation())
         pc = self.cache.prefix_cache
         if pc is not None:
             for name, help_, total in (
@@ -636,6 +657,67 @@ class ServingEngine:
             if max_steps is not None and steps >= max_steps:
                 break
         return outputs
+
+    # ------------------------------------------- HBM residency observatory
+    def _memory_tick(self, force=False):
+        """Serving-side residency window at the memory cadence: the
+        train-engine inventory plus this server's paged-KV pool, so the
+        observatory attributes the pool as ``kv_pool`` and its
+        ``kv_fragmentation`` rule judges the allocator's own numbers —
+        the same ones ``serving_report()`` and the gauges book. A host
+        RPC into the runtime's allocator bookkeeping only; never a
+        device sync, never a new decode/prefill signature."""
+        mon = self._memory
+        if mon is None:
+            return None
+        self._memory_steps += 1
+        if not force and self._memory_steps % self._memory_cadence != 0:
+            return None
+        self.engine._memory_arm(mon)
+        try:
+            from deepspeed_tpu.telemetry import memory_observatory as _mo
+            from deepspeed_tpu.telemetry import pprof as _pprof
+            sample = _mo.profile_sample(
+                _pprof.fetch_device_memory_profile())
+        except Exception as e:
+            if not self.engine._memory_warned_fetch:
+                self.engine._memory_warned_fetch = True
+                log_dist(
+                    f"[memory] device memory profile unavailable on this "
+                    f"backend: {e} — serving residency windows disabled",
+                    ranks=[0])
+            return None
+        inv = self.engine._memory_build_inventory()
+        totals = dict(inv["totals"])
+        totals["kv_pool"] = self.cache.pool_bytes()
+        alloc = self.cache.allocator
+        sample["step"] = self._memory_steps
+        sample["inventory"] = totals
+        sample["param_buckets"] = inv["param_buckets"]
+        sample["opt_buckets"] = inv["opt_buckets"]
+        sample["kv"] = {
+            "pool_bytes": self.cache.pool_bytes(),
+            "block_size": self.cache.block_size,
+            "free_blocks": alloc.num_free,
+            "usable_blocks": alloc.num_usable,
+            "occupancy": round(alloc.occupancy(), 4),
+            "fragmentation": round(self._kv_fragmentation(), 4),
+        }
+        mon.observe(sample)
+        return sample
+
+    def memory_report(self, write=False):
+        """The serving-side residency report: forces one window (with
+        the KV pool in the inventory) and returns the monitor's report;
+        ``write=True`` also writes MEMORY_ANATOMY.json.
+        ``{"enabled": False}`` when ``telemetry.memory`` is off."""
+        mon = self._memory
+        if mon is None:
+            return {"enabled": False}
+        self._memory_tick(force=True)
+        if write:
+            mon.write_report()
+        return mon.report()
 
     # -------------------------------------------------------- inspection
     def _kv_fragmentation(self):

@@ -44,6 +44,16 @@ Four pieces (see the per-module docstrings):
   when a capture is post-processed (lazy ``__getattr__`` below), so
   engine init never pays for it — tests/perf/telemetry_overhead.py
   pins that;
+* ``pprof`` / ``memory_observatory`` — measured device-MEMORY
+  attribution: a dependency-free parser for the gzip+protobuf pprof
+  profile ``jax.profiler.device_memory_profile()`` emits, and the HBM
+  residency observatory (exact-sum buffer attribution into
+  params / optimizer_state / kv_pool / activations_workspace / other,
+  leak / watermark-drift / kv-fragmentation / oom-risk sentinels) behind
+  ``telemetry.memory`` + ``engine.memory_report`` -> MEMORY_ANATOMY.json
+  (``python -m deepspeed_tpu.telemetry.memory_observatory`` is the
+  CLI). Lazy like xplane/step_anatomy — only loads at the first cadence
+  tick;
 * ``bench_diff`` — bench-regression differ over committed BENCH_r*.json
   rounds (``python -m deepspeed_tpu.telemetry.bench_diff`` exits
   non-zero past the regression threshold).
@@ -102,14 +112,15 @@ __all__ = [
     "FleetMonitor", "FleetShipper", "build_desync_checksum_fn",
     "get_shipper", "merge_traces", "set_shipper",
     "get_manager", "set_manager",
-    "xplane", "step_anatomy",
+    "xplane", "step_anatomy", "pprof", "memory_observatory",
 ]
 
 
 def __getattr__(name):
-    # lazy submodule access (PEP 562): telemetry.xplane / .step_anatomy
-    # stay un-imported until a capture is actually post-processed
-    if name in ("xplane", "step_anatomy"):
+    # lazy submodule access (PEP 562): telemetry.xplane / .step_anatomy /
+    # .pprof / .memory_observatory stay un-imported until a capture or a
+    # residency window is actually post-processed
+    if name in ("xplane", "step_anatomy", "pprof", "memory_observatory"):
         import importlib
         return importlib.import_module(f"deepspeed_tpu.telemetry.{name}")
     raise AttributeError(

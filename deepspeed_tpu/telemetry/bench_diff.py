@@ -35,7 +35,16 @@ METRICS = {
     "value": "up",            # the headline TFLOPS/chip
     "mfu": "up",
     "input_wait_frac": "down",
+    # measured HBM residency (appears from the BENCH_MEMORY rounds on):
+    # a peak-bytes growth is a memory regression like a step-time one;
+    # watermark_drift compares |drift| — the pre-flight calibration can
+    # miss in either direction, and -5% -> +5% is no worse
+    "hbm_peak_bytes": "down",
+    "watermark_drift": "down",
 }
+
+# metrics judged on magnitude: sign only says which SIDE the miss was on
+_ABS_METRICS = ("watermark_drift",)
 
 DEFAULT_THRESHOLD = 0.10      # 10% relative regression fails
 
@@ -69,7 +78,11 @@ def diff_rounds(prev, cur, threshold=DEFAULT_THRESHOLD):
     for name, direction in METRICS.items():
         a, b = prev.get(name), cur.get(name)
         if not isinstance(a, (int, float)) or \
-                not isinstance(b, (int, float)) or a == 0:
+                not isinstance(b, (int, float)):
+            continue
+        if name in _ABS_METRICS:
+            a, b = abs(a), abs(b)
+        if a == 0:
             continue
         rel = (b - a) / abs(a)
         worse = rel > threshold if direction == "down" \

@@ -41,6 +41,7 @@ class TelemetryManager:
             self.trace_path = None
             self.health = None
             self.goodput = None
+            self.memory = None
             return
 
         out = config.output_path or "telemetry/"
@@ -89,6 +90,19 @@ class TelemetryManager:
                 on_escalate=(self._force_trace_export
                              if config.trace else None))
             _ledger_mod.set_ledger(self.goodput)
+        # HBM residency observatory (telemetry/memory_observatory.py):
+        # host-side like the health monitor; the engine fills in the
+        # watermark prediction / HBM budget once its census exists and
+        # feeds observe() from the cadence tick.
+        self.memory = None
+        if getattr(config, "memory_enabled", False):
+            from deepspeed_tpu.telemetry.memory_observatory import \
+                MemoryMonitor
+            self.memory = MemoryMonitor.from_config(
+                config, output_path=out, job_name=job,
+                registry=self.registry,
+                on_escalate=(self._force_trace_export
+                             if config.trace else None))
         self._closed = False
         self._last_export_t = float("-inf")
         self._last_export_n = -1
@@ -121,9 +135,15 @@ class TelemetryManager:
             return
         stats = device_memory_stats()
         src = stats.pop("source", "none")
+        # one canonical label vocabulary: a real backend memory_stats()
+        # publishes as source=hbm; the psutil/resource fallbacks keep
+        # their host_* names so dashboards can never mistake process RSS
+        # for device residency (the autotuner/observatory refuse them).
+        label = {"device": "hbm"}.get(src, src)
         for k, v in stats.items():
             self.registry.gauge(f"device_memory_{k}",
-                                f"memory stat '{k}' (source: {src})").set(v)
+                                f"memory stat '{k}'",
+                                labels={"source": label}).set(v)
 
     # ----------------------------------------------------------------- sinks
     # re-serialising the whole trace buffer is O(events); at print cadence
@@ -158,6 +178,8 @@ class TelemetryManager:
         self._closed = True
         if self.health is not None:
             self.health.close()
+        if self.memory is not None:
+            self.memory.close()
         if self.goodput is not None:
             from deepspeed_tpu.telemetry import ledger as _ledger_mod
             self.goodput.close()

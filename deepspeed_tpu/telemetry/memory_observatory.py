@@ -1,0 +1,672 @@
+"""HBM residency observatory — buffer-level device-memory attribution.
+
+The cost explorer's ``memory_analysis`` watermark (PR 2) is a
+compile-time *prediction*; this module is the measured side: the live
+pprof profile ``jax.profiler.device_memory_profile()`` emits (decoded by
+the dependency-free ``pprof.py`` reader) joined to engine-owned state,
+so every live byte lands in exactly one of five categories —
+
+    params | optimizer_state | kv_pool | activations_workspace | other
+
+— with params/optimizer_state further bucketed through the PR-3
+``build_bucket_spec`` module names. The attribution is EXACT by
+construction (the goodput/anatomy invariant discipline): known
+categories are attributed ``min(expected, remaining)`` in priority
+order, the workspace category is the remainder, so per-category bytes
+re-add to the profile's live total with integer arithmetic — any
+engine-vs-profile mismatch surfaces as an explicit ``shortfall_bytes``,
+never as silent drift.
+
+On top sits :class:`MemoryMonitor`, a windowed monitor with the
+established warn-once -> throttled ``MEMORY_HEALTH.json`` ->
+on_anomaly-hook escalation and four rules:
+
+* ``hbm_leak`` — live bytes grew strictly monotonically across
+  ``leak_windows`` consecutive post-warmup windows;
+* ``watermark_drift`` — measured peak vs the pre-flight prediction
+  beyond ``drift_threshold`` in EITHER direction (an over-prediction
+  wastes autotuner headroom, an under-prediction hides OOM risk);
+* ``kv_fragmentation`` — the serving allocator's fragmentation (the
+  SAME numbers ``serving_report()`` books) above ``frag_threshold``;
+* ``oom_risk`` — live bytes crossing ``headroom x budget`` (critical).
+  The budget is a real HBM limit only: host-RSS fallbacks are refused
+  (warn-once) — process RSS is not an HBM budget.
+
+The module is pure host-side bookkeeping: no jax import outside the CLI
+demo (``tests/perf/telemetry_overhead.py`` pins this statically), so it
+cannot add device syncs; the profile fetch
+(``pprof.fetch_device_memory_profile``) happens on the engine/serving
+tick at cadence only. ``python -m deepspeed_tpu.telemetry
+.memory_observatory --demo`` regenerates the committed repo-root
+``MEMORY_ANATOMY.json`` example; ``--render`` pretty-prints one.
+"""
+
+import json
+import os
+import time
+from collections import deque
+
+from deepspeed_tpu.telemetry import pprof
+from deepspeed_tpu.telemetry.health import json_safe
+from deepspeed_tpu.utils.logging import logger
+
+MEMORY_SCHEMA = "deepspeed_tpu.memory_anatomy/1"
+
+# category attribution order: specific, engine-known pools first; the
+# workspace remainder is computed, never estimated
+CATEGORIES = ("params", "optimizer_state", "kv_pool",
+              "activations_workspace", "other")
+
+RULE_SEVERITY = {
+    "oom_risk": "critical",
+    "hbm_leak": "warning",
+    "watermark_drift": "warning",
+    "kv_fragmentation": "warning",
+}
+_SEVERITY_ORDER = ("critical", "warning", "watch")
+
+
+# ---------------------------------------------------------------------------
+# exact-sum attribution
+# ---------------------------------------------------------------------------
+
+def attribute_live_bytes(live_total_bytes, inventory, executable_bytes=0):
+    """Attribute a profile's live total across the five categories.
+
+    ``inventory`` holds the engine-expected byte counts for the pools
+    the engine owns ({params, optimizer_state, kv_pool}); compiled
+    programs (``executable_bytes``) land in ``other``. Each known
+    category is granted ``min(expected, remaining)`` in declaration
+    order and ``activations_workspace`` takes the remainder — so the
+    category bytes sum EXACTLY to ``live_total_bytes`` by construction,
+    and any capping (profile smaller than the engine's own accounting,
+    e.g. a donated buffer the allocator already released) is recorded as
+    that category's ``shortfall_bytes`` instead of corrupting the sum.
+    """
+    live_total_bytes = max(0, int(live_total_bytes))
+    remaining = live_total_bytes
+    cats = {}
+    expected = {
+        "params": int(inventory.get("params", 0) or 0),
+        "optimizer_state": int(inventory.get("optimizer_state", 0) or 0),
+        "kv_pool": int(inventory.get("kv_pool", 0) or 0),
+        "other": int(executable_bytes or 0),
+    }
+    for name in ("params", "optimizer_state", "kv_pool", "other"):
+        want = max(0, expected[name])
+        got = min(want, remaining)
+        remaining -= got
+        cats[name] = {"bytes": got, "expected_bytes": want,
+                      "shortfall_bytes": want - got}
+    cats["activations_workspace"] = {
+        "bytes": remaining, "expected_bytes": None, "shortfall_bytes": 0}
+    # re-order to the canonical tuple for stable artifacts
+    ordered = {name: cats[name] for name in CATEGORIES}
+    assert sum(c["bytes"] for c in ordered.values()) == live_total_bytes
+    return {"live_total_bytes": live_total_bytes, "categories": ordered}
+
+
+def attribute_buckets(total_bytes, bucket_bytes):
+    """Distribute a category's attributed bytes across its module
+    buckets with the same min-cap walk, so the bucket values sum EXACTLY
+    to ``total_bytes``. ``bucket_bytes`` is an ordered {name: expected}
+    mapping (PR-3 bucket names, leaf nbytes pre-summed per bucket); any
+    surplus the buckets cannot explain lands in ``(other)``."""
+    total_bytes = max(0, int(total_bytes))
+    remaining = total_bytes
+    out = {}
+    for name, want in bucket_bytes.items():
+        got = min(max(0, int(want or 0)), remaining)
+        remaining -= got
+        out[name] = got
+    if remaining:
+        out["(other)"] = out.get("(other)", 0) + remaining
+    assert sum(out.values()) == total_bytes
+    return out
+
+
+def profile_sample(data):
+    """Decode raw ``device_memory_profile`` bytes into the host-side
+    numbers one monitor window needs: live totals split by sample kind,
+    the buffer count, and the top samples for forensics."""
+    prof = pprof.parse_profile(data)
+    kinds = pprof.live_bytes_by_kind(prof)
+    buffer_bytes = int(kinds.get("buffer", 0))
+    executable_bytes = int(sum(v for k, v in kinds.items()
+                               if k != "buffer"))
+    ci = prof.value_index("count")
+    buffer_count = 0
+    if ci is not None:
+        for s in prof.samples:
+            if ci < len(s.values) and \
+                    prof.sample_labels(s).get("kind") == "buffer":
+                buffer_count += s.values[ci]
+    return {
+        "live_total_bytes": buffer_bytes + executable_bytes,
+        "buffer_bytes": buffer_bytes,
+        "executable_bytes": executable_bytes,
+        "buffer_count": buffer_count,
+        "top_samples": pprof.summarize_samples(prof, 8),
+        "source": "jax.profiler.device_memory_profile",
+    }
+
+
+# ---------------------------------------------------------------------------
+# the windowed monitor
+# ---------------------------------------------------------------------------
+
+class MemoryMonitor:
+    """Windowed device-memory residency monitor.
+
+    One input, one cadence: :meth:`observe` — a sample dict built by the
+    engine/serving tick (profile totals + engine inventory + optional
+    KV-pool numbers). Everything here is host arithmetic; the device was
+    touched exactly once, at the cadence fetch.
+
+    Escalation on a firing rule mirrors ``HealthMonitor``: one warning
+    log per rule (later firings only counted), a throttled
+    ``MEMORY_HEALTH.json`` snapshot, the ``on_escalate`` /
+    ``on_anomaly`` hooks, and a ``memory_anomalies_total{rule=...}``
+    counter. Level-triggered rules (drift / fragmentation / oom) carry
+    hysteresis: they fire on crossing and re-arm only after the signal
+    drops back under its threshold, so a persistently-drifted run
+    produces one anomaly, not one per window.
+    """
+
+    SNAPSHOT_MIN_INTERVAL_S = 5.0
+    MAX_ANOMALY_HISTORY = 100
+
+    def __init__(self, job_name="", snapshot_path="MEMORY_HEALTH.json",
+                 report_path="MEMORY_ANATOMY.json", leak_windows=4,
+                 warmup_windows=2, drift_threshold=0.25,
+                 frag_threshold=0.5, headroom=0.92, budget_bytes=None,
+                 ring_size=64, registry=None, on_escalate=None,
+                 on_anomaly=None, log_fn=None):
+        self.job_name = job_name
+        self.snapshot_path = snapshot_path
+        self.report_path = report_path
+        self.leak_windows = max(2, int(leak_windows))
+        self.warmup_windows = max(0, int(warmup_windows))
+        self.drift_threshold = float(drift_threshold)
+        self.frag_threshold = float(frag_threshold)
+        self.headroom = float(headroom)
+        self.budget_bytes = int(budget_bytes) if budget_bytes else None
+        self.budget_source = "config" if budget_bytes else None
+        self.registry = registry
+        self.on_escalate = on_escalate
+        self.on_anomaly = on_anomaly
+        self._log = log_fn or logger.warning
+
+        self.predicted_bytes = None
+        self.prediction_source = None
+        self.prediction_detail = None
+        self.measured_peak_bytes = 0
+        self.peak_step = -1
+        self.windows_seen = 0
+        self.anomalies = []          # bounded history, most recent last
+        self.rule_counts = {}        # rule -> total firings
+        self.ring = deque(maxlen=int(ring_size))
+        self._live_history = deque(maxlen=self.leak_windows + 1)
+        self.last_sample = None
+        self.last_attribution = None
+        self.last_buckets = None
+        self.last_step = -1
+        self._leak_active = False
+        self._drift_active = False
+        self._frag_active = False
+        self._oom_active = False
+        self._host_budget_refused = False
+        self._snapshots_written = 0
+        self._last_snapshot_t = float("-inf")
+
+    @classmethod
+    def from_config(cls, tconfig, output_path="telemetry/", job_name="",
+                    registry=None, on_escalate=None, on_anomaly=None):
+        """Build from a parsed ``DeepSpeedTelemetryConfig``'s
+        ``memory_*`` fields (the engine fills the prediction and the
+        HBM budget after its step programs / census exist)."""
+        snap = getattr(tconfig, "memory_snapshot_file", "") or \
+            "MEMORY_HEALTH.json"
+        if not os.path.isabs(snap):
+            snap = os.path.join(output_path or ".", snap)
+        rep = getattr(tconfig, "memory_report_file", "") or \
+            "MEMORY_ANATOMY.json"
+        if not os.path.isabs(rep):
+            rep = os.path.join(output_path or ".", rep)
+        return cls(
+            job_name=job_name,
+            snapshot_path=snap,
+            report_path=rep,
+            leak_windows=getattr(tconfig, "memory_leak_windows", 4),
+            warmup_windows=getattr(tconfig, "memory_warmup_windows", 2),
+            drift_threshold=getattr(tconfig, "memory_drift_threshold",
+                                    0.25),
+            frag_threshold=getattr(tconfig, "memory_frag_threshold", 0.5),
+            headroom=getattr(tconfig, "memory_headroom", 0.92),
+            budget_bytes=getattr(tconfig, "memory_budget_bytes", 0) or None,
+            ring_size=getattr(tconfig, "memory_ring_size", 64),
+            registry=registry, on_escalate=on_escalate,
+            on_anomaly=on_anomaly)
+
+    # ------------------------------------------------------------- wiring
+    def set_prediction(self, predicted_bytes, source="", detail=None):
+        """Install the PR-2 pre-flight watermark the drift rule measures
+        against (total bytes across the devices the profile covers)."""
+        if predicted_bytes and predicted_bytes > 0:
+            self.predicted_bytes = int(predicted_bytes)
+            self.prediction_source = source or None
+            self.prediction_detail = detail
+
+    def set_budget(self, budget_bytes, source=""):
+        """Install the HBM budget the oom_risk rule guards. Host-RSS
+        derived numbers must never reach here — call
+        :meth:`refuse_host_budget` instead so the refusal is recorded."""
+        if budget_bytes and budget_bytes > 0:
+            self.budget_bytes = int(budget_bytes)
+            self.budget_source = source or None
+
+    def refuse_host_budget(self, source="host_rss"):
+        """Record (warn-once) that budget detection only found host-RSS
+        numbers: process RSS is not an HBM limit, so oom_risk stays
+        disarmed rather than firing on a meaningless threshold."""
+        if not self._host_budget_refused:
+            self._host_budget_refused = True
+            self._log("[memory] device-memory budget detection found only "
+                      "%s — refusing to treat host RSS as an HBM budget; "
+                      "oom_risk stays disarmed (set telemetry.memory."
+                      "budget_bytes to arm it explicitly)", source)
+
+    # ------------------------------------------------------------ cadence
+    def observe(self, sample):
+        """Evaluate the rules on one cadence sample. ``sample`` is a
+        plain dict of host numbers: the ``profile_sample`` totals plus
+        ``step``, ``inventory`` ({params, optimizer_state, kv_pool}
+        expected bytes), optional ``param_buckets`` / ``opt_buckets``
+        (ordered {bucket: bytes}) and optional ``kv``
+        ({pool_bytes, free_blocks, usable_blocks, fragmentation}).
+        Returns the list of anomalies that fired on THIS sample."""
+        step = int(sample.get("step", -1))
+        live = int(sample.get("live_total_bytes", 0))
+        att = attribute_live_bytes(
+            live, sample.get("inventory") or {},
+            executable_bytes=sample.get("executable_bytes", 0))
+        buckets = {
+            "params": attribute_buckets(
+                att["categories"]["params"]["bytes"],
+                sample.get("param_buckets") or {}),
+            "optimizer_state": attribute_buckets(
+                att["categories"]["optimizer_state"]["bytes"],
+                sample.get("opt_buckets") or {}),
+        }
+        warmed = self.windows_seen >= self.warmup_windows
+        anoms = []
+
+        if live > self.measured_peak_bytes:
+            self.measured_peak_bytes = live
+            self.peak_step = step
+        self._live_history.append(live)
+
+        # hbm_leak: strict monotone growth across the whole window ring
+        if warmed and len(self._live_history) == self._live_history.maxlen:
+            hist = list(self._live_history)
+            growing = all(b > a for a, b in zip(hist, hist[1:]))
+            if growing and not self._leak_active:
+                self._leak_active = True
+                anoms.append({
+                    "rule": "hbm_leak", "step": step,
+                    "severity": RULE_SEVERITY["hbm_leak"],
+                    "detail": f"live bytes grew monotonically across the "
+                              f"last {self.leak_windows} windows: "
+                              f"{hist[0]} -> {hist[-1]} "
+                              f"(+{hist[-1] - hist[0]} B)",
+                    "history": hist})
+            elif not growing:
+                self._leak_active = False
+
+        # watermark_drift: measured peak vs the pre-flight, BOTH ways
+        drift = self.drift()
+        if warmed and drift is not None:
+            if abs(drift) > self.drift_threshold and not self._drift_active:
+                self._drift_active = True
+                direction = "above" if drift > 0 else "below"
+                anoms.append({
+                    "rule": "watermark_drift", "step": step,
+                    "severity": RULE_SEVERITY["watermark_drift"],
+                    "detail": f"measured peak {self.measured_peak_bytes} B "
+                              f"is {abs(drift):.0%} {direction} the "
+                              f"pre-flight prediction "
+                              f"{self.predicted_bytes} B "
+                              f"({self.prediction_source})",
+                    "drift": round(drift, 4)})
+            elif abs(drift) <= self.drift_threshold:
+                self._drift_active = False
+
+        # kv_fragmentation: the allocator's own numbers, unmodified
+        kv = sample.get("kv")
+        if warmed and kv and kv.get("pool_bytes"):
+            frag = float(kv.get("fragmentation") or 0.0)
+            if frag > self.frag_threshold and not self._frag_active:
+                self._frag_active = True
+                anoms.append({
+                    "rule": "kv_fragmentation", "step": step,
+                    "severity": RULE_SEVERITY["kv_fragmentation"],
+                    "detail": f"KV pool fragmentation {frag:.0%} exceeds "
+                              f"{self.frag_threshold:.0%} "
+                              f"({kv.get('free_blocks')} free of "
+                              f"{kv.get('usable_blocks')} usable blocks, "
+                              f"pool {kv['pool_bytes']} B)",
+                    "fragmentation": round(frag, 4)})
+            elif frag <= self.frag_threshold:
+                self._frag_active = False
+
+        # oom_risk: critical, never warmed up — headroom exists exactly
+        # so the alarm beats the allocator to the cliff
+        if self.budget_bytes:
+            limit = self.headroom * self.budget_bytes
+            if live > limit and not self._oom_active:
+                self._oom_active = True
+                anoms.append({
+                    "rule": "oom_risk", "step": step,
+                    "severity": RULE_SEVERITY["oom_risk"],
+                    "detail": f"live bytes {live} crossed "
+                              f"{self.headroom:.0%} of the "
+                              f"{self.budget_bytes} B HBM budget "
+                              f"({self.budget_source})",
+                    "live_bytes": live, "limit_bytes": int(limit)})
+            elif live <= limit:
+                self._oom_active = False
+
+        self.windows_seen += 1
+        self.last_sample = sample
+        self.last_attribution = att
+        self.last_buckets = buckets
+        self.last_step = step
+        self.ring.append({"step": step, "live_total_bytes": live,
+                          "buffer_count": sample.get("buffer_count")})
+        if anoms:
+            self._escalate(anoms)
+        return anoms
+
+    def drift(self):
+        """Measured-peak vs predicted watermark, or None while either
+        side is missing."""
+        if not self.predicted_bytes or not self.measured_peak_bytes:
+            return None
+        return self.measured_peak_bytes / self.predicted_bytes - 1.0
+
+    # ---------------------------------------------------------- escalation
+    def _escalate(self, anoms):
+        any_first = False
+        for a in anoms:
+            rule = a["rule"]
+            first = rule not in self.rule_counts
+            any_first = any_first or first
+            self.rule_counts[rule] = self.rule_counts.get(rule, 0) + 1
+            self.anomalies.append(a)
+            if first:
+                self._log("[memory] %s (%s) at step %s: %s — snapshot "
+                          "-> %s", rule, a["severity"], a.get("step"),
+                          a["detail"], self.snapshot_path)
+            if self.registry is not None:
+                self.registry.counter(
+                    "memory_anomalies_total",
+                    "device-memory anomaly rule firings",
+                    labels={"rule": rule}).inc()
+        del self.anomalies[:-self.MAX_ANOMALY_HISTORY]
+        self.write_snapshot(force=any_first)
+        if self.on_escalate is not None:
+            try:
+                self.on_escalate()
+            except Exception as e:   # forensics must never kill a step
+                logger.warning("[memory] on_escalate hook failed: %s", e)
+        if self.on_anomaly is not None:
+            try:
+                self.on_anomaly(anoms)
+            except Exception as e:   # a policy engine must not either
+                logger.warning("[memory] on_anomaly hook failed: %s", e)
+
+    # ------------------------------------------------------------- outputs
+    def verdict(self):
+        if not self.windows_seen:
+            return "unknown"
+        seen = {RULE_SEVERITY.get(r, "warning") for r in self.rule_counts}
+        for tier in _SEVERITY_ORDER:
+            if tier in seen:
+                return tier
+        return "healthy"
+
+    def report(self):
+        """The full residency dict (what ``MEMORY_ANATOMY.json`` and the
+        escalation snapshot both hold)."""
+        drift = self.drift()
+        sample = self.last_sample or {}
+        return {
+            "schema": MEMORY_SCHEMA,
+            "enabled": True,
+            "job_name": self.job_name,
+            "verdict": self.verdict(),
+            "source": sample.get("source"),
+            "step": self.last_step,
+            "live_total_bytes": (self.last_attribution or {}).get(
+                "live_total_bytes", 0),
+            "buffer_count": sample.get("buffer_count"),
+            "categories": (self.last_attribution or {}).get(
+                "categories", {}),
+            "buckets": self.last_buckets or {},
+            "watermark": {
+                "predicted_bytes": self.predicted_bytes,
+                "prediction_source": self.prediction_source,
+                "prediction_detail": self.prediction_detail,
+                "measured_peak_bytes": self.measured_peak_bytes,
+                "peak_step": self.peak_step,
+                "drift": None if drift is None else round(drift, 4),
+                "threshold": self.drift_threshold,
+                "flagged": (drift is not None
+                            and abs(drift) > self.drift_threshold),
+            },
+            "budget": {
+                "bytes": self.budget_bytes,
+                "source": self.budget_source,
+                "headroom": self.headroom,
+                "host_budget_refused": self._host_budget_refused,
+            },
+            "kv": sample.get("kv"),
+            "rules": {
+                "leak_windows": self.leak_windows,
+                "warmup_windows": self.warmup_windows,
+                "drift_threshold": self.drift_threshold,
+                "frag_threshold": self.frag_threshold,
+                "headroom": self.headroom,
+            },
+            "counters": {
+                "windows_seen": self.windows_seen,
+                "anomaly_counts": dict(self.rule_counts),
+                "snapshots_written": self._snapshots_written,
+            },
+            "top_samples": sample.get("top_samples") or [],
+            "anomalies": list(self.anomalies),
+            "ring": list(self.ring),
+        }
+
+    def write_snapshot(self, path=None, force=False):
+        """Write the throttled escalation snapshot (MEMORY_HEALTH.json).
+        Re-serialising the report every anomaly during a leak spiral
+        would stall the train thread, so repeats ride the throttle."""
+        if not force and (time.monotonic() - self._last_snapshot_t
+                          < self.SNAPSHOT_MIN_INTERVAL_S):
+            return None
+        self._last_snapshot_t = time.monotonic()
+        path = path or self.snapshot_path
+        self._write(path)
+        self._snapshots_written += 1
+        return path
+
+    def write_report(self, path=None):
+        """Write the residency report (MEMORY_ANATOMY.json) — the
+        explicit ``memory_report(write=True)`` / CLI path, unthrottled."""
+        path = path or self.report_path
+        self._write(path)
+        return path
+
+    def _write(self, path):
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(json_safe(self.report()), f, indent=1, default=repr,
+                      allow_nan=False)
+
+    def close(self):
+        """Final snapshot — only when there is something to explain."""
+        if self.anomalies:
+            self.write_snapshot(force=True)
+
+
+# --------------------------------------------------------------------- CLI
+
+def _fmt_bytes(n):
+    if n is None:
+        return "(n/a)"
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return (f"{n} {unit}" if unit == "B"
+                    else f"{n:.2f} {unit}")
+        n /= 1024.0
+    return f"{n:.2f} GiB"  # pragma: no cover
+
+
+def render(report):
+    """Human-readable rendering of a MEMORY_ANATOMY.json dict."""
+    lines = []
+    if not report.get("enabled", True):
+        return "memory observatory: disabled"
+    total = report.get("live_total_bytes", 0)
+    lines.append(f"memory verdict: {report.get('verdict', '?').upper()}"
+                 f"  (job {report.get('job_name') or '-'}, step "
+                 f"{report.get('step')}, live {_fmt_bytes(total)}, "
+                 f"{report.get('buffer_count')} buffers)")
+    for name in CATEGORIES:
+        c = (report.get("categories") or {}).get(name)
+        if c is None:
+            continue
+        frac = c["bytes"] / total if total else 0.0
+        short = (f"  (shortfall {_fmt_bytes(c['shortfall_bytes'])})"
+                 if c.get("shortfall_bytes") else "")
+        lines.append(f"  {name:22s} {_fmt_bytes(c['bytes']):>12s} "
+                     f"({frac:6.1%}){short}")
+    for cat in ("params", "optimizer_state"):
+        bks = (report.get("buckets") or {}).get(cat) or {}
+        for bname, b in sorted(bks.items(), key=lambda kv: -kv[1])[:6]:
+            if b:
+                lines.append(f"    {cat[:6]} bucket {bname:26s} "
+                             f"{_fmt_bytes(b):>12s}")
+    wm = report.get("watermark") or {}
+    if wm.get("predicted_bytes"):
+        d = wm.get("drift")
+        lines.append(
+            f"  watermark: measured peak "
+            f"{_fmt_bytes(wm.get('measured_peak_bytes'))} vs predicted "
+            f"{_fmt_bytes(wm.get('predicted_bytes'))}"
+            + (f", drift {d:+.1%}" if d is not None else "")
+            + (" [FLAGGED]" if wm.get("flagged") else ""))
+    bud = report.get("budget") or {}
+    if bud.get("bytes"):
+        lines.append(f"  budget: {_fmt_bytes(bud['bytes'])} "
+                     f"({bud.get('source')}) x headroom "
+                     f"{bud.get('headroom'):.0%}")
+    kv = report.get("kv")
+    if kv:
+        lines.append(f"  kv pool: {_fmt_bytes(kv.get('pool_bytes'))}, "
+                     f"{kv.get('free_blocks')} free / "
+                     f"{kv.get('usable_blocks')} usable blocks, "
+                     f"fragmentation {kv.get('fragmentation', 0):.1%}")
+    for a in report.get("anomalies", []):
+        lines.append(f"  [{a.get('severity', '?'):8s}] step "
+                     f"{a.get('step')}: {a.get('rule')} — "
+                     f"{a.get('detail')}")
+    if not report.get("anomalies"):
+        lines.append("  no anomalies recorded")
+    for row in report.get("top_samples", [])[:4]:
+        stack = " <- ".join(row.get("stack") or []) or "?"
+        lines.append(f"  top {row['kind']:10s} "
+                     f"{_fmt_bytes(row['bytes']):>12s}  {stack}")
+    return "\n".join(lines)
+
+
+def _demo(args):
+    """Build a tiny engine with the observatory armed at cadence 1, run
+    a few steps, and write the measured residency report — the committed
+    repo-root MEMORY_ANATOMY.json example comes from here. On CPU jax
+    the profile is real (TFRT CPU buffers), so the categories, buckets
+    and the measured-vs-predicted drift are all measured numbers."""
+    if args.devices:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.devices}")
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.simple import SimpleModel, sample_batch
+    from deepspeed_tpu.utils import groups
+
+    groups.destroy()
+    groups.initialize()
+    hidden = 64
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=hidden, nlayers=4),
+        config={
+            "train_batch_size": 16,
+            "steps_per_print": 1,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "telemetry": {"enabled": True, "trace": False,
+                          "jsonl": False, "prometheus": False,
+                          "cost_explorer": {"enabled": True},
+                          "memory": {"enabled": True, "cadence": 1,
+                                     "warmup_windows": 1}},
+        },
+        sample_batch=sample_batch(16, hidden))
+    rng = np.random.default_rng(0)
+    for _ in range(args.steps):
+        x = rng.standard_normal((16, hidden)).astype(np.float32)
+        y = rng.standard_normal((16, hidden)).astype(np.float32)
+        engine.train_batch(batch=(x, y))
+    report = engine.memory_report(write=False)
+    mon = engine.telemetry.memory
+    out = os.path.abspath(args.out)
+    mon.write_report(out)
+    print(render(report))
+    print(f"\nwrote {out}")
+    return 0
+
+
+def main(argv=None):
+    import argparse
+    p = argparse.ArgumentParser(
+        prog="python -m deepspeed_tpu.telemetry.memory_observatory",
+        description="Render a MEMORY_ANATOMY.json report, or run the "
+                    "residency demo (tiny engine, measured attribution "
+                    "+ watermark drift)")
+    p.add_argument("--render", metavar="MEMORY_ANATOMY.json",
+                   help="pretty-print an existing report and exit")
+    p.add_argument("--demo", action="store_true",
+                   help="build a tiny engine with the observatory armed "
+                        "and write the measured report")
+    p.add_argument("--steps", type=int, default=8)
+    p.add_argument("--devices", type=int, default=8,
+                   help="virtual CPU devices for the demo (0 = existing)")
+    p.add_argument("--out", default="MEMORY_ANATOMY.json")
+    args = p.parse_args(argv)
+    if args.render:
+        with open(args.render) as f:
+            print(render(json.load(f)))
+        return 0
+    if args.demo:
+        return _demo(args)
+    p.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -1,0 +1,476 @@
+"""Dependency-free pprof Profile protobuf reader.
+
+``jax.profiler.device_memory_profile()`` returns a gzip-compressed
+``perftools.profiles.Profile`` protobuf — the pprof format — describing
+every live device allocation (one sample per buffer/executable, with a
+byte count and an allocation stack). Reading it back normally requires
+the ``pprof`` tool or a protobuf runtime; this module instead decodes
+the wire format by hand (varint + length-delimited scanning, same house
+style as ``xplane.py``) so the memory observatory can attribute live HBM
+with zero extra dependencies.
+
+It intentionally imports neither ``tensorflow`` nor ``pprof``/protobuf
+(a static guard in ``tests/unit/test_pprof.py`` pins this).
+
+Field numbers (stable since the schema is append-only upstream):
+
+    Profile:    sample_type=1 sample=2 mapping=3 location=4 function=5
+                string_table=6 time_nanos=9 duration_nanos=10
+                period_type=11 period=12 default_sample_type=14
+    ValueType:  type=1 unit=2             (string-table indices)
+    Sample:     location_id=1 value=2     (packed varints)
+                label=3
+    Label:      key=1 str=2 num=3 num_unit=4
+    Location:   id=1 mapping_id=2 address=3 line=4
+    Line:       function_id=1 line=2
+    Function:   id=1 name=2 system_name=3 filename=4 start_line=5
+
+jax's device-memory profile carries two sample types —
+``(allocations, count)`` and ``(space, bytes)`` — and labels each sample
+with ``kind`` (``buffer`` | ``executable``) and ``device``.
+
+All error offsets are absolute positions in the DECOMPRESSED stream
+(the gzip envelope is stripped before decoding).
+"""
+
+import gzip
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+__all__ = [
+    "PprofParseError",
+    "ValueType",
+    "Label",
+    "Sample",
+    "Location",
+    "Function",
+    "Profile",
+    "parse_profile",
+    "parse_profile_file",
+    "live_bytes_by_kind",
+    "summarize_samples",
+]
+
+
+class PprofParseError(ValueError):
+    """Raised when the wire stream is malformed or truncated.
+
+    The message always names the absolute byte offset (into the
+    decompressed stream) at which decoding failed so a corrupt profile
+    can be triaged with a hex dump.
+    """
+
+
+# ---------------------------------------------------------------------------
+# wire-format primitives
+# ---------------------------------------------------------------------------
+
+_WIRE_VARINT = 0
+_WIRE_64BIT = 1
+_WIRE_LEN = 2
+_WIRE_32BIT = 5
+
+_GZIP_MAGIC = b"\x1f\x8b"
+
+
+def _read_varint(buf: bytes, pos: int, end: int) -> Tuple[int, int]:
+    """Decode one base-128 varint; returns (value, new_pos)."""
+    result = 0
+    shift = 0
+    start = pos
+    while True:
+        if pos >= end:
+            raise PprofParseError(
+                f"truncated varint at byte offset {start}")
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            return result, pos
+        shift += 7
+        if shift > 63:
+            raise PprofParseError(
+                f"varint wider than 64 bits at byte offset {start}")
+
+
+def _int64_signed(value: int) -> int:
+    """Reinterpret a 64-bit varint as two's-complement int64.
+
+    (pprof int64 fields are NOT zigzag on the wire — negative values are
+    sent as 10-byte two's-complement varints.)
+    """
+    if value >= 1 << 63:
+        value -= 1 << 64
+    return value
+
+
+def _iter_fields(buf: bytes, pos: int, end: int):
+    """Yield (field_number, wire_type, payload, value_offset) tuples.
+
+    ``payload`` is an int for varint fields, a ``(start, end)`` span
+    tuple for length-delimited fields, a bytes slice for fixed fields.
+    """
+    while pos < end:
+        key, pos = _read_varint(buf, pos, end)
+        field_no = key >> 3
+        wire = key & 0x7
+        if field_no == 0:
+            raise PprofParseError(
+                f"illegal field number 0 at byte offset {pos}")
+        if wire == _WIRE_VARINT:
+            val, pos = _read_varint(buf, pos, end)
+            yield field_no, wire, val, pos
+        elif wire == _WIRE_LEN:
+            length, pos = _read_varint(buf, pos, end)
+            if pos + length > end:
+                raise PprofParseError(
+                    f"length-delimited field overruns buffer at byte "
+                    f"offset {pos} (need {length} bytes, have {end - pos})")
+            yield field_no, wire, (pos, pos + length), pos
+            pos += length
+        elif wire == _WIRE_64BIT:
+            if pos + 8 > end:
+                raise PprofParseError(
+                    f"truncated fixed64 at byte offset {pos}")
+            yield field_no, wire, buf[pos:pos + 8], pos
+            pos += 8
+        elif wire == _WIRE_32BIT:
+            if pos + 4 > end:
+                raise PprofParseError(
+                    f"truncated fixed32 at byte offset {pos}")
+            yield field_no, wire, buf[pos:pos + 4], pos
+            pos += 4
+        else:
+            raise PprofParseError(
+                f"unsupported wire type {wire} at byte offset {pos}")
+
+
+def _decode_str(buf: bytes, span: Tuple[int, int], where: str) -> str:
+    try:
+        return bytes(buf[span[0]:span[1]]).decode("utf-8", "replace")
+    except Exception as exc:  # pragma: no cover - decode("replace") is total
+        raise PprofParseError(
+            f"undecodable {where} string at byte offset {span[0]}: {exc}")
+
+
+def _decode_packed_int64s(buf: bytes, span: Tuple[int, int],
+                          signed: bool) -> List[int]:
+    """Packed repeated varints (proto3 packs repeated scalars by default;
+    an unpacked encoder is still legal — the per-field decoders below
+    accept both)."""
+    out = []
+    pos, end = span
+    while pos < end:
+        v, pos = _read_varint(buf, pos, end)
+        out.append(_int64_signed(v) if signed else v)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# decoded model (string-typed fields hold STRING-TABLE INDICES — the
+# string table may follow the samples on the wire, so resolution happens
+# through Profile.string() after the whole message is decoded)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ValueType:
+    type: int = 0       # string-table index
+    unit: int = 0       # string-table index
+
+
+@dataclass
+class Label:
+    key: int = 0        # string-table index
+    str: int = 0        # string-table index (0 = unset)
+    num: int = 0
+    num_unit: int = 0   # string-table index
+
+
+@dataclass
+class Sample:
+    location_ids: List[int] = field(default_factory=list)
+    values: List[int] = field(default_factory=list)
+    labels: List[Label] = field(default_factory=list)
+
+
+@dataclass
+class Location:
+    id: int = 0
+    mapping_id: int = 0
+    address: int = 0
+    function_ids: List[int] = field(default_factory=list)  # leaf first
+
+
+@dataclass
+class Function:
+    id: int = 0
+    name: int = 0        # string-table index
+    system_name: int = 0
+    filename: int = 0
+    start_line: int = 0
+
+
+@dataclass
+class Profile:
+    sample_types: List[ValueType] = field(default_factory=list)
+    samples: List[Sample] = field(default_factory=list)
+    locations: Dict[int, Location] = field(default_factory=dict)
+    functions: Dict[int, Function] = field(default_factory=dict)
+    string_table: List[str] = field(default_factory=list)
+    time_nanos: int = 0
+    duration_nanos: int = 0
+    period_type: Optional[ValueType] = None
+    period: int = 0
+    default_sample_type: int = 0
+
+    # -------------------------------------------------------- resolution
+    def string(self, idx: int) -> str:
+        """String-table lookup; out-of-range indices resolve to '' (the
+        empty string is index 0 by pprof convention)."""
+        if 0 <= idx < len(self.string_table):
+            return self.string_table[idx]
+        return ""
+
+    def value_index(self, unit: str = "bytes") -> Optional[int]:
+        """Index into ``Sample.values`` of the sample type measured in
+        ``unit`` (the device-memory profile has ``count`` and ``bytes``).
+        None when no sample type carries that unit."""
+        for i, vt in enumerate(self.sample_types):
+            if self.string(vt.unit) == unit:
+                return i
+        return None
+
+    def sample_labels(self, sample: Sample) -> Dict[str, object]:
+        """Resolve a sample's labels to {key: str-or-int}."""
+        out = {}
+        for lb in sample.labels:
+            key = self.string(lb.key)
+            if not key:
+                continue
+            out[key] = self.string(lb.str) if lb.str else lb.num
+        return out
+
+    def sample_stack(self, sample: Sample) -> List[str]:
+        """Function names along the sample's location chain, leaf first.
+        Locations without line info contribute their address as hex."""
+        names = []
+        for loc_id in sample.location_ids:
+            loc = self.locations.get(loc_id)
+            if loc is None:
+                continue
+            if not loc.function_ids:
+                names.append(f"0x{loc.address:x}")
+                continue
+            for fid in loc.function_ids:
+                fn = self.functions.get(fid)
+                names.append(self.string(fn.name) if fn else "")
+        return names
+
+
+# ---------------------------------------------------------------------------
+# message decoders
+# ---------------------------------------------------------------------------
+
+def _decode_value_type(buf: bytes, span: Tuple[int, int]) -> ValueType:
+    vt = ValueType()
+    for fno, wire, payload, off in _iter_fields(buf, span[0], span[1]):
+        if fno == 1 and wire == _WIRE_VARINT:
+            vt.type = _int64_signed(payload)
+        elif fno == 2 and wire == _WIRE_VARINT:
+            vt.unit = _int64_signed(payload)
+    return vt
+
+
+def _decode_label(buf: bytes, span: Tuple[int, int]) -> Label:
+    lb = Label()
+    for fno, wire, payload, off in _iter_fields(buf, span[0], span[1]):
+        if fno == 1 and wire == _WIRE_VARINT:
+            lb.key = _int64_signed(payload)
+        elif fno == 2 and wire == _WIRE_VARINT:
+            lb.str = _int64_signed(payload)
+        elif fno == 3 and wire == _WIRE_VARINT:
+            lb.num = _int64_signed(payload)
+        elif fno == 4 and wire == _WIRE_VARINT:
+            lb.num_unit = _int64_signed(payload)
+    return lb
+
+
+def _decode_sample(buf: bytes, span: Tuple[int, int]) -> Sample:
+    s = Sample()
+    for fno, wire, payload, off in _iter_fields(buf, span[0], span[1]):
+        if fno == 1 and wire == _WIRE_LEN:
+            s.location_ids += _decode_packed_int64s(buf, payload,
+                                                    signed=False)
+        elif fno == 1 and wire == _WIRE_VARINT:     # unpacked encoder
+            s.location_ids.append(payload)
+        elif fno == 2 and wire == _WIRE_LEN:
+            s.values += _decode_packed_int64s(buf, payload, signed=True)
+        elif fno == 2 and wire == _WIRE_VARINT:
+            s.values.append(_int64_signed(payload))
+        elif fno == 3 and wire == _WIRE_LEN:
+            s.labels.append(_decode_label(buf, payload))
+    return s
+
+
+def _decode_line_function_id(buf: bytes, span: Tuple[int, int]) -> int:
+    fid = 0
+    for fno, wire, payload, off in _iter_fields(buf, span[0], span[1]):
+        if fno == 1 and wire == _WIRE_VARINT:
+            fid = payload
+    return fid
+
+
+def _decode_location(buf: bytes, span: Tuple[int, int]) -> Location:
+    loc = Location()
+    for fno, wire, payload, off in _iter_fields(buf, span[0], span[1]):
+        if fno == 1 and wire == _WIRE_VARINT:
+            loc.id = payload
+        elif fno == 2 and wire == _WIRE_VARINT:
+            loc.mapping_id = payload
+        elif fno == 3 and wire == _WIRE_VARINT:
+            loc.address = payload
+        elif fno == 4 and wire == _WIRE_LEN:
+            loc.function_ids.append(
+                _decode_line_function_id(buf, payload))
+    return loc
+
+
+def _decode_function(buf: bytes, span: Tuple[int, int]) -> Function:
+    fn = Function()
+    for fno, wire, payload, off in _iter_fields(buf, span[0], span[1]):
+        if fno == 1 and wire == _WIRE_VARINT:
+            fn.id = payload
+        elif fno == 2 and wire == _WIRE_VARINT:
+            fn.name = _int64_signed(payload)
+        elif fno == 3 and wire == _WIRE_VARINT:
+            fn.system_name = _int64_signed(payload)
+        elif fno == 4 and wire == _WIRE_VARINT:
+            fn.filename = _int64_signed(payload)
+        elif fno == 5 and wire == _WIRE_VARINT:
+            fn.start_line = _int64_signed(payload)
+    return fn
+
+
+def parse_profile(data: bytes) -> Profile:
+    """Decode a serialized pprof Profile from memory.
+
+    Accepts both the gzip envelope ``device_memory_profile`` returns and
+    a bare serialized Profile (the two are distinguished by the gzip
+    magic, not by trial decompression).
+    """
+    if data[:2] == _GZIP_MAGIC:
+        try:
+            data = gzip.decompress(data)
+        except Exception as exc:
+            raise PprofParseError(f"corrupt gzip envelope: {exc}")
+    prof = Profile()
+    for fno, wire, payload, off in _iter_fields(data, 0, len(data)):
+        if fno == 1 and wire == _WIRE_LEN:
+            prof.sample_types.append(_decode_value_type(data, payload))
+        elif fno == 2 and wire == _WIRE_LEN:
+            prof.samples.append(_decode_sample(data, payload))
+        elif fno == 4 and wire == _WIRE_LEN:
+            loc = _decode_location(data, payload)
+            prof.locations[loc.id] = loc
+        elif fno == 5 and wire == _WIRE_LEN:
+            fn = _decode_function(data, payload)
+            prof.functions[fn.id] = fn
+        elif fno == 6 and wire == _WIRE_LEN:
+            prof.string_table.append(_decode_str(data, payload,
+                                                 "string table"))
+        elif fno == 9 and wire == _WIRE_VARINT:
+            prof.time_nanos = _int64_signed(payload)
+        elif fno == 10 and wire == _WIRE_VARINT:
+            prof.duration_nanos = _int64_signed(payload)
+        elif fno == 11 and wire == _WIRE_LEN:
+            prof.period_type = _decode_value_type(data, payload)
+        elif fno == 12 and wire == _WIRE_VARINT:
+            prof.period = _int64_signed(payload)
+        elif fno == 14 and wire == _WIRE_VARINT:
+            prof.default_sample_type = _int64_signed(payload)
+    return prof
+
+
+def parse_profile_file(path: str) -> Profile:
+    with open(path, "rb") as f:
+        return parse_profile(f.read())
+
+
+# ---------------------------------------------------------------------------
+# device-memory summaries
+# ---------------------------------------------------------------------------
+
+def live_bytes_by_kind(profile: Profile) -> Dict[str, int]:
+    """Total live bytes per ``kind`` label (``buffer`` holds array
+    allocations, ``executable`` compiled programs; unlabeled samples land
+    under ``(unlabeled)``). Empty dict when the profile carries no
+    bytes-typed sample values."""
+    bi = profile.value_index("bytes")
+    if bi is None:
+        return {}
+    out: Dict[str, int] = {}
+    for s in profile.samples:
+        if bi >= len(s.values):
+            continue
+        kind = profile.sample_labels(s).get("kind") or "(unlabeled)"
+        out[kind] = out.get(kind, 0) + s.values[bi]
+    return out
+
+
+def summarize_samples(profile: Profile, top: int = 10) -> List[dict]:
+    """The ``top`` largest samples by bytes: {bytes, count, kind, device,
+    stack} — the forensics view the observatory embeds in its report."""
+    bi = profile.value_index("bytes")
+    ci = profile.value_index("count")
+    if bi is None:
+        return []
+    rows = []
+    for s in profile.samples:
+        if bi >= len(s.values):
+            continue
+        labels = profile.sample_labels(s)
+        rows.append({
+            "bytes": s.values[bi],
+            "count": (s.values[ci]
+                      if ci is not None and ci < len(s.values) else None),
+            "kind": labels.get("kind") or "(unlabeled)",
+            "device": labels.get("device"),
+            "stack": profile.sample_stack(s)[:4],
+        })
+    rows.sort(key=lambda r: -r["bytes"])
+    return rows[:top]
+
+
+def fetch_device_memory_profile() -> bytes:
+    """The one deliberately jax-touching helper: fetch the live pprof
+    profile from the backend (gzip bytes). Host-side runtime query — no
+    compilation, no device compute — but NOT free; callers fetch at
+    cadence only. Raises whatever jax raises when no backend exists."""
+    import jax.profiler
+    return jax.profiler.device_memory_profile()
+
+
+def _main(argv=None):  # pragma: no cover - thin debugging CLI
+    import argparse
+    import json
+    p = argparse.ArgumentParser(
+        prog="python -m deepspeed_tpu.telemetry.pprof",
+        description="Decode a pprof device-memory profile "
+                    "(.pb / .pb.gz) and print a summary.")
+    p.add_argument("path")
+    p.add_argument("--top", type=int, default=10)
+    args = p.parse_args(argv)
+    prof = parse_profile_file(args.path)
+    print(json.dumps({
+        "sample_types": [(prof.string(v.type), prof.string(v.unit))
+                         for v in prof.sample_types],
+        "samples": len(prof.samples),
+        "live_bytes_by_kind": live_bytes_by_kind(prof),
+        "top": summarize_samples(prof, args.top),
+    }, indent=1))
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    import sys
+    sys.exit(_main())

@@ -87,6 +87,7 @@ def _per_span_us(tracer, iters):
 def _tiny_engine(ce_enabled, health_enabled=False, goodput_enabled=False,
                  prefetch_enabled=False, comm_overlap=False,
                  fleet_enabled=False, guardian_enabled=False,
+                 memory_enabled=False, memory_cadence=0,
                  steps_per_print=10 ** 9):
     import tempfile
 
@@ -127,6 +128,8 @@ def _tiny_engine(ce_enabled, health_enabled=False, goodput_enabled=False,
                               "health": {"enabled": health_enabled},
                               "goodput": {"enabled": goodput_enabled,
                                           "profiler_capture": False},
+                              "memory": {"enabled": memory_enabled,
+                                         "cadence": memory_cadence},
                               "fleet": fleet_cfg}},
         sample_batch=batch)
     return engine, batch
@@ -690,6 +693,112 @@ def check_anatomy_inert(steps=5):
           f"({total * 1e3:.2f} / {wall * 1e3:.2f} ms)")
 
 
+def check_memory_zero_extra_compiles(steps=20, cadence=5):
+    """ISSUE-16 acceptance guard: the HBM residency observatory ARMED
+    (cost explorer feeding it the pre-flight watermark) over a 20-step
+    run adds exactly ZERO train-step compiles — the profile fetch is a
+    host RPC into the runtime's allocator bookkeeping, never a program
+    change — and the monitor observes windows only at the cadence (no
+    per-step fetch crept in)."""
+    engine, batch = _tiny_engine(ce_enabled=True, memory_enabled=True,
+                                 memory_cadence=cadence)
+    mon = engine._memory
+    assert mon is not None, "memory observatory must be armed"
+    engine.train_batch(batch=batch)       # the one compile
+    after_prime = _backend_compiles(engine)
+    for _ in range(steps - 1):
+        engine.train_batch(batch=batch)
+    after_steps = _backend_compiles(engine)
+    assert after_steps == after_prime, (
+        f"armed memory observatory changed compilation: "
+        f"{after_prime} -> {after_steps} over {steps} steps — the "
+        f"residency fetch must never touch the step programs")
+    expected = steps // cadence
+    assert mon.windows_seen == expected, (
+        f"memory windows observed {mon.windows_seen}x over {steps} "
+        f"steps; the cadence-{cadence} path must fetch exactly "
+        f"{expected}x — a per-step profile fetch crept in")
+    assert mon.last_attribution is not None
+    cats = mon.last_attribution["categories"]
+    total = mon.last_attribution["live_total_bytes"]
+    assert sum(c["bytes"] for c in cats.values()) == total, (
+        "category attribution must re-add exactly to the live total")
+    assert mon.predicted_bytes and mon.prediction_source, (
+        "cost explorer armed — the pre-flight watermark prediction "
+        "must be wired into the monitor")
+    snap = engine.telemetry.registry.snapshot()
+    assert "memory_live_bytes" in snap and "memory_peak_bytes" in snap
+    print(f"memory path: 0 extra compiles over {steps} steps, "
+          f"{mon.windows_seen} cadence windows, verdict "
+          f"{mon.verdict()!r}, drift {mon.drift()}")
+
+
+def check_memory_disabled_inert(steps=3):
+    """memory off (the default) => no monitor object, no memory gauges,
+    and the pprof / memory_observatory modules are never imported — the
+    disabled path must not even load the parser."""
+    for mod in ("deepspeed_tpu.telemetry.pprof",
+                "deepspeed_tpu.telemetry.memory_observatory"):
+        sys.modules.pop(mod, None)
+    engine, batch = _tiny_engine(ce_enabled=False)
+    assert engine._memory is None
+    assert engine.telemetry.memory is None
+    for _ in range(steps):
+        engine.train_batch(batch=batch)
+    assert engine.memory_report() == {"enabled": False}
+    snap = engine.telemetry.registry.snapshot()
+    for name in ("memory_live_bytes", "memory_peak_bytes",
+                 "memory_anomalies_total"):
+        assert name not in snap, f"unexpected metric {name} while disabled"
+    for mod in ("deepspeed_tpu.telemetry.pprof",
+                "deepspeed_tpu.telemetry.memory_observatory"):
+        assert mod not in sys.modules, (
+            f"{mod} was imported during engine init/steps — the disabled "
+            f"memory path must never load the parser")
+    print("disabled memory path: no monitor, no gauges, parser unloaded")
+
+
+def check_memory_obs_no_device_access():
+    """The memory observatory must stay PURE HOST bookkeeping — the same
+    static guard the serving observatory and fleet recorder carry: no
+    jax import anywhere in memory_observatory.py outside the CLI demo,
+    and none in pprof.py outside ``fetch_device_memory_profile`` (the
+    one deliberate jax touchpoint) and the CLI."""
+    import ast
+
+    import deepspeed_tpu.telemetry.memory_observatory as mem_mod
+    import deepspeed_tpu.telemetry.pprof as pprof_mod
+
+    def jax_imports(node):
+        found = []
+        for n in ast.walk(node):
+            if isinstance(n, ast.Import):
+                found += [a.name for a in n.names
+                          if a.name.split(".")[0] == "jax"]
+            elif isinstance(n, ast.ImportFrom) and \
+                    (n.module or "").split(".")[0] == "jax":
+                found.append(n.module)
+        return found
+
+    for mod, allowed in ((mem_mod, ("_demo", "main")),
+                         (pprof_mod, ("fetch_device_memory_profile",
+                                      "_main"))):
+        with open(mod.__file__) as f:
+            tree = ast.parse(f.read())
+        offenders = []
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name in allowed:
+                continue
+            offenders += jax_imports(node)
+        assert not offenders, (
+            f"{os.path.basename(mod.__file__)} imports jax outside "
+            f"{allowed} ({offenders}) — the observatory must stay "
+            f"host-only so it cannot add device syncs")
+    print("memory observatory: statically host-only (jax only in the "
+          "CLI demo / profile fetcher)")
+
+
 def check_guardian_armed_zero_overhead(steps=20, cadence=5):
     """ISSUE-13 acceptance guard: guardian ARMED (with health feeding
     it) on a healthy run — still exactly ONE train-step compile over 20
@@ -811,6 +920,9 @@ def main(iters=200_000):
     check_fleet_zero_extra_compiles()
     check_fleet_disabled_inert()
     check_anatomy_inert()
+    check_memory_zero_extra_compiles()
+    check_memory_disabled_inert()
+    check_memory_obs_no_device_access()
     check_guardian_armed_zero_overhead()
     check_guardian_disabled_inert()
     print("OK")

@@ -1,0 +1,581 @@
+"""HBM residency observatory tests — attribution, rules, engine glue.
+
+Host-side invariants run with no device programs at all (the monitor is
+pure bookkeeping; synthetic samples drive ``observe`` directly): the
+exact-sum category/bucket attribution, rule arming after warmup with
+hysteresis, warn-once escalation with the throttled snapshot, and the
+host-RSS budget refusal. The end-to-end tests drive a real engine with
+``telemetry.memory`` armed at cadence 1 and pin the acceptance
+behaviours: per-category AND per-bucket bytes re-adding EXACTLY to the
+profile's live total, bucket provenance through the PR-3
+``build_bucket_spec`` names, a measured-vs-predicted drift grounded in
+the PR-2 pre-flight, exactly one train-step compile, the serving KV
+gauges reading the allocator's own numbers, and the autotuner probes
+recording the measured drift (the TUNE_REPORT satellite).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.models.simple import SimpleModel, sample_batch
+from deepspeed_tpu.telemetry.health import build_bucket_spec
+from deepspeed_tpu.telemetry.memory_observatory import (CATEGORIES,
+                                                        MEMORY_SCHEMA,
+                                                        MemoryMonitor,
+                                                        attribute_buckets,
+                                                        attribute_live_bytes,
+                                                        profile_sample,
+                                                        render)
+from deepspeed_tpu.telemetry.metrics import MetricsRegistry
+from deepspeed_tpu.utils import groups
+
+PPROF_FIXTURE = os.path.join(os.path.dirname(__file__), "data",
+                             "tiny_memory.pprof.pb.gz")
+
+
+# ------------------------------------------------------ exact attribution
+
+class TestAttributeLiveBytes:
+    def test_exact_sum_with_remainder(self):
+        att = attribute_live_bytes(
+            1000, {"params": 300, "optimizer_state": 400, "kv_pool": 100},
+            executable_bytes=50)
+        cats = att["categories"]
+        assert tuple(cats) == CATEGORIES
+        assert sum(c["bytes"] for c in cats.values()) == 1000
+        assert cats["params"]["bytes"] == 300
+        assert cats["optimizer_state"]["bytes"] == 400
+        assert cats["kv_pool"]["bytes"] == 100
+        assert cats["other"]["bytes"] == 50
+        assert cats["activations_workspace"]["bytes"] == 150
+        assert cats["activations_workspace"]["expected_bytes"] is None
+        assert all(c["shortfall_bytes"] == 0 for c in cats.values())
+
+    def test_capping_records_shortfall_not_drift(self):
+        # profile smaller than the engine's own accounting: the walk caps
+        # in declaration order and records the miss explicitly
+        att = attribute_live_bytes(500, {"params": 300,
+                                         "optimizer_state": 400})
+        cats = att["categories"]
+        assert sum(c["bytes"] for c in cats.values()) == 500
+        assert cats["params"]["bytes"] == 300
+        assert cats["optimizer_state"]["bytes"] == 200
+        assert cats["optimizer_state"]["shortfall_bytes"] == 200
+        assert cats["activations_workspace"]["bytes"] == 0
+
+    def test_zero_total_and_negative_inputs(self):
+        att = attribute_live_bytes(-5, {"params": -10})
+        assert att["live_total_bytes"] == 0
+        assert sum(c["bytes"]
+                   for c in att["categories"].values()) == 0
+
+    def test_empty_inventory_is_all_workspace(self):
+        att = attribute_live_bytes(777, {})
+        assert att["categories"]["activations_workspace"]["bytes"] == 777
+
+
+class TestAttributeBuckets:
+    def test_exact_sum(self):
+        out = attribute_buckets(700, {"Dense_0": 300, "Dense_1": 400})
+        assert out == {"Dense_0": 300, "Dense_1": 400}
+        assert sum(out.values()) == 700
+
+    def test_surplus_lands_in_other(self):
+        out = attribute_buckets(1000, {"Dense_0": 300})
+        assert out == {"Dense_0": 300, "(other)": 700}
+
+    def test_capping_preserves_order_priority(self):
+        out = attribute_buckets(350, {"a": 300, "b": 400})
+        assert out == {"a": 300, "b": 50}
+
+    def test_existing_other_bucket_merges(self):
+        out = attribute_buckets(100, {"(other)": 40})
+        assert out == {"(other)": 100}
+
+
+def test_profile_sample_from_real_capture():
+    """The committed pprof fixture (a real CPU-jax capture) flows
+    through the sample builder: buffer/executable split, total, count."""
+    with open(PPROF_FIXTURE, "rb") as f:
+        sample = profile_sample(f.read())
+    assert sample["source"] == "jax.profiler.device_memory_profile"
+    assert sample["buffer_bytes"] > 0
+    assert sample["live_total_bytes"] == (sample["buffer_bytes"]
+                                          + sample["executable_bytes"])
+    assert sample["buffer_count"] > 0
+    assert sample["top_samples"] and len(sample["top_samples"]) <= 8
+
+
+# --------------------------------------------------------------- monitor
+
+def _mon(tmp_path=None, **kw):
+    logs = []
+    kw.setdefault("warmup_windows", 0)
+    kw.setdefault("leak_windows", 3)
+    if tmp_path is not None:
+        kw.setdefault("snapshot_path", str(tmp_path / "MEMORY_HEALTH.json"))
+    else:
+        kw.setdefault("snapshot_path", os.devnull)
+    m = MemoryMonitor(log_fn=lambda msg, *a: logs.append(msg % a), **kw)
+    m._test_logs = logs
+    return m
+
+
+def _s(step, live, **over):
+    s = {"step": step, "live_total_bytes": live, "executable_bytes": 0,
+         "buffer_count": 4, "inventory": {}}
+    s.update(over)
+    return s
+
+
+class TestMonitorRules:
+    def test_leak_fires_on_strict_monotone_growth(self):
+        m = _mon(leak_windows=3)
+        for i, live in enumerate((100, 200, 300)):
+            assert m.observe(_s(i, live)) == []   # ring not full yet
+        anoms = m.observe(_s(3, 400))
+        assert [a["rule"] for a in anoms] == ["hbm_leak"]
+        assert anoms[0]["severity"] == "warning"
+        # still growing: edge-triggered, no second firing until re-armed
+        assert m.observe(_s(4, 500)) == []
+        # a non-growth window re-arms, then a full monotone ring refires
+        assert m.observe(_s(5, 500)) == []
+        for i, live in enumerate((600, 700, 800), start=6):
+            anoms = m.observe(_s(i, live))
+        assert [a["rule"] for a in anoms] == ["hbm_leak"]
+        assert m.rule_counts["hbm_leak"] == 2
+
+    def test_flat_usage_never_leaks(self):
+        m = _mon(leak_windows=2)
+        for i in range(10):
+            assert m.observe(_s(i, 1000)) == []
+        assert m.verdict() == "healthy"
+
+    def test_warmup_gates_leak_and_drift(self):
+        m = _mon(warmup_windows=4, leak_windows=2, drift_threshold=0.1)
+        m.set_prediction(100, source="test")
+        for i, live in enumerate((100, 200, 300, 400)):   # all warmup
+            assert m.observe(_s(i, live)) == []
+        anoms = m.observe(_s(4, 500))
+        assert {a["rule"] for a in anoms} == {"hbm_leak",
+                                              "watermark_drift"}
+
+    def test_drift_fires_both_directions_with_hysteresis(self):
+        m = _mon(drift_threshold=0.25)
+        m.set_prediction(1000, source="cost_explorer.preflight")
+        anoms = m.observe(_s(0, 2000))        # +100% over
+        assert [a["rule"] for a in anoms] == ["watermark_drift"]
+        assert anoms[0]["drift"] == 1.0
+        assert "above" in anoms[0]["detail"]
+        assert m.observe(_s(1, 2000)) == []   # still drifted: hysteresis
+        # peak never decays, so under-prediction needs a fresh monitor
+        m2 = _mon(drift_threshold=0.25)
+        m2.set_prediction(1000, source="cost_explorer.preflight")
+        anoms = m2.observe(_s(0, 500))        # -50% under
+        assert [a["rule"] for a in anoms] == ["watermark_drift"]
+        assert "below" in anoms[0]["detail"]
+
+    def test_no_prediction_no_drift(self):
+        m = _mon(drift_threshold=0.01)
+        assert m.drift() is None
+        assert m.observe(_s(0, 10 ** 9)) == []
+
+    def test_kv_fragmentation_reads_allocator_numbers(self):
+        m = _mon(frag_threshold=0.5)
+        kv = {"pool_bytes": 4096, "free_blocks": 1, "usable_blocks": 8,
+              "fragmentation": 0.75}
+        anoms = m.observe(_s(0, 100, kv=kv))
+        assert [a["rule"] for a in anoms] == ["kv_fragmentation"]
+        assert anoms[0]["fragmentation"] == 0.75
+        assert m.observe(_s(1, 100, kv=kv)) == []          # hysteresis
+        kv_ok = dict(kv, fragmentation=0.1)
+        assert m.observe(_s(2, 100, kv=kv_ok)) == []       # re-arms
+        anoms = m.observe(_s(3, 100, kv=kv))
+        assert [a["rule"] for a in anoms] == ["kv_fragmentation"]
+
+    def test_oom_risk_is_critical_and_skips_warmup(self):
+        m = _mon(warmup_windows=100, budget_bytes=1000, headroom=0.9)
+        anoms = m.observe(_s(0, 950))
+        assert [a["rule"] for a in anoms] == ["oom_risk"]
+        assert anoms[0]["severity"] == "critical"
+        assert m.verdict() == "critical"
+        assert m.observe(_s(1, 960)) == []     # hysteresis
+        assert m.observe(_s(2, 100)) == []     # back under: re-arms
+        anoms = m.observe(_s(3, 999))
+        assert [a["rule"] for a in anoms] == ["oom_risk"]
+
+    def test_host_budget_refused_warn_once(self):
+        m = _mon()
+        m.refuse_host_budget("host_rss")
+        m.refuse_host_budget("host_rss")
+        assert len(m._test_logs) == 1
+        assert "host_rss" in m._test_logs[0]
+        assert m.budget_bytes is None          # oom_risk stays disarmed
+        m.observe(_s(0, 10 ** 12))
+        assert m.verdict() == "healthy"
+        assert m.report()["budget"]["host_budget_refused"] is True
+
+    def test_explicit_budget_survives_refusal(self):
+        m = _mon(budget_bytes=500)
+        assert m.budget_source == "config"
+        m.refuse_host_budget()
+        assert m.budget_bytes == 500           # config budget still armed
+
+    def test_verdict_tiers(self):
+        m = _mon()
+        assert m.verdict() == "unknown"
+        m.observe(_s(0, 100))
+        assert m.verdict() == "healthy"
+        m.set_prediction(1, source="t")
+        m.observe(_s(1, 100))                  # drift fires: warning
+        assert m.verdict() == "warning"
+        m.set_budget(50, source="t")
+        m.observe(_s(2, 100))                  # oom fires: critical wins
+        assert m.verdict() == "critical"
+
+    def test_snapshot_written_on_first_firing_only_then_throttled(
+            self, tmp_path):
+        m = _mon(tmp_path, drift_threshold=0.25)
+        m.set_prediction(1000, source="t")
+        m.observe(_s(0, 2000))                 # first firing: forced write
+        assert m._snapshots_written == 1
+        doc = json.load(open(str(tmp_path / "MEMORY_HEALTH.json")))
+        assert doc["schema"] == MEMORY_SCHEMA
+        assert doc["verdict"] == "warning"
+        assert doc["counters"]["anomaly_counts"] == {"watermark_drift": 1}
+        # drop under, refire: a REPEAT of a known rule rides the throttle
+        m._drift_active = False
+        m.observe(_s(1, 2000))
+        assert m.rule_counts["watermark_drift"] == 2
+        assert m._snapshots_written == 1
+        assert len(m._test_logs) == 1          # warn-once per rule
+
+    def test_close_snapshots_only_with_anomalies(self, tmp_path):
+        clean = _mon(tmp_path)
+        clean.observe(_s(0, 100))
+        clean.close()
+        assert not os.path.exists(str(tmp_path / "MEMORY_HEALTH.json"))
+
+    def test_anomaly_history_bounded(self):
+        m = _mon(budget_bytes=100, headroom=0.5)
+        for i in range(250):
+            m.observe(_s(i, 1000 if i % 2 else 10))   # toggling oom
+        assert len(m.anomalies) <= MemoryMonitor.MAX_ANOMALY_HISTORY
+        assert m.rule_counts["oom_risk"] > \
+            MemoryMonitor.MAX_ANOMALY_HISTORY / 2
+
+    def test_anomaly_counter_reaches_registry(self):
+        reg = MetricsRegistry()
+        m = _mon(budget_bytes=100, registry=reg)
+        m.observe(_s(0, 99))
+        rows = reg.snapshot()["memory_anomalies_total"]
+        assert [(r["labels"], r["value"]) for r in rows] == \
+            [({"rule": "oom_risk"}, 1)]
+
+    def test_on_hooks_fire_and_failures_are_contained(self):
+        seen = {}
+        m = _mon(budget_bytes=100,
+                 on_escalate=lambda: seen.setdefault("esc", True),
+                 on_anomaly=lambda a: 1 / 0)   # must not kill the step
+        anoms = m.observe(_s(0, 99))
+        assert anoms and seen == {"esc": True}
+
+    def test_report_schema_and_ring(self):
+        m = _mon(ring_size=4)
+        for i in range(6):
+            m.observe(_s(i, 105 if i == 5 else 100,
+                         inventory={"params": 50},
+                         param_buckets={"Dense_0": 50}))
+        rep = m.report()
+        assert rep["schema"] == MEMORY_SCHEMA
+        for key in ("verdict", "categories", "buckets", "watermark",
+                    "budget", "rules", "counters", "top_samples",
+                    "anomalies", "ring"):
+            assert key in rep, f"report lost key {key}"
+        assert rep["counters"]["windows_seen"] == 6
+        assert len(rep["ring"]) == 4           # bounded
+        assert rep["ring"][-1]["live_total_bytes"] == 105
+        assert rep["buckets"]["params"] == {"Dense_0": 50}
+        txt = render(rep)
+        assert "memory verdict: HEALTHY" in txt
+        assert "params" in txt
+
+    def test_from_config_joins_relative_paths(self, tmp_path):
+        class C:
+            memory_snapshot_file = ""
+            memory_report_file = str(tmp_path / "abs" / "R.json")
+            memory_leak_windows = 5
+            memory_warmup_windows = 1
+            memory_drift_threshold = 0.1
+            memory_frag_threshold = 0.9
+            memory_headroom = 0.8
+            memory_budget_bytes = 123
+            memory_ring_size = 7
+
+        m = MemoryMonitor.from_config(C(), output_path=str(tmp_path),
+                                      job_name="j")
+        assert m.snapshot_path == str(tmp_path / "MEMORY_HEALTH.json")
+        assert m.report_path == str(tmp_path / "abs" / "R.json")
+        assert (m.leak_windows, m.warmup_windows) == (5, 1)
+        assert m.budget_bytes == 123 and m.budget_source == "config"
+        assert m.ring.maxlen == 7
+
+    def test_write_report_unthrottled(self, tmp_path):
+        m = _mon(tmp_path, report_path=str(tmp_path / "MA.json"))
+        m.observe(_s(0, 10))
+        for _ in range(3):
+            assert m.write_report() == str(tmp_path / "MA.json")
+        doc = json.load(open(str(tmp_path / "MA.json")))
+        assert doc["live_total_bytes"] == 10
+
+
+# ---------------------------------------------------------- engine glue
+
+def _mem_config(tmp_path, cadence=1, **mem_over):
+    mem = {"enabled": True, "cadence": cadence, "warmup_windows": 0}
+    mem.update(mem_over)
+    return {
+        "train_batch_size": 16,
+        "steps_per_print": 10 ** 9,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "telemetry": {"enabled": True, "trace": False, "jsonl": False,
+                      "prometheus": False,
+                      "output_path": str(tmp_path),
+                      "cost_explorer": {"enabled": True},
+                      "memory": mem},
+    }
+
+
+def _make_engine(config, hidden=32, nlayers=2):
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=hidden, nlayers=nlayers),
+        config=config, sample_batch=sample_batch(2, hidden), seed=42)
+    return engine
+
+
+def _run_steps(engine, n, hidden=32, bs=16):
+    rng = np.random.default_rng(0)
+    for _ in range(n):
+        x = rng.standard_normal((bs, hidden)).astype(np.float32)
+        y = rng.standard_normal((bs, hidden)).astype(np.float32)
+        engine.train_batch(batch=(x, y))
+
+
+class TestEngineMemory:
+    def test_e2e_exact_attribution_and_provenance(self, tmp_path):
+        """THE acceptance criterion: armed observatory, real profile,
+        per-category and per-bucket bytes re-add EXACTLY to the live
+        total, buckets carry the PR-3 spec names, the drift is grounded
+        in the PR-2 pre-flight, and the run compiled ONE train step."""
+        engine = _make_engine(_mem_config(tmp_path))
+        mon = engine.telemetry.memory
+        assert mon is not None and engine._memory is mon
+        _run_steps(engine, 6)
+        rep = engine.memory_report()
+        assert rep["schema"] == MEMORY_SCHEMA
+        total = rep["live_total_bytes"]
+        assert total > 0
+        assert sum(c["bytes"] for c in rep["categories"].values()) == total
+        assert rep["categories"]["params"]["bytes"] > 0
+        for cat in ("params", "optimizer_state"):
+            assert sum(rep["buckets"][cat].values()) == \
+                rep["categories"][cat]["bytes"], f"{cat} buckets drifted"
+        spec_names = set(build_bucket_spec(engine.state.params).names)
+        named = set(rep["buckets"]["params"]) - {"(other)"}
+        assert named and named <= spec_names, (
+            f"param buckets {named} are not PR-3 spec names {spec_names}")
+        wm = rep["watermark"]
+        assert wm["prediction_source"] == "cost_explorer.preflight"
+        assert wm["predicted_bytes"] > 0
+        assert wm["drift"] is not None and wm["drift"] != 0
+        assert mon.windows_seen >= 6
+        snap = engine.telemetry.registry.snapshot()
+        compiles = {tuple(r["labels"].items()): r["value"]
+                    for r in snap["xla_compiles_total"]}
+        assert compiles[(("fn", "fused_train_step"),)] == 1
+        cats = {r["labels"]["category"]: r["value"]
+                for r in snap["memory_live_bytes"]}
+        assert set(cats) == set(CATEGORIES)
+        assert "memory_peak_bytes" in snap
+
+    def test_report_write_lands_in_output_path(self, tmp_path):
+        engine = _make_engine(_mem_config(tmp_path))
+        _run_steps(engine, 2)
+        rep = engine.memory_report(write=True)
+        out = tmp_path / "MEMORY_ANATOMY.json"
+        assert out.exists(), "report must land in telemetry.output_path"
+        doc = json.load(open(str(out)))
+        assert doc["live_total_bytes"] == rep["live_total_bytes"]
+
+    def test_cadence_gates_fetches(self, tmp_path):
+        engine = _make_engine(_mem_config(tmp_path, cadence=3))
+        _run_steps(engine, 9)
+        assert engine.telemetry.memory.windows_seen == 3
+
+    def test_disabled_path_inert(self, tmp_path):
+        cfg = _mem_config(tmp_path)
+        cfg["telemetry"]["memory"] = {"enabled": False}
+        engine = _make_engine(cfg)
+        assert engine._memory is None
+        assert engine.telemetry.memory is None
+        _run_steps(engine, 3)
+        assert engine.memory_report() == {"enabled": False}
+        snap = engine.telemetry.registry.snapshot()
+        assert "memory_live_bytes" not in snap
+        assert not (tmp_path / "MEMORY_ANATOMY.json").exists()
+        assert not (tmp_path / "MEMORY_HEALTH.json").exists()
+
+    def test_env_flag_arms_the_observatory(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("DS_TELEMETRY_MEMORY", "1")
+        cfg = _mem_config(tmp_path)
+        del cfg["telemetry"]["memory"]
+        engine = _make_engine(cfg)
+        assert engine.telemetry.memory is not None
+
+    def test_host_rss_budget_refused_on_cpu(self, tmp_path):
+        """CPU backends have no allocator bytes_limit: the budget
+        detection must record the refusal instead of treating process
+        RSS as an HBM budget (satellite 1)."""
+        engine = _make_engine(_mem_config(tmp_path))
+        _run_steps(engine, 2)
+        rep = engine.memory_report()
+        assert rep["budget"]["bytes"] is None
+        assert rep["budget"]["host_budget_refused"] is True
+
+
+# -------------------------------------------- satellite: gauge source label
+
+class TestDeviceMemoryGaugeSource:
+    def _manager(self, tmp_path, stats, monkeypatch):
+        from deepspeed_tpu.telemetry import manager as mgr_mod
+        from deepspeed_tpu.runtime.config import DeepSpeedTelemetryConfig
+        monkeypatch.setattr(mgr_mod, "device_memory_stats", lambda: stats)
+        cfg = DeepSpeedTelemetryConfig(
+            {"telemetry": {"enabled": True, "trace": False, "jsonl": False,
+                           "prometheus": False,
+                           "output_path": str(tmp_path)}})
+        return mgr_mod.TelemetryManager(cfg)
+
+    def test_device_source_publishes_as_hbm(self, tmp_path, monkeypatch):
+        tm = self._manager(tmp_path, {"source": "device",
+                                      "bytes_in_use": 5,
+                                      "bytes_limit": 10}, monkeypatch)
+        tm.publish_device_memory()
+        rows = tm.registry.snapshot()["device_memory_bytes_in_use"]
+        assert [r["labels"] for r in rows] == [{"source": "hbm"}]
+
+    def test_host_fallback_keeps_its_name(self, tmp_path, monkeypatch):
+        tm = self._manager(tmp_path, {"source": "host_rss",
+                                      "rss": 123}, monkeypatch)
+        tm.publish_device_memory()
+        rows = tm.registry.snapshot()["device_memory_rss"]
+        assert [r["labels"] for r in rows] == [{"source": "host_rss"}]
+
+
+class TestAutotunerBudgetRefusal:
+    def test_host_rss_never_becomes_hbm_budget(self, monkeypatch):
+        import deepspeed_tpu.autotuning.autotuner as at
+        from deepspeed_tpu.telemetry import cost_explorer, metrics
+        monkeypatch.setattr(cost_explorer, "device_hbm_bytes", lambda: 0)
+        monkeypatch.setattr(metrics, "device_memory_stats",
+                            lambda: {"source": "host_rss", "rss": 1 << 40})
+        monkeypatch.setattr(at, "_WARNED_HOST_BUDGET", False)
+        assert at.Autotuner._detect_device_memory() == 16 << 30
+
+    def test_real_device_limit_is_accepted(self, monkeypatch):
+        import deepspeed_tpu.autotuning.autotuner as at
+        from deepspeed_tpu.telemetry import cost_explorer, metrics
+        monkeypatch.setattr(cost_explorer, "device_hbm_bytes", lambda: 0)
+        monkeypatch.setattr(metrics, "device_memory_stats",
+                            lambda: {"source": "device",
+                                     "bytes_limit": 7 << 30})
+        assert at.Autotuner._detect_device_memory() == 7 << 30
+
+
+# ------------------------------------------------ satellite: serving gauges
+
+class TestServingMemory:
+    def test_kv_gauges_read_allocator_numbers(self, tmp_path):
+        from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+        from deepspeed_tpu.serving.server import ServingEngine
+        groups.destroy()
+        groups.initialize()
+        cfg = GPT2Config(vocab_size=256, n_positions=64, n_embd=32,
+                         n_layer=2, n_head=2)
+        model = GPT2LMHeadModel(cfg)
+        params = model.init(
+            jax.random.PRNGKey(0),
+            {"input_ids": jnp.zeros((1, 8), jnp.int32)})["params"]
+        eng = deepspeed_tpu.init_inference(model, params=params,
+                                           dtype=jnp.float32)
+        registry = MetricsRegistry()
+        srv = ServingEngine(
+            eng, config={"max_batch": 2, "block_size": 8,
+                         "observability": {
+                             "enabled": True, "window": 4,
+                             "snapshot_file":
+                                 str(tmp_path / "SERVING_HEALTH.json")}},
+            registry=registry)
+        rng = np.random.default_rng(0)
+        srv.submit(rng.integers(0, 256, (12,)).astype(np.int32),
+                   max_new_tokens=4)
+        srv.serve_forever()
+        snap = registry.snapshot()
+        alloc = srv.cache.allocator
+        (free,) = snap["serving_kv_free_blocks"]
+        assert free["value"] == alloc.num_free
+        (frag,) = snap["serving_kv_fragmentation"]
+        assert frag["value"] == srv._kv_fragmentation()
+        # the report books the SAME allocator numbers (one source of
+        # truth for the observatory's kv_fragmentation rule)
+        kv = srv.serving_report()["engine_state"]["kv"]
+        assert kv["free"] == alloc.num_free
+        assert kv["fragmentation"] == round(srv._kv_fragmentation(), 4)
+        assert kv["pool_bytes"] == srv.cache.pool_bytes()
+
+
+# --------------------------------------------- satellite: autotuner drift
+
+class TestTuneProbeDrift:
+    def test_probe_records_measured_drift(self, tmp_path):
+        """TUNE_REPORT candidates carry hbm_peak_bytes + the measured
+        watermark_drift when the trial config arms the observatory."""
+        from deepspeed_tpu.autotuning.tune import GoodputTuner
+        base = {
+            "train_batch_size": 16,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "telemetry": {"enabled": True, "trace": False, "jsonl": False,
+                          "prometheus": False,
+                          "output_path": str(tmp_path / "tel"),
+                          "cost_explorer": {"enabled": True},
+                          "memory": {"enabled": True, "cadence": 1,
+                                     "warmup_windows": 0}},
+        }
+        hid = 64
+        rng = np.random.default_rng(0)
+
+        def make_batch(bs):
+            return (rng.standard_normal((bs, hid)).astype(np.float32),
+                    rng.standard_normal((bs, hid)).astype(np.float32))
+
+        tuner = GoodputTuner(
+            lambda **kw: SimpleModel(hidden_dim=hid, nlayers=2),
+            make_batch, base, space={},
+            hbm_budget_bytes=1 << 30, probe_steps=2, probe_warmup_steps=1,
+            results_dir=str(tmp_path / "results"),
+            report_file=str(tmp_path / "TUNE_REPORT.json"))
+        _, report = tuner.tune()
+        cand = report["candidates"][0]
+        assert cand["status"] == "probed"
+        assert cand["probe"]["hbm_peak_bytes"] > 0
+        drift = cand["probe"]["watermark_drift"]
+        assert isinstance(drift, float) and drift != 0, (
+            "the probe must record a measured-vs-predicted drift when "
+            "the observatory is armed")

@@ -1,0 +1,348 @@
+"""Wire-format tests for the dependency-free pprof Profile reader.
+
+Same discipline as ``test_xplane.py``: the parser decodes the protobuf
+wire format by hand, so the tests build wire bytes by hand too — a tiny
+encoder (varint + tag + length-delimited) constructs nested Profile
+messages from field numbers, and a committed golden fixture
+(``tests/unit/data/tiny_memory.pprof.pb.gz``, a real CPU-jax
+``device_memory_profile()`` capture) pins the parse of what
+``jax.profiler`` actually writes. A static AST guard pins the module's
+reason to exist: it must import neither tensorflow nor a protobuf/pprof
+runtime, and jax only inside the one deliberate fetch helper.
+"""
+
+import ast
+import gzip
+import os
+
+import pytest
+
+from deepspeed_tpu.telemetry import pprof
+from deepspeed_tpu.telemetry.pprof import (PprofParseError, _int64_signed,
+                                           _read_varint, live_bytes_by_kind,
+                                           parse_profile, parse_profile_file,
+                                           summarize_samples)
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "data",
+                       "tiny_memory.pprof.pb.gz")
+
+
+# ---------------------------------------------------------------------------
+# hand encoder (mirrors the decoder: both are developed against the same
+# field-number table, so a transposition typo shows up as a round-trip
+# failure here)
+# ---------------------------------------------------------------------------
+
+def vint(value):
+    """Unsigned base-128 varint (negatives as 64-bit two's complement)."""
+    value &= (1 << 64) - 1
+    out = bytearray()
+    while True:
+        b = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def tag(field_no, wire):
+    return vint((field_no << 3) | wire)
+
+
+def vfield(field_no, value):
+    return tag(field_no, 0) + vint(value)
+
+
+def lfield(field_no, payload):
+    if isinstance(payload, str):
+        payload = payload.encode()
+    return tag(field_no, 2) + vint(len(payload)) + payload
+
+
+def packed(field_no, values):
+    body = b"".join(vint(v) for v in values)
+    return lfield(field_no, body)
+
+
+# string-table layout of the synthetic profile (index 0 is '' by pprof
+# convention; the table is emitted AFTER the samples to pin the parser's
+# deferred resolution)
+STR = ["", "allocations", "count", "space", "bytes", "kind", "buffer",
+       "device", "TFRT_CPU_0", "executable", "my_alloc", "main_fn"]
+S = {name: i for i, name in enumerate(STR)}
+
+
+def label(key, str_idx=0, num=0):
+    body = vfield(1, key)
+    if str_idx:
+        body += vfield(2, str_idx)
+    if num:
+        body += vfield(3, num)
+    return lfield(3, body)
+
+
+def build_synthetic_profile():
+    """Two sample types, three samples (packed + unpacked + unlabeled),
+    two located functions, one address-only location."""
+    doc = b""
+    # sample_type: (allocations, count) then (space, bytes)
+    doc += lfield(1, vfield(1, S["allocations"]) + vfield(2, S["count"]))
+    doc += lfield(1, vfield(1, S["space"]) + vfield(2, S["bytes"]))
+    # sample A: buffer, 1024 B, count 1, stack loc1 -> loc2 (packed)
+    doc += lfield(2, packed(1, [1, 2]) + packed(2, [1, 1024])
+                  + label(S["kind"], str_idx=S["buffer"])
+                  + label(S["device"], str_idx=S["TFRT_CPU_0"]))
+    # sample B: executable, 2048 B (UNPACKED encoder — still legal proto)
+    doc += lfield(2, vfield(1, 3) + vfield(2, 1) + vfield(2, 2048)
+                  + label(S["kind"], str_idx=S["executable"]))
+    # sample C: unlabeled, 10 B, count 2, no stack
+    doc += lfield(2, packed(2, [2, 10]))
+    # locations: 1 and 2 carry line/function info, 3 is address-only
+    doc += lfield(4, vfield(1, 1) + vfield(3, 0xdead)
+                  + lfield(4, vfield(1, 1) + vfield(2, 42)))
+    doc += lfield(4, vfield(1, 2) + lfield(4, vfield(1, 2)))
+    doc += lfield(4, vfield(1, 3) + vfield(3, 0xbeef))
+    # functions
+    doc += lfield(5, vfield(1, 1) + vfield(2, S["my_alloc"]))
+    doc += lfield(5, vfield(1, 2) + vfield(2, S["main_fn"]))
+    # string table LAST (jax writes it after the samples too)
+    for s in STR:
+        doc += lfield(6, s)
+    doc += vfield(9, 123)                        # time_nanos
+    doc += vfield(10, 456)                       # duration_nanos
+    doc += lfield(11, vfield(1, S["space"]) + vfield(2, S["bytes"]))
+    doc += vfield(12, 1)                         # period
+    doc += vfield(14, 1)                         # default_sample_type
+    return doc
+
+
+class TestVarint:
+    def test_single_byte_values(self):
+        for v in (0, 1, 5, 127):
+            assert _read_varint(vint(v), 0, 10) == (v, 1)
+
+    def test_multi_byte_values(self):
+        for v in (128, 300, 16_384, 1 << 35, (1 << 64) - 1):
+            enc = vint(v)
+            assert _read_varint(enc, 0, len(enc)) == (v, len(enc))
+
+    def test_truncated_varint_names_offset(self):
+        # continuation bit set, stream ends — offset of the varint START
+        with pytest.raises(PprofParseError, match=r"byte offset 3"):
+            _read_varint(b"\x00\x00\x00\xac\x82", 3, 5)
+
+    def test_overwide_varint_rejected(self):
+        with pytest.raises(PprofParseError, match="wider than 64 bits"):
+            _read_varint(b"\x80" * 10 + b"\x01", 0, 11)
+
+    def test_twos_complement_int64(self):
+        assert _int64_signed((1 << 64) - 5) == -5
+        assert _int64_signed(5) == 5
+        assert _int64_signed(1 << 63) == -(1 << 63)
+        assert _int64_signed((1 << 63) - 1) == (1 << 63) - 1
+
+
+class TestMalformedStreams:
+    def test_length_overrun_names_offset(self):
+        # declares a 100-byte submessage in a 4-byte buffer
+        bad = tag(2, 2) + vint(100) + b"xx"
+        with pytest.raises(PprofParseError,
+                           match=r"overruns buffer at byte offset \d+"):
+            parse_profile(bad)
+
+    def test_field_number_zero_rejected(self):
+        with pytest.raises(PprofParseError, match="field number 0"):
+            parse_profile(b"\x00\x01")
+
+    def test_group_wire_type_rejected(self):
+        # wire type 3 (start-group) is pre-proto3 and never written here
+        with pytest.raises(PprofParseError, match="wire type 3"):
+            parse_profile(tag(1, 3))
+
+    def test_truncated_fixed64(self):
+        with pytest.raises(PprofParseError, match="truncated fixed64"):
+            parse_profile(tag(7, 1) + b"\x00\x00")
+
+    def test_corrupt_gzip_envelope(self):
+        with pytest.raises(PprofParseError, match="corrupt gzip"):
+            parse_profile(b"\x1f\x8b" + b"\x00" * 16)
+
+    def test_nested_error_offsets_are_absolute(self):
+        prefix = lfield(6, "padpadpadpad")       # a string-table entry,
+        # then a well-framed sample whose payload ends mid-varint
+        bad = prefix + tag(2, 2) + vint(2) + tag(2, 0) + b"\xac"
+        try:
+            parse_profile(bad)
+        except PprofParseError as exc:
+            (offset,) = [int(t) for t in str(exc).split() if t.isdigit()]
+            assert offset >= len(prefix), (
+                f"error offset {offset} is relative to the submessage, "
+                f"not the stream (prefix is {len(prefix)} bytes)")
+        else:
+            pytest.fail("truncated nested sample parsed cleanly")
+
+
+class TestSyntheticRoundTrip:
+    def test_header_fields(self):
+        prof = parse_profile(build_synthetic_profile())
+        assert [(prof.string(v.type), prof.string(v.unit))
+                for v in prof.sample_types] == \
+            [("allocations", "count"), ("space", "bytes")]
+        assert prof.time_nanos == 123
+        assert prof.duration_nanos == 456
+        assert (prof.string(prof.period_type.type),
+                prof.string(prof.period_type.unit)) == ("space", "bytes")
+        assert prof.period == 1
+        assert prof.default_sample_type == 1
+
+    def test_value_index(self):
+        prof = parse_profile(build_synthetic_profile())
+        assert prof.value_index("count") == 0
+        assert prof.value_index("bytes") == 1
+        assert prof.value_index("nanoseconds") is None
+
+    def test_packed_and_unpacked_samples_agree(self):
+        prof = parse_profile(build_synthetic_profile())
+        a, b, c = prof.samples
+        assert a.location_ids == [1, 2] and a.values == [1, 1024]
+        assert b.location_ids == [3] and b.values == [1, 2048]
+        assert c.location_ids == [] and c.values == [2, 10]
+
+    def test_labels_resolve_after_deferred_string_table(self):
+        prof = parse_profile(build_synthetic_profile())
+        a, b, c = prof.samples
+        assert prof.sample_labels(a) == {"kind": "buffer",
+                                         "device": "TFRT_CPU_0"}
+        assert prof.sample_labels(b) == {"kind": "executable"}
+        assert prof.sample_labels(c) == {}
+
+    def test_live_bytes_by_kind(self):
+        prof = parse_profile(build_synthetic_profile())
+        assert live_bytes_by_kind(prof) == {
+            "buffer": 1024, "executable": 2048, "(unlabeled)": 10}
+
+    def test_sample_stack_leaf_first(self):
+        prof = parse_profile(build_synthetic_profile())
+        a, b, _ = prof.samples
+        assert prof.sample_stack(a) == ["my_alloc", "main_fn"]
+        # address-only location renders as hex
+        assert prof.sample_stack(b) == ["0xbeef"]
+
+    def test_summarize_samples_ordering_and_top(self):
+        prof = parse_profile(build_synthetic_profile())
+        rows = summarize_samples(prof, top=2)
+        assert [r["bytes"] for r in rows] == [2048, 1024]
+        assert rows[0]["kind"] == "executable"
+        assert rows[1] == {"bytes": 1024, "count": 1, "kind": "buffer",
+                           "device": "TFRT_CPU_0",
+                           "stack": ["my_alloc", "main_fn"]}
+        assert len(summarize_samples(prof, top=10)) == 3
+
+    def test_gzip_envelope_equivalent(self):
+        raw = build_synthetic_profile()
+        plain = parse_profile(raw)
+        wrapped = parse_profile(gzip.compress(raw))
+        assert live_bytes_by_kind(plain) == live_bytes_by_kind(wrapped)
+        assert len(wrapped.samples) == 3
+
+    def test_unknown_fields_skipped(self):
+        # a future field number (200, varint) must be ignored, not fatal
+        prof = parse_profile(vfield(200, 42) + build_synthetic_profile())
+        assert len(prof.samples) == 3
+
+    def test_negative_sample_value_survives(self):
+        # deallocation deltas are legal int64s on the wire
+        doc = (lfield(1, vfield(1, 1) + vfield(2, 2))
+               + lfield(2, packed(2, [-5]))
+               + lfield(6, "") + lfield(6, "space") + lfield(6, "bytes"))
+        prof = parse_profile(doc)
+        assert prof.samples[0].values == [-5]
+
+    def test_empty_profile_has_no_bytes_index(self):
+        prof = parse_profile(b"")
+        assert prof.value_index("bytes") is None
+        assert live_bytes_by_kind(prof) == {}
+        assert summarize_samples(prof) == []
+
+    def test_out_of_range_string_index_is_empty(self):
+        prof = parse_profile(build_synthetic_profile())
+        assert prof.string(10_000) == ""
+        assert prof.string(-1) == ""
+
+    def test_file_round_trip(self, tmp_path):
+        path = tmp_path / "prof.pb.gz"
+        path.write_bytes(gzip.compress(build_synthetic_profile()))
+        prof = parse_profile_file(str(path))
+        assert live_bytes_by_kind(prof)["buffer"] == 1024
+
+
+class TestGoldenFixture:
+    """Pin the parse of a real ``jax.profiler.device_memory_profile()``
+    capture (CPU jax, a handful of live arrays), committed gzip'd. This
+    is the contract with what jax actually writes — an upstream field
+    renumbering breaks here, not in production."""
+
+    def test_fixture_exists_and_parses(self):
+        assert os.path.isfile(FIXTURE), (
+            "golden fixture tests/unit/data/tiny_memory.pprof.pb.gz is "
+            "missing")
+        prof = parse_profile_file(FIXTURE)
+        assert prof.samples, "capture lost its samples"
+        assert prof.string_table, "capture lost its string table"
+
+    def test_sample_types_are_count_and_bytes(self):
+        prof = parse_profile_file(FIXTURE)
+        units = {prof.string(v.unit) for v in prof.sample_types}
+        assert {"count", "bytes"} <= units, (
+            f"device-memory profile sample units drifted: {units}")
+
+    def test_live_buffers_attributed(self):
+        prof = parse_profile_file(FIXTURE)
+        by_kind = live_bytes_by_kind(prof)
+        assert by_kind.get("buffer", 0) > 0, (
+            f"no live buffer bytes in the capture: {by_kind}")
+
+    def test_samples_carry_device_labels_and_stacks(self):
+        prof = parse_profile_file(FIXTURE)
+        rows = summarize_samples(prof, top=5)
+        assert rows and rows[0]["bytes"] > 0
+        assert any(r["device"] for r in rows), "device labels lost"
+
+
+def test_static_no_protobuf_or_tf_imports():
+    """The module's contract: reading the profile back needs neither
+    tensorflow nor a protobuf/pprof runtime — and jax only inside the
+    one deliberate fetch helper + CLI. Enforced statically."""
+    with open(pprof.__file__) as f:
+        tree = ast.parse(f.read())
+    forbidden = ("tensorflow", "tensorboard", "pprof", "protobuf",
+                 "google", "perftools")
+    offenders = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            offenders += [a.name for a in node.names
+                          if a.name.split(".")[0] in forbidden]
+        elif isinstance(node, ast.ImportFrom):
+            if (node.module or "").split(".")[0] in forbidden:
+                offenders.append(node.module)
+    assert not offenders, (
+        f"pprof.py imports {offenders} — the reader must stay "
+        f"dependency-free")
+
+    jax_outside = []
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name in ("fetch_device_memory_profile", "_main"):
+            continue
+        for n in ast.walk(node):
+            if isinstance(n, ast.Import):
+                jax_outside += [a.name for a in n.names
+                                if a.name.split(".")[0] == "jax"]
+            elif isinstance(n, ast.ImportFrom) and \
+                    (n.module or "").split(".")[0] == "jax":
+                jax_outside.append(n.module)
+    assert not jax_outside, (
+        f"pprof.py imports jax outside the fetch helper ({jax_outside}) "
+        f"— parsing must work without a backend")
