@@ -120,6 +120,10 @@ def telemetry_report():
     row("self-healing guardian", True,
         "(guardian block; anomaly->action policies: emergency ckpt, "
         "rollback, fp16 rescue, admission pause -> GUARDIAN.json)")
+    row("run chronicle + incidents", True,
+        "(telemetry.chronicle block; DS_TELEMETRY_CHRONICLE=1; one "
+        "causal event timeline -> CHRONICLE.json, correlated "
+        "root-caused incident chains -> INCIDENTS.json)")
     try:
         from deepspeed_tpu.telemetry.ledger import profiler_available
         row("jax.profiler programmatic capture", profiler_available(),

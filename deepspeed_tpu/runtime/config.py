@@ -94,8 +94,9 @@ class DeepSpeedTelemetryConfig(DeepSpeedConfigObject):
     Env overrides (sweep ergonomics, applied after JSON): ``DS_TELEMETRY``
     = 1/0 force-toggles ``enabled``; ``DS_TELEMETRY_DIR`` overrides
     ``output_path``; ``DS_COST_EXPLORER`` / ``DS_TELEMETRY_HEALTH`` /
-    ``DS_TELEMETRY_GOODPUT`` / ``DS_TELEMETRY_MEMORY`` = 1/0 force-toggle
-    the cost-explorer / health / goodput / memory sub-blocks."""
+    ``DS_TELEMETRY_GOODPUT`` / ``DS_TELEMETRY_MEMORY`` /
+    ``DS_TELEMETRY_CHRONICLE`` = 1/0 force-toggle the cost-explorer /
+    health / goodput / memory / chronicle sub-blocks."""
 
     def __init__(self, param_dict):
         t = param_dict.get(C.TELEMETRY, {}) or {}
@@ -267,6 +268,25 @@ class DeepSpeedTelemetryConfig(DeepSpeedConfigObject):
             C.MEMORY_BUDGET_BYTES, C.MEMORY_BUDGET_BYTES_DEFAULT))
         self.memory_ring_size = int(m.get(C.MEMORY_RING_SIZE,
                                           C.MEMORY_RING_SIZE_DEFAULT))
+        # chronicle sub-block (telemetry/chronicle.py + incidents.py):
+        # the run-wide causal event timeline. Flattened onto chronicle_*.
+        ch = t.get(C.TELEMETRY_CHRONICLE, {}) or {}
+        self.chronicle_enabled = ch.get(C.CHRONICLE_ENABLED,
+                                        C.CHRONICLE_ENABLED_DEFAULT)
+        self.chronicle_run_dir = ch.get(C.CHRONICLE_RUN_DIR,
+                                        C.CHRONICLE_RUN_DIR_DEFAULT)
+        self.chronicle_max_events = int(ch.get(
+            C.CHRONICLE_MAX_EVENTS, C.CHRONICLE_MAX_EVENTS_DEFAULT))
+        self.chronicle_summary_file = ch.get(
+            C.CHRONICLE_SUMMARY_FILE, C.CHRONICLE_SUMMARY_FILE_DEFAULT)
+        self.chronicle_incidents_file = ch.get(
+            C.CHRONICLE_INCIDENTS_FILE, C.CHRONICLE_INCIDENTS_FILE_DEFAULT)
+        self.chronicle_step_window = int(ch.get(
+            C.CHRONICLE_STEP_WINDOW, C.CHRONICLE_STEP_WINDOW_DEFAULT))
+        self.chronicle_time_window_s = float(ch.get(
+            C.CHRONICLE_TIME_WINDOW_S, C.CHRONICLE_TIME_WINDOW_S_DEFAULT))
+        self.chronicle_background = ch.get(C.CHRONICLE_BACKGROUND,
+                                           C.CHRONICLE_BACKGROUND_DEFAULT)
         env = os.environ.get("DS_TELEMETRY")
         if env is not None:
             self.enabled = env.lower() in ("1", "true", "yes", "on")
@@ -302,6 +322,10 @@ class DeepSpeedTelemetryConfig(DeepSpeedConfigObject):
         if env_m is not None:
             self.memory_enabled = env_m.lower() in ("1", "true", "yes",
                                                     "on")
+        env_ch = os.environ.get("DS_TELEMETRY_CHRONICLE")
+        if env_ch is not None:
+            self.chronicle_enabled = env_ch.lower() in ("1", "true",
+                                                        "yes", "on")
         if self.anatomy_capture_steps < 1:
             raise DeepSpeedConfigError(
                 f"telemetry.anatomy.capture_steps must be >= 1, got "
@@ -364,6 +388,18 @@ class DeepSpeedTelemetryConfig(DeepSpeedConfigObject):
             raise DeepSpeedConfigError(
                 f"telemetry.memory.ring_size must be >= 1, got "
                 f"{self.memory_ring_size}")
+        if self.chronicle_max_events < 1:
+            raise DeepSpeedConfigError(
+                f"telemetry.chronicle.max_events must be >= 1, got "
+                f"{self.chronicle_max_events}")
+        if self.chronicle_step_window < 0:
+            raise DeepSpeedConfigError(
+                f"telemetry.chronicle.step_window must be >= 0, got "
+                f"{self.chronicle_step_window}")
+        if self.chronicle_time_window_s <= 0:
+            raise DeepSpeedConfigError(
+                f"telemetry.chronicle.time_window_s must be > 0, got "
+                f"{self.chronicle_time_window_s}")
 
 
 class DeepSpeedDataPrefetchConfig(DeepSpeedConfigObject):

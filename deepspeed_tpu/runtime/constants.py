@@ -295,6 +295,33 @@ MEMORY_BUDGET_BYTES_DEFAULT = 0
 MEMORY_RING_SIZE = "ring_size"              # live-bytes window ring buffer size
 MEMORY_RING_SIZE_DEFAULT = 64
 
+# telemetry.chronicle: the run chronicle (telemetry/chronicle.py) — one
+# append-only, integer-µs, causally-ordered event timeline every
+# subsystem emits into (monitor rule firings, guardian actions, engine
+# lifecycle, compile-watch retraces, serving admission/preemption/
+# livelock, chaos injections, goodput windows). Streams land as one
+# atomic JSONL per rank under `run_dir`; engine.chronicle_report /
+# ServingEngine.chronicle_report summarise to CHRONICLE.json and run the
+# incident correlator (telemetry/incidents.py) to INCIDENTS.json.
+# DS_TELEMETRY_CHRONICLE=1/0 force-toggles `enabled`.
+TELEMETRY_CHRONICLE = "chronicle"
+CHRONICLE_ENABLED = "enabled"
+CHRONICLE_ENABLED_DEFAULT = False
+CHRONICLE_RUN_DIR = "run_dir"               # "" -> <output_path>/chronicle
+CHRONICLE_RUN_DIR_DEFAULT = ""
+CHRONICLE_MAX_EVENTS = "max_events"         # in-memory cap; past it NEW events drop (counted)
+CHRONICLE_MAX_EVENTS_DEFAULT = 16384
+CHRONICLE_SUMMARY_FILE = "summary_file"     # "" -> <output_path>/CHRONICLE.json
+CHRONICLE_SUMMARY_FILE_DEFAULT = ""
+CHRONICLE_INCIDENTS_FILE = "incidents_file"  # "" -> <output_path>/INCIDENTS.json
+CHRONICLE_INCIDENTS_FILE_DEFAULT = ""
+CHRONICLE_STEP_WINDOW = "step_window"       # correlator step-join radius
+CHRONICLE_STEP_WINDOW_DEFAULT = 8
+CHRONICLE_TIME_WINDOW_S = "time_window_s"   # correlator time-join radius
+CHRONICLE_TIME_WINDOW_S_DEFAULT = 30.0
+CHRONICLE_BACKGROUND = "background"         # stream writes off-thread
+CHRONICLE_BACKGROUND_DEFAULT = True
+
 # Checkpoint
 CHECKPOINT = "checkpoint"
 CHECKPOINT_TAG_VALIDATION = "tag_validation"

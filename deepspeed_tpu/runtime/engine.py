@@ -552,6 +552,38 @@ class DeepSpeedEngine:
                 self.telemetry.tracer.set_process_label(
                     f"rank {frank}", sort_index=frank)
 
+        # ---- run chronicle (telemetry/chronicle.py) -----------------------
+        # The causal event timeline every subsystem emits into. Per-rank
+        # by design (one atomic JSONL stream per rank in the run dir), so
+        # gated on the CONFIG like the fleet shipper, not the rank-0-only
+        # manager. Armed BEFORE the guardian so its first action lands in
+        # the timeline.
+        self._chronicle = None
+        self._chronicle_summary_path = None
+        self._chronicle_incidents_path = None
+        if (bool(getattr(tcfg, "enabled", False))
+                and bool(getattr(tcfg, "chronicle_enabled", False))
+                and not self._abstract_init):
+            from deepspeed_tpu.telemetry import chronicle as _chron_mod
+            _chron_out = tcfg.output_path or "telemetry/"
+            chron_run_dir = getattr(tcfg, "chronicle_run_dir", "") or \
+                os.path.join(_chron_out, "chronicle")
+            self._chronicle_summary_path = \
+                getattr(tcfg, "chronicle_summary_file", "") or \
+                os.path.join(_chron_out, "CHRONICLE.json")
+            self._chronicle_incidents_path = \
+                getattr(tcfg, "chronicle_incidents_file", "") or \
+                os.path.join(_chron_out, "INCIDENTS.json")
+            self._chronicle = _chron_mod.RunChronicle(
+                run_dir=chron_run_dir, rank=dist.get_rank(),
+                job_name=tcfg.job_name or "",
+                max_events=int(getattr(tcfg, "chronicle_max_events",
+                                       16384)),
+                background=bool(getattr(tcfg, "chronicle_background",
+                                        True)))
+            _chron_mod.set_chronicle(self._chronicle)
+        self._chronicle_first_emitted = False
+
         # ---- self-healing guardian (runtime/guardian.py) ------------------
         # anomaly->action policy engine: the monitors above classify and
         # escalate; the guardian (when armed) subscribes to their
@@ -679,6 +711,12 @@ class DeepSpeedEngine:
             ranks=[0])
         if self.config.dump_state:  # reference engine.py:245 dump_state
             self.config.print("DeepSpeedEngine configuration")
+        self._chronicle_emit(
+            "init",
+            detail=f"zero_stage={self.zero_stage} "
+                   f"dtype={self.compute_dtype.__name__} "
+                   f"dp={self.dp_world_size} mp={self.mp_world_size} "
+                   f"gas={self.gradient_accumulation_steps()}")
 
     # ------------------------------------------------------------------ config
     def train_batch_size(self):
@@ -2474,6 +2512,53 @@ class DeepSpeedEngine:
             self._guardian.write_journal()
         return report
 
+    # ---------------------------------------------------------- chronicle
+    def _chronicle_emit(self, phase, **data):
+        """Engine-lifecycle event into the run chronicle. No-op unless
+        THIS engine armed one (one attribute test when off — the
+        autotuner's trial engines must not cross-chronicle)."""
+        if self._chronicle is not None and self._chronicle.enabled:
+            self._chronicle.emit("lifecycle", source="engine",
+                                 step=int(self.global_steps), phase=phase,
+                                 **data)
+
+    def _note_first_compile(self, step_s):
+        """The first train_batch is the compile-dominated one — a
+        timeline without it misattributes minutes of wait to whatever
+        fired next."""
+        if not self._chronicle_first_emitted:
+            self._chronicle_first_emitted = True
+            self._chronicle_emit(
+                "first_compile", step_time_ms=round(step_s * 1000.0, 3),
+                detail="first train_batch (compile-dominated)")
+
+    def chronicle_report(self, write=False):
+        """The run chronicle + correlated incidents (what
+        ``CHRONICLE.json`` / ``INCIDENTS.json`` hold): this rank's merged
+        causal event timeline, plus the incident chains the correlator
+        joins out of it — ordered member events, ranked root cause,
+        goodput cost re-added from the ledger's window-diff events.
+        Works on a closed engine (reads the in-memory log; ``write=True``
+        then writes both artifacts synchronously).
+        ``{"enabled": False}`` when ``telemetry.chronicle`` is off."""
+        if self._chronicle is None:
+            return {"enabled": False}
+        from deepspeed_tpu.telemetry import incidents as _inc
+        tcfg = self.config.telemetry
+        doc = self._chronicle.report()
+        doc["incidents"] = _inc.correlate(
+            self._chronicle.snapshot_events(),
+            step_window=int(getattr(tcfg, "chronicle_step_window", 8)),
+            time_window_us=int(round(float(getattr(
+                tcfg, "chronicle_time_window_s", 30.0)) * 1e6)),
+            job_name=tcfg.job_name or "")
+        if write:
+            self._chronicle.drain()
+            self._chronicle.write_summary(self._chronicle_summary_path)
+            _inc.write_incidents(doc["incidents"],
+                                 self._chronicle_incidents_path)
+        return doc
+
     def _guardian_emergency_save(self, step):
         """Guardian action (a): an extra checkpoint through the normal
         save path (async writer when configured, one in flight). The tag
@@ -3017,7 +3102,9 @@ class DeepSpeedEngine:
             # two clock reads, nothing else
             t0 = time.perf_counter()
             mean_loss = self._train_batch(data_iter, batch)
-            self._fleet.note_step_time(time.perf_counter() - t0)
+            step_s = time.perf_counter() - t0
+            self._fleet.note_step_time(step_s)
+            self._note_first_compile(step_s)
             self._fleet_tick()
             return mean_loss
         t0 = time.perf_counter()
@@ -3034,6 +3121,7 @@ class DeepSpeedEngine:
             with tel.span("train_batch", global_step=self.global_steps):
                 mean_loss = self._train_batch(data_iter, batch)
             step_s = time.perf_counter() - t0
+            self._note_first_compile(step_s)
             self._publish_step_telemetry(mean_loss, step_s)
         if self._fleet is not None:
             self._fleet.note_step_time(step_s)
@@ -3372,6 +3460,19 @@ class DeepSpeedEngine:
                 except Exception as e:
                     logger.warning("[guardian] final journal failed: %s", e)
             self.telemetry.close()
+            if self._chronicle is not None:
+                from deepspeed_tpu.telemetry import chronicle as _chron_mod
+                # AFTER telemetry.close(): the ledger's final forced tick
+                # just emitted its last goodput_window — the lifecycle
+                # close must be the timeline's final event. Emit before
+                # closing (the writer only drains pre-close events), then
+                # detach the global so later engines start clean.
+                self._chronicle_emit("close")
+                try:
+                    self._chronicle.close()
+                except Exception as e:
+                    logger.warning("[chronicle] close failed: %s", e)
+                _chron_mod.reset_chronicle(if_current=self._chronicle)
 
     # ------------------------------------------------------------ checkpoints
     def _get_ckpt_name(self, checkpoints_path, tag):
@@ -3437,6 +3538,8 @@ class DeepSpeedEngine:
                 self._persist_checkpoint(save_dir, tag, snapshot,
                                          save_latest)
             log_dist(f"saved checkpoint {save_dir}/{tag}", ranks=[0])
+            self._chronicle_emit("checkpoint_save", tag=tag, dir=save_dir,
+                                 initiator=initiator, mode="sync")
             return True
         reg = self.telemetry.registry
         if reg is not None:
@@ -3447,6 +3550,8 @@ class DeepSpeedEngine:
                                              save_latest), tag=tag)
         log_dist(f"checkpoint {save_dir}/{tag}: snapshot taken, "
                  f"persisting in background", ranks=[0])
+        self._chronicle_emit("checkpoint_save", tag=tag, dir=save_dir,
+                             initiator=initiator, mode="background")
         return True
 
     def _get_ckpt_writer(self):
@@ -3724,6 +3829,11 @@ class DeepSpeedEngine:
                 f"elastic checkpoint load: saved at dp={saved_dp}, "
                 f"resuming at dp={self.dp_world_size} (shard reassembly)",
                 ranks=[0])
+            self._chronicle_emit(
+                "elastic_resume", tag=str(tag), saved_dp=int(saved_dp),
+                dp=int(self.dp_world_size),
+                detail=f"shard reassembly dp={saved_dp}->"
+                       f"{self.dp_world_size}")
 
         if sd.get("module") is not None:
             module_np = sd["module"]
@@ -3834,6 +3944,10 @@ class DeepSpeedEngine:
                 self._offload_opt.load_state_dict(sd_off)
                 self._pending_offload_sd = None
         log_dist(f"loaded checkpoint {load_dir}/{tag}", ranks=[0])
+        # after the counters are restored: the event's step IS the
+        # resumed position, which is what a timeline reader wants
+        self._chronicle_emit("checkpoint_load", tag=str(tag),
+                             dir=load_dir)
         return path, client_state
 
     # ------------------------------------------------- consolidated exports

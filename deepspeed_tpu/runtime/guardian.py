@@ -45,6 +45,8 @@ import os
 import threading
 import time
 
+from deepspeed_tpu.telemetry import chronicle as _chronicle
+from deepspeed_tpu.telemetry import clock as _clk
 from deepspeed_tpu.utils.logging import logger
 
 GUARDIAN_SCHEMA = "deepspeed_tpu.guardian/1"
@@ -215,7 +217,9 @@ class Guardian:
         count it. A throwing action is a journaled failure — the policy
         engine must never kill the step that triggered it."""
         entry = {"action": action, "rule": rule, "step": int(step),
-                 "unix_time": round(time.time(), 3), "detail": detail}
+                 "t_us": _clk.monotonic_us(),
+                 "unix_time": round(_clk.unix_us() / 1e6, 3),
+                 "detail": detail}
         if fn is None:
             entry["outcome"] = "skipped:no_handler"
         else:
@@ -238,6 +242,13 @@ class Guardian:
                 "guardian anomaly->action policy firings",
                 labels={"action": action,
                         "outcome": entry["outcome"].split(":")[0]}).inc()
+        chron = _chronicle.get_chronicle()
+        if chron.enabled:
+            # the rule->action edge is the correlator's causal join
+            chron.emit("action", source="guardian", step=int(step),
+                       severity="warning", action=action, rule=rule,
+                       outcome=entry["outcome"], detail=detail or None,
+                       artifact=self.journal_path)
         self.write_journal()
         return entry["outcome"] == "ok"
 

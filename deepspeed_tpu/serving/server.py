@@ -32,6 +32,7 @@ from deepspeed_tpu.serving.runner import PagedGPT2Runner
 from deepspeed_tpu.serving.sampling import make_rng_lane
 from deepspeed_tpu.serving.scheduler import (ContinuousBatchingScheduler,
                                              Request, RequestState)
+from deepspeed_tpu.telemetry import chronicle as _chronicle
 from deepspeed_tpu.telemetry import metrics as _metrics
 from deepspeed_tpu.telemetry.compile_watch import CompileWatch
 from deepspeed_tpu.telemetry.serving_observatory import (
@@ -217,6 +218,8 @@ class ServingEngine:
                 "serving_requests_rejected_total",
                 "submits refused while admission was paused",
                 labels={"reason": "admission_paused"}).inc()
+            self._chronicle_serving("submit_refused", severity="watch",
+                                    rule=self._admission_pause_rule)
             raise ServingAdmissionPausedError(self._admission_pause_rule)
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         vs = self.engine.module.config.vocab_size
@@ -297,12 +300,18 @@ class ServingEngine:
         self.registry.gauge(
             "serving_admission_paused",
             "1 while the guardian has admission paused").set(1)
+        # rule rides the event: the correlator's join key back to the
+        # SLO anomaly that triggered the pause
+        self._chronicle_serving("admission_pause", severity="warning",
+                                rule=str(rule))
         log_dist(f"serving: admission PAUSED (rule {rule}); new submits "
                  f"fail fast until recovery", ranks=[0])
 
     def _resume_admission(self):
         """Guardian recovery action: the overload rules stayed quiet for
         ``resume_clear_steps`` serving steps."""
+        self._chronicle_serving("admission_resume", severity="info",
+                                rule=self._admission_pause_rule)
         self._admission_pause_rule = None
         self.registry.gauge(
             "serving_admission_paused",
@@ -595,6 +604,8 @@ class ServingEngine:
             delta = total - pre.value
             if delta > 0:
                 pre.inc(delta)
+                self._chronicle_serving("preemption", severity="watch",
+                                        reason=reason, count=delta)
 
     # ----------------------------------------------------------- collect
     def collect(self) -> List[RequestOutput]:
@@ -643,6 +654,10 @@ class ServingEngine:
                 # a hang), and the forensics snapshot is forced to disk —
                 # then the report also rides the exception.
                 n = self._fail_all_pending("livelock")
+                self._chronicle_serving(
+                    "livelock", severity="critical", failed=n,
+                    detail=f"no progress for 1000 iterations; failed {n} "
+                           f"pending request(s)")
                 report = self.serving_report(write=True)
                 raise ServingLivelockError(
                     "serving made no progress for 1000 iterations — "
@@ -795,6 +810,29 @@ class ServingEngine:
                                           "queue_growth"):
                     sig[a["rule"]] = True
         return sig
+
+    def _chronicle_serving(self, event, severity=None, detail=None,
+                           **data):
+        """Serving event into the run chronicle (admission pause/resume,
+        preemption, livelock last rites). ``step`` is the SERVING step
+        clock, not the train step — readers disambiguate by the event's
+        ``source``."""
+        chron = _chronicle.get_chronicle()
+        if chron.enabled:
+            chron.emit("serving", source="serving",
+                       step=self._serving_steps, severity=severity,
+                       detail=detail, event=event, **data)
+
+    def chronicle_report(self, write=False):
+        """Serving counterpart of ``engine.chronicle_report``: the
+        chronicle is process-global and armed by the engine that owns
+        it, so this delegates to the wrapped engine (the serving events
+        above are already in the same timeline).
+        ``{"enabled": False}`` when no chronicle is armed."""
+        fn = getattr(self.engine, "chronicle_report", None)
+        if fn is not None:
+            return fn(write=write)
+        return {"enabled": False}
 
     def serving_report(self, write=False):
         """The structured serving forensics dict: the observatory report

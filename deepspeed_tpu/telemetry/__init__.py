@@ -56,7 +56,18 @@ Four pieces (see the per-module docstrings):
   tick;
 * ``bench_diff`` — bench-regression differ over committed BENCH_r*.json
   rounds (``python -m deepspeed_tpu.telemetry.bench_diff`` exits
-  non-zero past the regression threshold).
+  non-zero past the regression threshold);
+* ``clock`` — the shared monotonic integer-µs axis every cross-stream
+  timestamp joins on (plus the one wall anchor for rendering);
+* ``escalation`` — the ONE escalation protocol all five observatories
+  share (warn-once, counters, history cap, snapshot, chronicle emit,
+  fenced hooks);
+* ``chronicle`` / ``incidents`` — the run chronicle (one causally-
+  ordered event timeline across monitors, guardian, engine lifecycle,
+  serving and chaos; per-rank atomic JSONL streams) and the incident
+  correlator joining it into INCIDENTS.json chains with ranked root
+  cause and per-incident goodput cost
+  (``python -m deepspeed_tpu.telemetry.chronicle`` is the CLI).
 
 ``TelemetryManager`` (manager.py) wires them per engine run, behind the
 ``telemetry`` config block (see CONFIG.md). Everything is importable and
@@ -93,6 +104,11 @@ from deepspeed_tpu.telemetry.fleet import (FleetMonitor, FleetShipper,
                                            build_desync_checksum_fn,
                                            get_shipper, merge_traces,
                                            set_shipper)
+from deepspeed_tpu.telemetry.chronicle import (RunChronicle, get_chronicle,
+                                               reset_chronicle,
+                                               set_chronicle)
+from deepspeed_tpu.telemetry.incidents import (IncidentCorrelator,
+                                               correlate, write_incidents)
 from deepspeed_tpu.telemetry.manager import (TelemetryManager, get_manager,
                                              set_manager)
 
@@ -112,6 +128,8 @@ __all__ = [
     "FleetMonitor", "FleetShipper", "build_desync_checksum_fn",
     "get_shipper", "merge_traces", "set_shipper",
     "get_manager", "set_manager",
+    "RunChronicle", "get_chronicle", "set_chronicle", "reset_chronicle",
+    "IncidentCorrelator", "correlate", "write_incidents",
     "xplane", "step_anatomy", "pprof", "memory_observatory",
 ]
 

@@ -1,0 +1,559 @@
+"""Run chronicle — ONE causally-ordered event timeline for the whole run.
+
+Every instrument so far escalates into its own siloed artifact: HEALTH /
+GOODPUT / SERVING_HEALTH / FLEET_HEALTH / MEMORY_ANATOMY snapshots, the
+guardian's GUARDIAN.json journal, the compile watch's log lines. A single
+production incident — input stall -> loss spike -> guardian rollback ->
+TTFT breach on the co-located replica — is therefore scattered across
+five files with no shared clock and no causal join. This module is the
+merge point:
+
+* :class:`RunChronicle` — an append-only structured event log. Every
+  event carries a **monotone per-rank sequence number** and an **integer
+  microsecond stamp on the shared monotonic axis**
+  (:func:`deepspeed_tpu.telemetry.clock.monotonic_us`), so a merged
+  timeline is strictly ordered with no wall-vs-monotonic confusion and
+  no float drift. Emitters reach it through the process-global
+  :func:`get_chronicle` (the tracer/registry/ledger pattern):
+
+  ========== ============================================================
+  kind        emitted by
+  ========== ============================================================
+  anomaly     every monitor's rule firing, at ``escalation.escalate``
+              time (one emit site for all five observatories)
+  action      the guardian's ``_act`` — action, triggering rule, outcome
+  lifecycle   the engine: init / first_compile / checkpoint_save+load /
+              elastic_resume / close (+ the ServingEngine counterparts)
+  retrace     the compile watch's recompile culprit reports
+  serving     admission pause/resume, preemption, livelock last rites
+  chaos       the PR-12 chaos harness naming its own injections — a
+              chaos-driven run self-documents its ground truth
+  goodput_window  the ledger's window ticks (integer-µs category diffs),
+              so an incident's goodput cost is computable — and
+              re-addable — from chronicle events alone
+  ========== ============================================================
+
+* Persistence: one JSONL stream per rank under a run dir
+  (``<run_dir>/events_rank_00000.jsonl``), rewritten atomically
+  (tmp+fsync+rename — the PR-7/11 discipline; a reader sees a COMPLETE
+  prefix of the log or nothing) by a background writer thread that holds
+  only a :class:`_WriterState` (weakref.finalize GC, PR-5/7 thread
+  discipline) and runs under ``suppress_attribution`` so shipping the
+  chronicle can never book badput into the ledger it is chronicling.
+
+* The in-memory log is bounded (``max_events``): past the cap NEW events
+  are dropped and counted (``dropped``) — append-only means the
+  committed prefix, with the earliest (causally richest) events, is
+  never rewritten out from under a reader.
+
+* :meth:`RunChronicle.report` -> CHRONICLE.json summary; the
+  :class:`deepspeed_tpu.telemetry.incidents.IncidentCorrelator` joins
+  the same events into INCIDENTS.json (``engine.chronicle_report``).
+
+Disabled is near-free: ``emit`` on the shared disabled instance is one
+attribute check (guarded < 2 µs in tests/perf/telemetry_overhead.py),
+and the module imports no jax — pure host bookkeeping.
+
+CLI: ``python -m deepspeed_tpu.telemetry.chronicle --render
+CHRONICLE.json`` (or a run dir) pretty-prints the merged timeline;
+``--demo`` replays the guardian's chaos scenario — DivergenceChaos
+poison -> nonfinite_grads -> automatic rollback — and writes the
+committed repo-root CHRONICLE.json + INCIDENTS.json, whose correlator
+output is exactly ONE incident rooted at the poison step.
+"""
+
+import argparse
+import json
+import math
+import os
+import threading
+import weakref
+from collections import deque
+
+from deepspeed_tpu.telemetry import clock as _clk
+from deepspeed_tpu.utils.logging import logger
+
+CHRONICLE_SCHEMA = "deepspeed_tpu.chronicle/1"
+
+_TMP_MARK = ".tmp."          # the checkpoint_io sibling-marker convention
+_STREAM_FMT = "events_rank_{:05d}.jsonl"
+
+SEVERITY_ORDER = ("critical", "warning", "watch", "info")
+
+
+def _severity_rank(sev):
+    try:
+        return SEVERITY_ORDER.index(sev)
+    except ValueError:
+        return len(SEVERITY_ORDER)
+
+
+def _fsync_dir(dirname):
+    """Durability for the rename (best-effort — mirrors fleet._fsync_dir,
+    re-implemented so this module imports nothing that imports the
+    escalation helper back)."""
+    try:
+        fd = os.open(dirname or ".", os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _atomic_write_bytes(path, payload):
+    """tmp sibling + fsync + atomic rename (+ dir fsync)."""
+    tmp = f"{path}{_TMP_MARK}{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+    _fsync_dir(os.path.dirname(path))
+
+
+def _json_sane(obj):
+    """Make *obj* strictly-JSON-serialisable: non-finite floats become
+    strings (the health.json_safe contract, local copy to keep the
+    import graph acyclic), unknown objects their repr."""
+    if isinstance(obj, float):
+        return obj if math.isfinite(obj) else str(obj)
+    if isinstance(obj, dict):
+        return {str(k): _json_sane(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_json_sane(v) for v in obj]
+    if obj is None or isinstance(obj, (bool, int, str)):
+        return obj
+    return repr(obj)
+
+
+class _WriterState:
+    """Everything the background writer thread may touch — the thread
+    holds ONLY this (never the chronicle), so an abandoned chronicle is
+    reclaimed via weakref.finalize. ``busy`` spans dequeue-to-written,
+    so ``drain`` means durably on disk."""
+    __slots__ = ("queue", "cond", "stopped", "busy", "errors", "warned")
+
+    def __init__(self):
+        self.queue = deque()
+        self.cond = threading.Condition()
+        self.stopped = False
+        self.busy = False
+        self.errors = 0
+        self.warned = False
+
+
+def _writer_loop(state):
+    # chronicling a run must never book wall time into the run's own
+    # goodput ledger (lazy import: the ledger imports the escalation
+    # helper which imports this module)
+    from deepspeed_tpu.telemetry.ledger import suppress_attribution
+    with suppress_attribution():
+        while True:
+            with state.cond:
+                state.busy = False
+                state.cond.notify_all()
+                while not state.queue and not state.stopped:
+                    state.cond.wait(timeout=0.5)
+                if not state.queue and state.stopped:
+                    return
+                path, payload = state.queue.popleft()
+                state.busy = True
+            try:
+                _atomic_write_bytes(path, payload)
+            except Exception as e:   # forensics must never kill a run
+                state.errors += 1
+                if not state.warned:
+                    state.warned = True
+                    logger.warning("[chronicle] background write failed: "
+                                   "%s", e)
+
+
+def _finalize_writer(state, thread):
+    with state.cond:
+        state.stopped = True
+        state.cond.notify_all()
+    if thread.is_alive():
+        thread.join(timeout=5.0)
+
+
+class RunChronicle:
+    """The per-process run chronicle. See the module docstring.
+
+    ``emit`` is thread-safe (monitors escalate on the train thread, the
+    serving scheduler and prefetch workers on theirs); each emit appends
+    one event and enqueues a full-log rewrite for the background writer
+    (coalesced: at most one pending rewrite rides the queue per stream).
+    """
+
+    def __init__(self, run_dir=None, rank=0, job_name="", enabled=True,
+                 max_events=16384, background=True, log_fn=None):
+        self.enabled = bool(enabled)
+        self.rank = int(rank)
+        self.job_name = job_name
+        self.dropped = 0
+        if not self.enabled:
+            return
+        self.run_dir = run_dir
+        self.max_events = max(1, int(max_events))
+        self._log = log_fn or logger.warning
+        self._lock = threading.Lock()
+        self._seq = 0
+        self.events = []
+        self._closed = False
+        self.stream_path = None
+        self._wstate = None
+        self._wthread = None
+        if run_dir:
+            os.makedirs(run_dir, exist_ok=True)
+            self.stream_path = os.path.join(
+                run_dir, _STREAM_FMT.format(self.rank))
+            if background:
+                self._wstate = _WriterState()
+                self._wthread = threading.Thread(
+                    target=_writer_loop, args=(self._wstate,),
+                    name=f"ds-chronicle-r{self.rank}", daemon=True)
+                self._wthread.start()
+                self._finalizer = weakref.finalize(
+                    self, _finalize_writer, self._wstate, self._wthread)
+
+    # -------------------------------------------------------------- emitting
+    def emit(self, kind, source, step=None, severity=None, detail=None,
+             **data):
+        """Append one event. Returns the event dict (None when disabled
+        or dropped). The stamp is taken INSIDE the lock so (t_us, seq)
+        is monotone even under concurrent emitters."""
+        if not self.enabled or self._closed:
+            # post-close emits drop (the writer is gone; an enqueue
+            # nobody drains would just dangle)
+            return None
+        with self._lock:
+            if len(self.events) >= self.max_events:
+                # append-only: past the cap the committed prefix wins
+                # and NEW events drop (counted — a summary with
+                # dropped>0 says "timeline truncated", never "rewritten")
+                self.dropped += 1
+                return None
+            t_us = _clk.monotonic_us()
+            event = {"seq": self._seq, "t_us": t_us,
+                     "unix_us": _clk.to_unix_us(t_us),
+                     "kind": kind, "source": source, "rank": self.rank}
+            if step is not None:
+                event["step"] = int(step)
+            if severity is not None:
+                event["severity"] = severity
+            if detail is not None:
+                event["detail"] = str(detail)
+            for k, v in data.items():
+                if v is not None:
+                    event[k] = _json_sane(v)
+            self._seq += 1
+            self.events.append(event)
+            snapshot = list(self.events) if self.stream_path else None
+        if snapshot is not None:
+            self._ship(snapshot)
+        return event
+
+    def _payload(self, events):
+        return ("\n".join(json.dumps(e, sort_keys=True, allow_nan=False)
+                          for e in events) + "\n").encode()
+
+    def _ship(self, events):
+        if self._wstate is not None:
+            payload = self._payload(events)
+            with self._wstate.cond:
+                # coalesce: a newer full-log rewrite supersedes any
+                # queued one — the stream is always written whole
+                self._wstate.queue.clear()
+                self._wstate.queue.append((self.stream_path, payload))
+                self._wstate.cond.notify_all()
+        else:
+            try:
+                _atomic_write_bytes(self.stream_path,
+                                    self._payload(events))
+            except OSError as e:
+                self._log("[chronicle] stream write failed: %s", e)
+
+    # --------------------------------------------------------------- reading
+    def snapshot_events(self):
+        """A consistent copy of the event log (ordered by (t_us, seq))."""
+        if not self.enabled:
+            return []
+        with self._lock:
+            return list(self.events)
+
+    def drain(self, timeout=10.0):
+        """Block until every queued stream write is durably on disk."""
+        if not self.enabled or self._wstate is None:
+            return
+        deadline = _clk.monotonic_s() + timeout
+        with self._wstate.cond:
+            while ((self._wstate.queue or self._wstate.busy)
+                   and _clk.monotonic_s() < deadline):
+                self._wstate.cond.wait(timeout=0.2)
+
+    def report(self):
+        """The CHRONICLE.json summary dict."""
+        if not self.enabled:
+            return {"schema": CHRONICLE_SCHEMA, "enabled": False}
+        events = self.snapshot_events()
+        by_kind, by_source = {}, {}
+        for e in events:
+            by_kind[e["kind"]] = by_kind.get(e["kind"], 0) + 1
+            by_source[e["source"]] = by_source.get(e["source"], 0) + 1
+        return {
+            "schema": CHRONICLE_SCHEMA,
+            "job_name": self.job_name,
+            "rank": self.rank,
+            "run_dir": self.run_dir,
+            "n_events": len(events),
+            "dropped": self.dropped,
+            "counts_by_kind": by_kind,
+            "counts_by_source": by_source,
+            "first_t_us": events[0]["t_us"] if events else None,
+            "last_t_us": events[-1]["t_us"] if events else None,
+            "events": events,
+        }
+
+    def write_summary(self, path):
+        doc = self.report()
+        payload = json.dumps(doc, indent=1, default=repr,
+                             allow_nan=False).encode()
+        _atomic_write_bytes(path, payload)
+        return path
+
+    def close(self):
+        """Final stream write + writer join. Idempotent."""
+        if not self.enabled or self._closed:
+            return
+        self._closed = True
+        if self.stream_path is not None:
+            with self._lock:
+                events = list(self.events)
+            self._ship(events)
+        self.drain()
+        if self._wstate is not None:
+            _finalize_writer(self._wstate, self._wthread)
+
+
+# Process-global chronicle. The shared disabled instance (never None)
+# keeps every emit site a plain attribute check — the ledger's
+# _DISABLED/_GLOBAL pattern.
+_DISABLED = RunChronicle(enabled=False)
+_GLOBAL = _DISABLED
+
+
+def get_chronicle():
+    return _GLOBAL
+
+
+def set_chronicle(chronicle):
+    """Install *chronicle* as the process global; returns the old one."""
+    global _GLOBAL
+    old, _GLOBAL = _GLOBAL, (chronicle if chronicle is not None
+                             else _DISABLED)
+    return old
+
+
+def reset_chronicle(if_current=None):
+    global _GLOBAL
+    if if_current is None or _GLOBAL is if_current:
+        _GLOBAL = _DISABLED
+
+
+# --------------------------------------------------------------------- CLI
+
+def load_events(path):
+    """Events from a CHRONICLE.json summary, a rank JSONL stream, or a
+    run dir of streams (merged, ordered on the shared µs axis)."""
+    if os.path.isdir(path):
+        events = []
+        for f in sorted(os.listdir(path)):
+            if f.startswith("events_rank_") and f.endswith(".jsonl") \
+                    and _TMP_MARK not in f:
+                events.extend(load_events(os.path.join(path, f)))
+        events.sort(key=lambda e: (e["t_us"], e.get("rank", 0),
+                                   e["seq"]))
+        return events
+    with open(path) as f:
+        if path.endswith(".jsonl"):
+            return [json.loads(line) for line in f if line.strip()]
+        return json.load(f).get("events", [])
+
+
+def render(events):
+    """Human-readable merged timeline."""
+    if not events:
+        return "chronicle: no events"
+    t0 = events[0]["t_us"]
+    lines = [f"chronicle: {len(events)} event(s) across "
+             f"{len({e.get('rank', 0) for e in events})} rank(s)"]
+    for e in events:
+        dt_ms = (e["t_us"] - t0) / 1e3
+        step = f"step {e['step']}" if "step" in e else "-"
+        what = e.get("rule") or e.get("phase") \
+            or e.get("event") or e.get("chaos") or ""
+        if e.get("action"):
+            # the rule->action causal edge, rendered as one
+            what = (f"{what}->{e['action']}" if what else e["action"])
+        sev = f" [{e['severity']}]" if "severity" in e else ""
+        detail = e.get("detail", "")
+        if len(detail) > 72:
+            detail = detail[:69] + "..."
+        lines.append(f"  +{dt_ms:10.1f}ms r{e.get('rank', 0)} "
+                     f"{e['kind']:>14}/{e['source']:<10} {step:>9} "
+                     f"{what}{sev} {detail}".rstrip())
+    return "\n".join(lines)
+
+
+def render_incidents(doc):
+    """Human-readable incident chains (an INCIDENTS.json document)."""
+    incs = doc.get("incidents", [])
+    lines = [f"incidents: {len(incs)} over {doc.get('n_events', 0)} "
+             f"event(s) (job {doc.get('job_name') or '-'})"]
+    for inc in incs:
+        dur_ms = inc["duration_us"] / 1e3
+        lines.append(
+            f"  #{inc['id']} [{inc['severity']}] steps "
+            f"{inc['start_step']}-{inc['end_step']} over {dur_ms:.1f}ms "
+            f"badput {inc['goodput_cost']['badput_total_us'] / 1e3:.1f}ms")
+        rc = inc["root_cause"]
+        if rc:
+            what = rc.get("rule") or rc.get("chaos") or rc.get("kind")
+            lines.append(f"     root cause: {rc['kind']}/{what} at step "
+                         f"{rc.get('step', '-')} — {rc['why']}")
+        if inc["rules"]:
+            lines.append(f"     rules:   {', '.join(inc['rules'])}")
+        if inc["actions"]:
+            lines.append(f"     actions: {', '.join(inc['actions'])}")
+        for a in inc["artifacts"]:
+            lines.append(f"     artifact: {a}")
+    return "\n".join(lines)
+
+
+def _demo(args):
+    """The committed-artifact scenario: the guardian demo's chaos run
+    with the chronicle armed — a DivergenceChaos poison, the health
+    observatory's nonfinite_grads/loss_spike firings and the guardian's
+    rollback collapse into ONE incident naming the poison step."""
+    if args.devices:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.devices}")
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import tempfile
+
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.testing.chaos import DivergenceChaos
+    from deepspeed_tpu.models.simple import SimpleModel, sample_batch
+    from deepspeed_tpu.utils import groups
+
+    import jax
+
+    groups.destroy()
+    groups.initialize()
+    hidden = 32
+    ndev = jax.device_count()
+    ckpt_dir = tempfile.mkdtemp(prefix="chronicle_demo_ckpt_")
+    run_dir = tempfile.mkdtemp(prefix="chronicle_demo_run_")
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=hidden, nlayers=2),
+        config={
+            "train_batch_size": 8,
+            "train_micro_batch_size_per_gpu": 8 // ndev,
+            "gradient_accumulation_steps": 1,
+            "steps_per_print": 1,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+            "fp16": {"enabled": True, "loss_scale": 0,
+                     "initial_scale_power": 8},
+            "checkpoint": {"async_save": True},
+            "guardian": {"enabled": True, "action_cooldown_steps": 1,
+                         "divergence_streak": 2},
+            "telemetry": {"enabled": True, "trace": False,
+                          "jsonl": False, "prometheus": False,
+                          "health": {"enabled": True, "cadence": 1,
+                                     "warmup_samples": 2},
+                          "goodput": {"enabled": True, "cadence": 2},
+                          "chronicle": {"enabled": True,
+                                        "run_dir": run_dir,
+                                        "summary_file":
+                                            os.path.abspath(args.out),
+                                        "incidents_file":
+                                            os.path.abspath(
+                                                args.incidents_out)}},
+        },
+        sample_batch=sample_batch(8, hidden))
+    rng = np.random.default_rng(0)
+
+    def batches():
+        while True:
+            x = rng.standard_normal((8, hidden)).astype(np.float32)
+            yield (x, x * 0.5)
+
+    it = batches()
+    for step in range(1, args.steps + 1):
+        if step == 3:        # the tag the guardian's rollback restores
+            engine.save_checkpoint(ckpt_dir)
+        engine.train_batch(data_iter=it)
+    # chaos: poison the params -> loss_spike + nonfinite streak ->
+    # automatic rollback; the injector chronicles its own ground truth
+    chaos = DivergenceChaos(engine, at_call=1)
+    with chaos:
+        engine.train_batch(data_iter=it)
+    for _ in range(3):
+        engine.train_batch(data_iter=it)
+    engine.close()       # emits the lifecycle close + final stream write
+    doc = engine.chronicle_report(write=True)
+    print(render(doc["events"]))
+    inc = doc.get("incidents") or {}
+    print(f"\n{len(inc.get('incidents', []))} incident(s); "
+          f"poisoned step(s): {chaos.poisoned_steps}")
+    print(f"wrote {args.out} + {args.incidents_out}")
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="run-chronicle timeline/demo CLI")
+    ap.add_argument("--render", metavar="PATH",
+                    help="CHRONICLE.json, INCIDENTS.json, a rank .jsonl "
+                         "stream, or a run dir — print the merged "
+                         "timeline (or the incident chains)")
+    ap.add_argument("--demo", action="store_true",
+                    help="run the chaos-driven demo and write the "
+                         "committed CHRONICLE.json + INCIDENTS.json")
+    ap.add_argument("--out", default="CHRONICLE.json")
+    ap.add_argument("--incidents-out", default="INCIDENTS.json")
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--devices", type=int, default=0)
+    args = ap.parse_args(argv)
+    if args.demo:
+        return _demo(args)
+    if args.render:
+        if os.path.isfile(args.render) and args.render.endswith(".json"):
+            with open(args.render) as f:
+                doc = json.load(f)
+            if isinstance(doc, dict) and \
+                    str(doc.get("schema", "")).startswith(
+                        "deepspeed_tpu.incidents/"):
+                print(render_incidents(doc))
+                return 0
+        print(render(load_events(args.render)))
+        return 0
+    ap.error("one of --render / --demo is required")
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

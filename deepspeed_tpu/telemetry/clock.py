@@ -1,0 +1,61 @@
+"""Shared monotonic integer-µs clock for every telemetry stream.
+
+Before this module each instrument picked its own time source —
+``time.monotonic`` (ledger intervals), ``time.perf_counter`` (fleet
+shipper windows), ``time.time`` (guardian journal entries) — which is
+fine inside one file and fatal the moment streams are JOINED: the run
+chronicle orders events from every monitor, the guardian and the engine
+lifecycle on ONE axis, and comparing a wall-clock stamp to a monotonic
+stamp silently mis-orders the causal chain (NTP slews wall clock; the
+monotonic origin is boot-arbitrary).
+
+The contract here:
+
+* :func:`monotonic_us` — the ONE ordering axis: integer microseconds on
+  the process monotonic clock (``time.monotonic_ns() // 1000``).
+  Integer so equality/ordering survive JSON round-trips with no float
+  drift (the PR-11 exact-sum discipline applied to time stamps).
+* :func:`to_unix_us` / :func:`unix_us` — RENDERING only: a wall-clock
+  anchor is sampled once at import (one ``(monotonic, unix)`` pair), so
+  any monotonic stamp converts to an approximate wall time through the
+  same fixed offset. Conversions are for humans reading a timeline;
+  joins and ordering must always use the monotonic stamps.
+
+Host-only, stdlib-only — importable from the no-jax monitors without
+breaking their module-scope import guards.
+"""
+
+import time
+
+# One anchor pair for the whole process, sampled back-to-back at import:
+# every renderer maps monotonic -> wall through the SAME offset, so two
+# streams' stamps keep their relative order after conversion. (The pair
+# itself is ~µs-skewed — irrelevant for rendering, which is why ordering
+# never uses converted values.)
+_ANCHOR_MONO_US = time.monotonic_ns() // 1000
+_ANCHOR_UNIX_US = time.time_ns() // 1000
+
+
+def monotonic_us():
+    """Integer microseconds on the process monotonic clock — the shared
+    ordering axis for chronicle events, ledger windows and fleet
+    records."""
+    return time.monotonic_ns() // 1000
+
+
+def monotonic_s():
+    """The same clock as :func:`monotonic_us`, in float seconds — for
+    call sites that keep second-resolution arithmetic (ledger interval
+    math) but must stay on the shared axis."""
+    return time.monotonic_ns() / 1e9
+
+
+def to_unix_us(t_us):
+    """Render a :func:`monotonic_us` stamp as approximate unix µs
+    (fixed process-wide offset; rendering only, never ordering)."""
+    return int(t_us) - _ANCHOR_MONO_US + _ANCHOR_UNIX_US
+
+
+def unix_us():
+    """Approximate unix µs of *now*, via the same anchor."""
+    return to_unix_us(monotonic_us())

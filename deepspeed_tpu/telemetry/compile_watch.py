@@ -26,6 +26,7 @@ still move the ``xla_compiles_total`` counter.
 
 import functools
 
+from deepspeed_tpu.telemetry import chronicle as _chronicle
 from deepspeed_tpu.telemetry import ledger as _ledger
 from deepspeed_tpu.telemetry import metrics as _metrics
 from deepspeed_tpu.utils.logging import logger
@@ -102,7 +103,13 @@ class CompileWatch:
                         "xla_retraces_total",
                         "NEW signatures after the first (retraces)",
                         labels={"fn": name}).inc()
-                    self.log_fn(self._report(name, state["last"], sig))
+                    report = self._report(name, state["last"], sig)
+                    self.log_fn(report)
+                    chron = _chronicle.get_chronicle()
+                    if chron.enabled:
+                        chron.emit("retrace", source="compile_watch",
+                                   severity="watch", fn=name,
+                                   retraces=self.retraces, detail=report)
                 state["last"] = sig
             return fn(*args, **kwargs)
 

@@ -1,0 +1,82 @@
+"""Shared monitor escalation protocol (one copy instead of five).
+
+Every observatory escalates firing rules the same way — the protocol the
+health monitor established in PR 3 and the ledger / serving / fleet /
+memory monitors then hand-copied (the PR-4 "deliberate duplication"
+note, grown to five copies):
+
+1. warn ONCE per rule (the first firing logs; repeats stay quiet),
+2. count the firing (``owner.rule_counts`` + the registry counter),
+3. append to the bounded ``owner.anomalies`` forensics list,
+4. throttled snapshot, FORCED when any rule fired for the first time,
+5. optional per-monitor follow-up (the ledger's one-shot profiler
+   capture) — ``after_snapshot(any_first)``,
+6. ``on_escalate`` / ``on_anomaly`` hooks, each fenced so a throwing
+   hook (trace export, guardian delivery) can never kill the step that
+   surfaced the anomaly.
+
+This helper IS that protocol; the monitors' ``_escalate`` methods are
+now one-line delegations. It deliberately mutates the owner's existing
+``rule_counts`` / ``anomalies`` objects IN PLACE (``del list[:-N]``, not
+reassignment) — tests and reports hold references to them.
+
+Step 2.5 is the one new behavior every monitor gains at once: each
+anomaly is emitted into the process-global run chronicle
+(:mod:`deepspeed_tpu.telemetry.chronicle`), which is how five siloed
+JSON artifacts become one causally-ordered timeline. The emit is a
+no-op dict-build skip when no chronicle is armed.
+"""
+
+from deepspeed_tpu.telemetry import chronicle as _chronicle
+from deepspeed_tpu.utils.logging import logger
+
+
+def escalate(owner, anoms, *, tag, counter, counter_help, step=None,
+             after_snapshot=None):
+    """Run the escalation protocol for *owner* over *anoms*.
+
+    *owner* supplies the per-monitor state and surfaces: ``rule_counts``,
+    ``anomalies``, ``MAX_ANOMALY_HISTORY``, ``registry``, ``_log``,
+    ``snapshot_path``, ``write_snapshot(force=)``, ``on_escalate``,
+    ``on_anomaly``. *tag* is the log prefix (``health``/``goodput``/...),
+    *counter*/*counter_help* the registry counter identity. *step* is the
+    ledger's variant (its rules know the window-closing step better than
+    the per-anomaly dicts); ``after_snapshot(any_first)`` is the
+    monitor-specific step 5.
+    """
+    chron = _chronicle.get_chronicle()
+    any_first = False
+    for a in anoms:
+        rule = a["rule"]
+        first = rule not in owner.rule_counts
+        any_first = any_first or first
+        owner.rule_counts[rule] = owner.rule_counts.get(rule, 0) + 1
+        owner.anomalies.append(a)
+        if first:
+            owner._log("[%s] %s (%s) at step %s: %s — snapshot -> %s",
+                       tag, rule, a["severity"],
+                       step if step is not None else a.get("step"),
+                       a["detail"], owner.snapshot_path)
+        if owner.registry is not None:
+            owner.registry.counter(counter, counter_help,
+                                   labels={"rule": rule}).inc()
+        if chron.enabled:
+            chron.emit("anomaly", source=tag,
+                       step=step if step is not None else a.get("step"),
+                       severity=a.get("severity"), rule=rule,
+                       detail=a.get("detail"),
+                       artifact=owner.snapshot_path)
+    del owner.anomalies[:-owner.MAX_ANOMALY_HISTORY]
+    owner.write_snapshot(force=any_first)
+    if after_snapshot is not None:
+        after_snapshot(any_first)
+    if owner.on_escalate is not None:
+        try:
+            owner.on_escalate()
+        except Exception as e:   # forensics must never kill a step
+            logger.warning("[%s] on_escalate hook failed: %s", tag, e)
+    if owner.on_anomaly is not None:
+        try:
+            owner.on_anomaly(anoms)
+        except Exception as e:   # a policy engine must not either
+            logger.warning("[%s] on_anomaly hook failed: %s", tag, e)

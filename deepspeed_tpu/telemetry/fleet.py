@@ -83,6 +83,8 @@ import time
 import weakref
 from collections import deque
 
+from deepspeed_tpu.telemetry import clock as _clk
+from deepspeed_tpu.telemetry import escalation
 from deepspeed_tpu.telemetry.health import build_bucket_spec, json_safe
 from deepspeed_tpu.telemetry.ledger import suppress_attribution
 from deepspeed_tpu.utils.logging import logger
@@ -172,11 +174,11 @@ class _CatTimer:
         self._cat = cat
 
     def __enter__(self):
-        self._t0 = time.perf_counter()
+        self._t0 = _clk.monotonic_s()
         return self
 
     def __exit__(self, *exc):
-        us = int((time.perf_counter() - self._t0) * 1e6)
+        us = int((_clk.monotonic_s() - self._t0) * 1e6)
         if us > 0:
             self._acc[self._cat] += us
         return False
@@ -278,7 +280,10 @@ class FleetShipper:
         self._ledger = None
         self._led_totals = None
         self._led_elapsed = 0.0
-        self._t_last = time.perf_counter()
+        # the shared telemetry axis (clock.py) — shipper windows join
+        # against chronicle events and ledger windows with no
+        # perf_counter/monotonic mix
+        self._t_last = _clk.monotonic_s()
         self._step_sum_us = 0
         self._step_max_us = 0
         self._step_n = 0
@@ -355,7 +360,7 @@ class FleetShipper:
         the cross-rank window join)."""
         if not self.enabled or self._step_n == 0:
             return None
-        now = time.perf_counter()
+        now = _clk.monotonic_s()
         categories_us = None
         goodput_fraction = None
         if self._ledger is not None and self._ledger.enabled:
@@ -401,7 +406,10 @@ class FleetShipper:
             "health": health,
             "desync": desync,
             "serving": list(self._serving) or None,
-            "ts": round(time.time(), 3),
+            # t_us is the join stamp (shared monotonic axis); ts renders
+            # it as wall time through the process-wide anchor
+            "t_us": _clk.monotonic_us(),
+            "ts": round(_clk.unix_us() / 1e6, 3),
         }
         if force:
             record["forced"] = True
@@ -999,34 +1007,10 @@ class FleetMonitor:
 
     # ---------------------------------------------------------- escalation
     def _escalate(self, anoms):
-        any_first = False
-        for a in anoms:
-            rule = a["rule"]
-            first = rule not in self.rule_counts
-            any_first = any_first or first
-            self.rule_counts[rule] = self.rule_counts.get(rule, 0) + 1
-            self.anomalies.append(a)
-            if first:
-                self._log("[fleet] %s (%s) at step %s: %s — snapshot "
-                          "-> %s", rule, a["severity"], a.get("step"),
-                          a["detail"], self.snapshot_path)
-            if self.registry is not None:
-                self.registry.counter(
-                    "fleet_anomalies_total",
-                    "fleet cross-rank rule firings",
-                    labels={"rule": rule}).inc()
-        del self.anomalies[:-self.MAX_ANOMALY_HISTORY]
-        self.write_snapshot(force=any_first)
-        if self.on_escalate is not None:
-            try:
-                self.on_escalate()
-            except Exception as e:   # forensics must never kill a step
-                logger.warning("[fleet] on_escalate hook failed: %s", e)
-        if self.on_anomaly is not None:
-            try:
-                self.on_anomaly(anoms)
-            except Exception as e:   # a policy engine must not either
-                logger.warning("[fleet] on_anomaly hook failed: %s", e)
+        # the shared protocol (telemetry/escalation.py)
+        escalation.escalate(self, anoms, tag="fleet",
+                            counter="fleet_anomalies_total",
+                            counter_help="fleet cross-rank rule firings")
 
     # -------------------------------------------------------------- output
     def verdict(self):

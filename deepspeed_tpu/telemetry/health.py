@@ -38,6 +38,7 @@ import time
 from collections import deque
 from typing import NamedTuple, Tuple
 
+from deepspeed_tpu.telemetry import escalation
 from deepspeed_tpu.utils.logging import logger
 
 # the provenance bitmask is a uint32: at most 32 buckets, ever
@@ -397,36 +398,13 @@ class HealthMonitor:
 
     # ---------------------------------------------------------- escalation
     def _escalate(self, anoms):
-        any_first = False
-        for a in anoms:
-            rule = a["rule"]
-            first = rule not in self.rule_counts
-            any_first = any_first or first
-            self.rule_counts[rule] = self.rule_counts.get(rule, 0) + 1
-            self.anomalies.append(a)
-            if first:
-                self._log("[health] %s (%s) at step %s: %s — snapshot -> %s",
-                          rule, a["severity"], a.get("step"), a["detail"],
-                          self.snapshot_path)
-            if self.registry is not None:
-                self.registry.counter(
-                    "health_anomalies_total",
-                    "training-health anomaly rule firings",
-                    labels={"rule": rule}).inc()
-        del self.anomalies[:-self.MAX_ANOMALY_HISTORY]
-        # a first-time rule always snapshots (the forensics file must name
-        # it); repeat firings ride the throttle
-        self.write_snapshot(force=any_first)
-        if self.on_escalate is not None:
-            try:
-                self.on_escalate()
-            except Exception as e:   # forensics must never kill a step
-                logger.warning("[health] on_escalate hook failed: %s", e)
-        if self.on_anomaly is not None:
-            try:
-                self.on_anomaly(anoms)
-            except Exception as e:   # a policy engine must not either
-                logger.warning("[health] on_anomaly hook failed: %s", e)
+        # the shared protocol (telemetry/escalation.py): warn-once ->
+        # counters -> bounded history -> forced-first snapshot ->
+        # chronicle emit -> hooks
+        escalation.escalate(self, anoms, tag="health",
+                            counter="health_anomalies_total",
+                            counter_help="training-health anomaly rule "
+                                         "firings")
 
     # ------------------------------------------------------------- outputs
     def verdict(self):

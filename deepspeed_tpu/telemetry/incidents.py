@@ -1,0 +1,225 @@
+"""Incident correlator — chronicle events -> causal incident chains.
+
+The run chronicle gives every subsystem one ordered event axis; this
+module answers the operator's actual question: *"what happened, in what
+order, starting where, and what did it cost?"*. It joins chronicle
+events into **incidents** — maximal chains of causally-related events —
+and writes INCIDENTS.json.
+
+Join rules (:class:`IncidentCorrelator`): an event joins the open
+incident when ANY of
+
+* **causal hint** — it shares a join key with a member: the same
+  ``rule`` (an anomaly firing and the guardian action it triggered — the
+  rule->action edge), the same ``request_id`` (a serving request's
+  admission/preemption trail), or the same ``tag`` (a checkpoint save
+  and the rollback that restored it);
+* **step window** — its ``step`` is within ``step_window`` of a member
+  step (a poison at step 8 and the nonfinite firing it causes at 9);
+* **time window** — it lands within ``time_window_us`` of the incident's
+  last member (wall-adjacent cascades with no step, e.g. serving).
+
+Only *symptom* kinds (anomaly / action / chaos / serving / retrace) form
+incidents; lifecycle and goodput_window events are context. Root-cause
+ranking: the EARLIEST causally-linked anomaly-or-chaos event wins, ties
+broken by severity (a chaos injection outranks everything it caused by
+construction — it is first on the shared µs axis, so a poison-then-
+diverge run names the poison step, not the loud rollback).
+
+Per-incident **goodput cost** comes from the ledger's ``goodput_window``
+events (integer-µs category diffs): every window overlapping the
+incident's time span contributes its badput microseconds, category by
+category — so the cost figure re-adds exactly against the ledger's own
+window ring (pinned by the artifact tests). Incidents also link the
+sibling snapshot artifacts (HEALTH/GOODPUT/GUARDIAN/...) that member
+events escalated into, so the flat JSON families become navigable from
+the timeline.
+
+Host-only, stdlib-only.
+"""
+
+import json
+
+from deepspeed_tpu.telemetry.chronicle import (_atomic_write_bytes,
+                                               _severity_rank)
+
+INCIDENTS_SCHEMA = "deepspeed_tpu.incidents/1"
+
+# kinds that can MAKE an incident; everything else is context
+MEMBER_KINDS = frozenset({"anomaly", "action", "chaos", "serving",
+                          "retrace"})
+# badput = every goodput-ledger category except the good two
+GOOD_CATEGORIES = frozenset({"device_compute", "host_dispatch"})
+
+
+def _join_keys(event):
+    keys = set()
+    for field in ("rule", "request_id", "tag"):
+        v = event.get(field)
+        if v is not None:
+            keys.add((field, v))
+    return keys
+
+
+class IncidentCorrelator:
+    """Correlate an event list (one rank's chronicle or a merged run
+    dir) into incidents. Pure function of its inputs — construct, call
+    :meth:`correlate`, discard."""
+
+    def __init__(self, events, step_window=8, time_window_us=30_000_000):
+        self.events = sorted(events,
+                             key=lambda e: (e["t_us"], e.get("rank", 0),
+                                            e["seq"]))
+        self.step_window = int(step_window)
+        self.time_window_us = int(time_window_us)
+
+    # ------------------------------------------------------------ clustering
+    def _joins(self, incident, event):
+        if _join_keys(event) & incident["keys"]:
+            return True
+        step = event.get("step")
+        if step is not None and incident["steps"]:
+            if min(abs(step - s) for s in incident["steps"]) \
+                    <= self.step_window:
+                return True
+            return False     # a known-far step never time-joins
+        return event["t_us"] - incident["end_t_us"] <= self.time_window_us
+
+    def correlate(self):
+        incidents = []
+        for e in self.events:
+            if e["kind"] not in MEMBER_KINDS:
+                continue
+            open_inc = incidents[-1] if incidents else None
+            if open_inc is not None and self._joins(open_inc, e):
+                open_inc["members"].append(e)
+                open_inc["keys"] |= _join_keys(e)
+                if e.get("step") is not None:
+                    open_inc["steps"].add(e["step"])
+                open_inc["end_t_us"] = e["t_us"]
+            else:
+                incidents.append({
+                    "members": [e], "keys": _join_keys(e),
+                    "steps": ({e["step"]} if e.get("step") is not None
+                              else set()),
+                    "start_t_us": e["t_us"], "end_t_us": e["t_us"],
+                })
+        return [self._finish(i, n) for n, i in enumerate(incidents)]
+
+    # ------------------------------------------------------------- finishing
+    def _root_cause(self, members):
+        causes = [m for m in members if m["kind"] in ("anomaly", "chaos")]
+        if not causes:
+            causes = members
+        best = min(causes, key=lambda m: (m["t_us"],
+                                          _severity_rank(m.get("severity")),
+                                          m["seq"]))
+        rc = {k: best[k] for k in ("seq", "t_us", "kind", "source")}
+        for k in ("step", "rule", "chaos", "severity", "detail"):
+            if k in best:
+                rc[k] = best[k]
+        rc["why"] = ("earliest causally-linked "
+                     f"{'chaos injection' if best['kind'] == 'chaos' else 'anomaly'}"
+                     " on the shared µs axis"
+                     + (", severity tie-break" if len(
+                         [c for c in causes
+                          if c["t_us"] == best["t_us"]]) > 1 else ""))
+        return rc
+
+    def _goodput_cost(self, start_us, end_us):
+        """Badput µs from every goodput_window overlapping the span.
+        Each window event covers [t_us - dur_us, t_us]."""
+        windows, badput = [], {}
+        for e in self.events:
+            if e["kind"] != "goodput_window":
+                continue
+            w_end, w_start = e["t_us"], e["t_us"] - int(e["dur_us"])
+            if w_end < start_us or w_start > end_us:
+                continue
+            windows.append(e.get("index"))
+            for c, us in e.get("categories_us", {}).items():
+                if c not in GOOD_CATEGORIES:
+                    badput[c] = badput.get(c, 0) + int(us)
+        if not windows:
+            return None
+        return {"window_indices": windows,
+                "badput_us": badput,
+                "badput_total_us": sum(badput.values())}
+
+    def _finish(self, inc, n):
+        members = inc["members"]
+        sev = min((m.get("severity") for m in members
+                   if m.get("severity")), key=_severity_rank,
+                  default=None)
+        artifacts = []
+        for m in members:
+            a = m.get("artifact")
+            if a and a not in artifacts:
+                artifacts.append(a)
+        steps = sorted(inc["steps"])
+        return {
+            "id": n,
+            "start_t_us": inc["start_t_us"],
+            "end_t_us": inc["end_t_us"],
+            "duration_us": inc["end_t_us"] - inc["start_t_us"],
+            "start_step": steps[0] if steps else None,
+            "end_step": steps[-1] if steps else None,
+            "severity": sev,
+            "rules": sorted({m["rule"] for m in members if "rule" in m}),
+            "actions": sorted({m["action"] for m in members
+                               if "action" in m}),
+            "root_cause": self._root_cause(members),
+            "goodput_cost": self._goodput_cost(inc["start_t_us"],
+                                               inc["end_t_us"]),
+            "artifacts": artifacts,
+            "events": members,
+        }
+
+
+def correlate(events, step_window=8, time_window_us=30_000_000,
+              job_name=""):
+    """One-call front door: events -> the INCIDENTS.json document."""
+    incidents = IncidentCorrelator(
+        events, step_window=step_window,
+        time_window_us=time_window_us).correlate()
+    return {
+        "schema": INCIDENTS_SCHEMA,
+        "job_name": job_name,
+        "n_events": len(events),
+        "params": {"step_window": int(step_window),
+                   "time_window_us": int(time_window_us)},
+        "incidents": incidents,
+    }
+
+
+def write_incidents(doc, path):
+    _atomic_write_bytes(path, json.dumps(doc, indent=1, default=repr,
+                                         allow_nan=False).encode())
+    return path
+
+
+def render(doc):
+    """Human-readable rendering of an INCIDENTS.json document."""
+    incs = doc.get("incidents", [])
+    lines = [f"incidents: {len(incs)} from {doc.get('n_events', 0)} "
+             f"event(s)"]
+    for i in incs:
+        rc = i.get("root_cause") or {}
+        cost = i.get("goodput_cost") or {}
+        lines.append(
+            f"  #{i['id']} [{i.get('severity') or '-'}] steps "
+            f"{i.get('start_step')}–{i.get('end_step')}, "
+            f"{i['duration_us'] / 1e3:.1f} ms, {len(i['events'])} "
+            f"event(s)")
+        lines.append(
+            f"      root cause: {rc.get('kind')}/{rc.get('source')} "
+            f"{rc.get('rule') or rc.get('chaos') or ''} at step "
+            f"{rc.get('step')} — {rc.get('why')}")
+        if cost:
+            lines.append(
+                f"      goodput cost: "
+                f"{cost.get('badput_total_us', 0) / 1e6:.3f} s badput "
+                f"across windows {cost.get('window_indices')}")
+        for a in i.get("artifacts", []):
+            lines.append(f"      artifact: {a}")
+    return "\n".join(lines)

@@ -55,6 +55,9 @@ import threading
 import time
 from collections import deque
 
+from deepspeed_tpu.telemetry import chronicle as _chronicle
+from deepspeed_tpu.telemetry import clock as _clk
+from deepspeed_tpu.telemetry import escalation
 from deepspeed_tpu.utils.logging import logger
 
 GOODPUT_SCHEMA = "deepspeed_tpu.goodput/1"
@@ -228,7 +231,9 @@ class GoodputLedger:
         self.on_anomaly = on_anomaly
         self.breakdown_fn = None     # engine wires wall_clock_breakdown
         self._log = log_fn or logger.warning
-        self._clock = time.monotonic
+        # the shared telemetry axis (clock.py): ledger windows must be
+        # joinable against chronicle events with no wall/monotonic mix
+        self._clock = _clk.monotonic_s
         if not self.enabled:
             return
 
@@ -446,6 +451,18 @@ class GoodputLedger:
         self.ring.append(window)
         self.last_window = window
         self._publish(totals, elapsed, window)
+        chron = _chronicle.get_chronicle()
+        if chron.enabled:
+            # integer-µs category diffs so an incident's goodput cost is
+            # computable (and re-addable) from chronicle events alone
+            chron.emit(
+                "goodput_window", source="goodput", step=step,
+                index=window["index"],
+                dur_us=int(round(dur * 1e6)),
+                categories_us={c: int(round(s * 1e6))
+                               for c, s in cats.items()},
+                goodput_fraction=window["goodput_fraction"],
+                forced=bool(force) or None)
         if not force:
             self.windows_closed += 1
             if self.windows_closed > self.warmup_windows:
@@ -509,36 +526,15 @@ class GoodputLedger:
 
     # ------------------------------------------------------------ escalation
     def _escalate(self, anoms, step):
-        any_first = False
-        for a in anoms:
-            rule = a["rule"]
-            first = rule not in self.rule_counts
-            any_first = any_first or first
-            self.rule_counts[rule] = self.rule_counts.get(rule, 0) + 1
-            self.anomalies.append(a)
-            if first:
-                self._log("[goodput] %s (%s) at step %s: %s — "
-                          "snapshot -> %s", rule, a["severity"], step,
-                          a["detail"], self.snapshot_path)
-            if self.registry is not None:
-                self.registry.counter(
-                    "goodput_anomalies_total",
-                    "goodput-ledger badput rule firings",
-                    labels={"rule": rule}).inc()
-        del self.anomalies[:-self.MAX_ANOMALY_HISTORY]
-        self.write_snapshot(force=any_first)
-        if any_first:
-            self._maybe_start_capture(step)
-        if self.on_escalate is not None:
-            try:
-                self.on_escalate()
-            except Exception as e:  # forensics must never kill a step
-                logger.warning("[goodput] on_escalate hook failed: %s", e)
-        if self.on_anomaly is not None:
-            try:
-                self.on_anomaly(anoms)
-            except Exception as e:  # a policy engine must not either
-                logger.warning("[goodput] on_anomaly hook failed: %s", e)
+        # the shared protocol (telemetry/escalation.py) + the ledger's
+        # step 5: a first-time rule starts the one-shot profiler capture
+        escalation.escalate(
+            self, anoms, tag="goodput",
+            counter="goodput_anomalies_total",
+            counter_help="goodput-ledger badput rule firings",
+            step=step,
+            after_snapshot=lambda any_first: (
+                self._maybe_start_capture(step) if any_first else None))
 
     # ------------------------------------------------------ profiler capture
     def _maybe_start_capture(self, step):

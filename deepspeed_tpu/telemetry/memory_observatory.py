@@ -46,6 +46,7 @@ import os
 import time
 from collections import deque
 
+from deepspeed_tpu.telemetry import escalation
 from deepspeed_tpu.telemetry import pprof
 from deepspeed_tpu.telemetry.health import json_safe
 from deepspeed_tpu.utils.logging import logger
@@ -396,34 +397,11 @@ class MemoryMonitor:
 
     # ---------------------------------------------------------- escalation
     def _escalate(self, anoms):
-        any_first = False
-        for a in anoms:
-            rule = a["rule"]
-            first = rule not in self.rule_counts
-            any_first = any_first or first
-            self.rule_counts[rule] = self.rule_counts.get(rule, 0) + 1
-            self.anomalies.append(a)
-            if first:
-                self._log("[memory] %s (%s) at step %s: %s — snapshot "
-                          "-> %s", rule, a["severity"], a.get("step"),
-                          a["detail"], self.snapshot_path)
-            if self.registry is not None:
-                self.registry.counter(
-                    "memory_anomalies_total",
-                    "device-memory anomaly rule firings",
-                    labels={"rule": rule}).inc()
-        del self.anomalies[:-self.MAX_ANOMALY_HISTORY]
-        self.write_snapshot(force=any_first)
-        if self.on_escalate is not None:
-            try:
-                self.on_escalate()
-            except Exception as e:   # forensics must never kill a step
-                logger.warning("[memory] on_escalate hook failed: %s", e)
-        if self.on_anomaly is not None:
-            try:
-                self.on_anomaly(anoms)
-            except Exception as e:   # a policy engine must not either
-                logger.warning("[memory] on_anomaly hook failed: %s", e)
+        # the shared protocol (telemetry/escalation.py)
+        escalation.escalate(self, anoms, tag="memory",
+                            counter="memory_anomalies_total",
+                            counter_help="device-memory anomaly rule "
+                                         "firings")
 
     # ------------------------------------------------------------- outputs
     def verdict(self):

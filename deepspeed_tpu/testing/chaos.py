@@ -27,7 +27,20 @@ import random
 import signal
 import time
 
+from deepspeed_tpu.telemetry import chronicle as _chronicle
 from deepspeed_tpu.utils.logging import logger
+
+
+def _chronicle_chaos(name, step=None, detail=None, **data):
+    """Every injector names its own ground truth in the run chronicle:
+    a chaos-driven run's incident timeline starts at the injection, so
+    the correlator can rank the poison — not the loudest symptom — as
+    root cause."""
+    chron = _chronicle.get_chronicle()
+    if chron.enabled:
+        chron.emit("chaos", source="chaos", step=step,
+                   severity="critical", chaos=name, detail=detail,
+                   **data)
 
 
 class ChaosFault(OSError):
@@ -155,6 +168,10 @@ class FilesystemChaos(Injector):
                     tmp = f"{path}{checkpoint_io._TMP_MARK}chaos"
                     with open(tmp, "wb") as f:
                         write_fn(f)
+                _chronicle_chaos(
+                    "filesystem",
+                    detail=f"injected {self.op} failure for "
+                           f"{os.path.basename(path)}")
                 raise ChaosFault(
                     errno.EIO,
                     f"chaos: injected {self.op} failure "
@@ -198,6 +215,10 @@ class DivergenceChaos(Injector):
         eng.state = eng.state._replace(
             params=jax.tree_util.tree_unflatten(treedef, poisoned))
         self.poisoned_steps.append(int(eng.global_steps))
+        _chronicle_chaos(
+            "divergence", step=int(eng.global_steps),
+            detail=f"params poisoned with {self.value} before "
+                   f"train_batch call {self.calls}")
         logger.warning(
             f"chaos: poisoned params with {self.value} before train_batch "
             f"call {self.calls} (global_step {eng.global_steps})")
@@ -267,6 +288,11 @@ class SigkillChaos:
     def maybe_kill(self, step):
         if int(step) == self.at_step:
             logger.warning(f"chaos: SIGKILL at step {step}")
+            _chronicle_chaos("sigkill", step=int(step),
+                             detail="SIGKILL injected (no teardown)")
+            # SIGKILL means no atexit: push the event to disk first so
+            # the post-mortem stream ends with its own cause of death
+            _chronicle.get_chronicle().drain(timeout=2.0)
             os.kill(os.getpid(), signal.SIGKILL)
 
 

@@ -88,7 +88,7 @@ def _tiny_engine(ce_enabled, health_enabled=False, goodput_enabled=False,
                  prefetch_enabled=False, comm_overlap=False,
                  fleet_enabled=False, guardian_enabled=False,
                  memory_enabled=False, memory_cadence=0,
-                 steps_per_print=10 ** 9):
+                 chronicle_enabled=False, steps_per_print=10 ** 9):
     import tempfile
 
     import jax
@@ -113,6 +113,13 @@ def _tiny_engine(ce_enabled, health_enabled=False, goodput_enabled=False,
         gdir = tempfile.mkdtemp(prefix="ds_guardian_oh_")
         guardian_cfg = {"enabled": True,
                         "journal_file": os.path.join(gdir, "GUARDIAN.json")}
+    chronicle_cfg = {"enabled": False}
+    if chronicle_enabled:
+        cdir = tempfile.mkdtemp(prefix="ds_chron_oh_")
+        chronicle_cfg = {
+            "enabled": True, "run_dir": os.path.join(cdir, "chronicle"),
+            "summary_file": os.path.join(cdir, "CHRONICLE.json"),
+            "incidents_file": os.path.join(cdir, "INCIDENTS.json")}
     engine, _, _, _ = deepspeed_tpu.initialize(
         model=GPT2LMHeadModel(cfg),
         config={"train_batch_size": 8,
@@ -130,6 +137,7 @@ def _tiny_engine(ce_enabled, health_enabled=False, goodput_enabled=False,
                                           "profiler_capture": False},
                               "memory": {"enabled": memory_enabled,
                                          "cadence": memory_cadence},
+                              "chronicle": chronicle_cfg,
                               "fleet": fleet_cfg}},
         sample_batch=batch)
     return engine, batch
@@ -887,6 +895,105 @@ def check_goodput_disabled_inert(steps=3):
           f"{per_us:.3f} us/attribute")
 
 
+def check_chronicle_armed_zero_extra_compiles(steps=20, cadence=5):
+    """Chronicle ARMED with every training-side emitter feeding it
+    (health anomaly traffic would too, but this is the healthy-run cost)
+    — still exactly ONE train-step compile over 20 steady-state steps.
+    The chronicle owns zero compiled programs: emits are host-side
+    appends, and the correlator runs off-path at report time."""
+    from deepspeed_tpu.telemetry import chronicle as chron_mod
+    engine, batch = _tiny_engine(ce_enabled=True, health_enabled=True,
+                                 goodput_enabled=True,
+                                 chronicle_enabled=True,
+                                 steps_per_print=cadence)
+    chron = engine._chronicle
+    assert chron is not None and chron.enabled, "chronicle must be armed"
+    assert chron_mod.get_chronicle() is chron, \
+        "the engine's chronicle must be the process-global one"
+    engine.train_batch(batch=batch)       # the one compile
+    after_prime = _backend_compiles(engine)
+    for _ in range(steps - 1):
+        engine.train_batch(batch=batch)
+    after_steps = _backend_compiles(engine)
+    assert after_steps == after_prime, (
+        f"armed chronicle changed compilation: {after_prime} -> "
+        f"{after_steps} over {steps} steps — the chronicle must own "
+        f"zero compiled programs")
+    events = chron.snapshot_events()
+    kinds = {e["kind"] for e in events}
+    assert "lifecycle" in kinds and "goodput_window" in kinds, (
+        f"armed run emitted no lifecycle/goodput events (kinds={kinds}) "
+        f"— the emitter wiring rotted")
+    doc = engine.chronicle_report()
+    assert doc["incidents"]["incidents"] == [], \
+        "a healthy run must correlate into zero incidents"
+    engine.close()
+    assert not chron_mod.get_chronicle().enabled, \
+        "close must detach the global chronicle"
+    print(f"chronicle armed path: 1 compile over {steps} steps, "
+          f"{len(events)} events, 0 incidents")
+
+
+def check_chronicle_disabled_emit_under_2us(iters=100_000):
+    """telemetry.chronicle off (the default) => the global chronicle is
+    the disabled singleton and a hot-path emit through it fits the same
+    <2 µs budget as the disabled tracer — monitors can emit
+    unconditionally without checking ``enabled`` first."""
+    from deepspeed_tpu.telemetry import chronicle as chron_mod
+    chron_mod.reset_chronicle()
+    chron = chron_mod.get_chronicle()
+    assert not chron.enabled
+    emit = chron.emit
+    t0 = time.perf_counter()
+    for i in range(iters):
+        emit("anomaly", source="health", step=i, rule="loss_spike")
+    per_us = (time.perf_counter() - t0) / iters * 1e6
+    assert per_us < DISABLED_BUDGET_US, (
+        f"disabled chronicle emit {per_us:.3f} us exceeds the "
+        f"{DISABLED_BUDGET_US} us budget")
+    assert chron.snapshot_events() == []
+    print(f"disabled chronicle path: {per_us:.3f} us/emit, 0 retained")
+
+
+def check_chronicle_writer_books_nothing_into_ledger(events=500):
+    """The background stream writer runs under the ledger's
+    ``suppress_attribution()`` — shipping events must leave every booked
+    goodput category EXACTLY unchanged (the writer's wall time is the
+    run's background noise, not train-loop badput)."""
+    import tempfile
+
+    from deepspeed_tpu.telemetry import chronicle as chron_mod
+    from deepspeed_tpu.telemetry import ledger as ledger_mod
+    led = ledger_mod.GoodputLedger(profiler_capture=False)
+    prev = ledger_mod.get_ledger()
+    ledger_mod.set_ledger(led)
+    try:
+        with led.attribute("host_dispatch"):
+            pass
+        before = dict(led.report()["categories_s"])
+        run_dir = tempfile.mkdtemp(prefix="ds_chron_writer_")
+        chron = chron_mod.RunChronicle(run_dir=run_dir, rank=0,
+                                       background=True)
+        for i in range(events):
+            chron.emit("anomaly", source="health", step=i,
+                       rule="loss_spike", severity="watch")
+        chron.drain()
+        chron.close()
+        after = led.report()["categories_s"]
+        for cat, booked in before.items():
+            if cat == "unattributed":
+                continue   # the wall-clock residual grows with time
+            assert after[cat] == booked, (
+                f"chronicle writer booked into {cat!r}: "
+                f"{booked} -> {after[cat]}")
+        assert len(chron_mod.load_events(run_dir)) == events
+    finally:
+        ledger_mod.set_ledger(prev)
+        led.close()
+    print(f"chronicle writer: {events} events shipped, "
+          f"0 s booked into the ledger")
+
+
 def main(iters=200_000):
     from deepspeed_tpu.telemetry import Tracer
 
@@ -925,6 +1032,9 @@ def main(iters=200_000):
     check_memory_obs_no_device_access()
     check_guardian_armed_zero_overhead()
     check_guardian_disabled_inert()
+    check_chronicle_armed_zero_extra_compiles()
+    check_chronicle_disabled_emit_under_2us()
+    check_chronicle_writer_books_nothing_into_ledger()
     print("OK")
 
 

@@ -1,0 +1,499 @@
+"""Run chronicle + incident correlator tests.
+
+Unit side: the clock axis, RunChronicle ordering/cap/stream/global
+discipline, the shared escalation protocol's chronicle emit, and the
+correlator's join rules / root-cause ranking / goodput-cost re-add on
+synthetic event lists.
+
+E2E side is the tentpole acceptance pin: a real engine with the
+chronicle armed, DivergenceChaos poison -> nonfinite streak -> guardian
+rollback — the whole cascade collapses into exactly ONE incident whose
+root cause is the poison step, the timeline is strictly (t_us, seq)
+ordered, and the incident's goodput cost re-adds against the ledger's
+own window ring.
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.simple import SimpleModel, sample_batch
+from deepspeed_tpu.runtime.dataloader import RepeatingLoader
+from deepspeed_tpu.telemetry import chronicle, clock, escalation, incidents
+from deepspeed_tpu.telemetry.chronicle import RunChronicle
+from deepspeed_tpu.testing.chaos import DivergenceChaos
+from deepspeed_tpu.utils import groups
+
+HIDDEN = 32
+
+
+@pytest.fixture(autouse=True)
+def _clean_global():
+    chronicle.reset_chronicle()
+    yield
+    chronicle.reset_chronicle()
+
+
+# ================================================================= clock
+def test_monotonic_us_is_integer_and_nondecreasing():
+    a = clock.monotonic_us()
+    b = clock.monotonic_us()
+    assert isinstance(a, int) and isinstance(b, int)
+    assert b >= a
+
+
+def test_to_unix_us_anchor_consistency():
+    t = clock.monotonic_us()
+    u = clock.to_unix_us(t)
+    # the anchor pair was sampled together at import: converting "now"
+    # must land within a few seconds of the wall clock
+    import time
+    assert abs(u / 1e6 - time.time()) < 5.0
+    # conversion is a pure offset: deltas survive exactly
+    assert clock.to_unix_us(t + 123) - u == 123
+
+
+# ============================================================ RunChronicle
+def test_emit_is_strictly_ordered_and_sequenced():
+    c = RunChronicle()
+    for i in range(50):
+        c.emit("anomaly", source="health", step=i)
+    ev = c.snapshot_events()
+    assert [e["seq"] for e in ev] == list(range(50))
+    keys = [(e["t_us"], e["seq"]) for e in ev]
+    assert keys == sorted(keys)
+    assert all(keys[i] < keys[i + 1] for i in range(len(keys) - 1))
+    c.close()
+
+
+def test_emit_threaded_ordering_holds():
+    c = RunChronicle()
+
+    def emitter(tag):
+        for i in range(100):
+            c.emit("anomaly", source=tag, step=i)
+
+    threads = [threading.Thread(target=emitter, args=(f"t{k}",))
+               for k in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    ev = c.snapshot_events()
+    assert len(ev) == 400
+    keys = [(e["t_us"], e["seq"]) for e in ev]
+    assert all(keys[i] < keys[i + 1] for i in range(len(keys) - 1)), \
+        "stamp+seq must be taken inside the lock"
+    c.close()
+
+
+def test_cap_drops_new_events_and_counts():
+    c = RunChronicle(max_events=5)
+    for i in range(9):
+        c.emit("anomaly", source="health", step=i)
+    ev = c.snapshot_events()
+    # append-only: the committed PREFIX survives, the tail drops
+    assert [e["step"] for e in ev] == [0, 1, 2, 3, 4]
+    assert c.dropped == 4
+    assert c.report()["dropped"] == 4
+    c.close()
+
+
+def test_disabled_and_global_pattern():
+    d = chronicle.get_chronicle()
+    assert d.enabled is False
+    assert d.emit("anomaly", source="x") is None
+    assert d.snapshot_events() == []
+    c = RunChronicle()
+    old = chronicle.set_chronicle(c)
+    assert old is d
+    assert chronicle.get_chronicle() is c
+    # reset with a NON-current instance is a no-op
+    chronicle.reset_chronicle(if_current=RunChronicle(enabled=False))
+    assert chronicle.get_chronicle() is c
+    chronicle.reset_chronicle(if_current=c)
+    assert chronicle.get_chronicle().enabled is False
+    # set_chronicle(None) installs the disabled instance, never None
+    chronicle.set_chronicle(None)
+    assert chronicle.get_chronicle() is not None
+    c.close()
+
+
+def test_stream_written_atomically_and_round_trips(tmp_path):
+    run_dir = str(tmp_path / "run")
+    c = RunChronicle(run_dir=run_dir, rank=0, background=False)
+    c.emit("anomaly", source="health", step=1, severity="warning",
+           rule="loss_spike", detail="x")
+    c.emit("action", source="guardian", step=2, rule="loss_spike",
+           action="rollback")
+    c.close()
+    stream = os.path.join(run_dir, "events_rank_00000.jsonl")
+    assert os.path.isfile(stream)
+    assert not [f for f in os.listdir(run_dir) if ".tmp." in f], \
+        "no tmp debris after atomic rename"
+    ev = chronicle.load_events(stream)
+    assert [e["kind"] for e in ev] == ["anomaly", "action"]
+    # dir form merges + orders the same stream
+    assert chronicle.load_events(run_dir) == ev
+
+
+def test_background_writer_drains_and_joins(tmp_path):
+    run_dir = str(tmp_path / "run")
+    c = RunChronicle(run_dir=run_dir, rank=3)
+    for i in range(20):
+        c.emit("anomaly", source="health", step=i)
+    c.drain()
+    ev = chronicle.load_events(os.path.join(run_dir,
+                                            "events_rank_00003.jsonl"))
+    assert len(ev) == 20 and all(e["rank"] == 3 for e in ev)
+    thread = c._wthread
+    c.close()
+    assert not thread.is_alive()
+    # idempotent: double close and post-close emits never raise
+    c.close()
+    assert c.emit("anomaly", source="health") is None
+    assert len(c.snapshot_events()) == 20
+
+
+def test_nonfinite_values_serialise_strictly():
+    c = RunChronicle()
+    c.emit("anomaly", source="health", step=1,
+           loss=float("nan"), bound=float("inf"),
+           weird=object())
+    payload = json.dumps(c.report(), allow_nan=False)
+    doc = json.loads(payload,
+                     parse_constant=lambda s: pytest.fail(f"bare {s}"))
+    e = doc["events"][0]
+    assert e["loss"] == "nan" and e["bound"] == "inf"
+    c.close()
+
+
+def test_write_summary_strict_parses(tmp_path):
+    c = RunChronicle()
+    c.emit("chaos", source="chaos", step=4, chaos="divergence")
+    path = str(tmp_path / "CHRONICLE.json")
+    c.write_summary(path)
+    doc = json.load(open(path),
+                    parse_constant=lambda s: pytest.fail(f"bare {s}"))
+    assert doc["schema"] == chronicle.CHRONICLE_SCHEMA
+    assert doc["n_events"] == 1
+    c.close()
+
+
+def test_render_names_the_events():
+    c = RunChronicle()
+    c.emit("chaos", source="chaos", step=4, severity="critical",
+           chaos="divergence", detail="poisoned")
+    c.emit("action", source="guardian", step=5, action="rollback",
+           rule="loss_spike")
+    out = chronicle.render(c.snapshot_events())
+    assert "divergence" in out and "rollback" in out
+    assert "chaos" in out and "guardian" in out
+    c.close()
+
+
+# ============================================== shared escalation protocol
+class _FakeOwner:
+    MAX_ANOMALY_HISTORY = 4
+
+    def __init__(self):
+        self.rule_counts = {}
+        self.anomalies = []
+        self.registry = None
+        self.snapshot_path = "X.json"
+        self.on_escalate = None
+        self.on_anomaly = None
+        self.snapshots = []
+        self.logs = []
+
+    def _log(self, fmt, *args):
+        self.logs.append(fmt % args)
+
+    def write_snapshot(self, force=False):
+        self.snapshots.append(force)
+
+
+def _anoms(*rules, step=7):
+    return [{"rule": r, "step": step, "severity": "warning",
+             "detail": f"{r} fired"} for r in rules]
+
+
+def test_escalate_emits_into_chronicle_once_per_anomaly():
+    c = RunChronicle()
+    chronicle.set_chronicle(c)
+    owner = _FakeOwner()
+    escalation.escalate(owner, _anoms("loss_spike", "grad_norm_spike"),
+                        tag="health", counter="health_anomalies_total",
+                        counter_help="h")
+    ev = c.snapshot_events()
+    assert [e["rule"] for e in ev] == ["loss_spike", "grad_norm_spike"]
+    assert all(e["kind"] == "anomaly" and e["source"] == "health"
+               and e["step"] == 7 and e["artifact"] == "X.json"
+               for e in ev)
+    # protocol invariants ride along: warn-once, counts, forced snapshot
+    assert owner.rule_counts == {"loss_spike": 1, "grad_norm_spike": 1}
+    assert len(owner.logs) == 2 and owner.snapshots == [True]
+    escalation.escalate(owner, _anoms("loss_spike"), tag="health",
+                        counter="health_anomalies_total", counter_help="h")
+    assert len(owner.logs) == 2, "second firing must not re-warn"
+    assert owner.snapshots == [True, False]
+    c.close()
+
+
+def test_escalate_history_cap_preserves_aliasing():
+    owner = _FakeOwner()
+    alias = owner.anomalies
+    for i in range(3):
+        escalation.escalate(owner, _anoms("a", "b", step=i), tag="t",
+                            counter="c", counter_help="h")
+    assert owner.anomalies is alias, "del [:-N] must edit in place"
+    assert len(owner.anomalies) == owner.MAX_ANOMALY_HISTORY
+
+
+def test_escalate_hooks_are_fenced():
+    owner = _FakeOwner()
+    owner.on_escalate = lambda: (_ for _ in ()).throw(RuntimeError("boom"))
+    owner.on_anomaly = lambda a: (_ for _ in ()).throw(RuntimeError("boom"))
+    escalation.escalate(owner, _anoms("a"), tag="t", counter="c",
+                        counter_help="h")   # must not raise
+
+
+# ============================================================== correlator
+def _ev(seq, t_us, kind, **kw):
+    return dict({"seq": seq, "t_us": t_us, "unix_us": t_us,
+                 "kind": kind, "source": kw.pop("source", "test"),
+                 "rank": 0}, **kw)
+
+
+def test_rule_join_chains_anomaly_to_action():
+    ev = [_ev(0, 1000, "anomaly", rule="loss_spike", step=5,
+              severity="warning"),
+          _ev(1, 2000, "action", rule="loss_spike", step=5,
+              action="rollback")]
+    out = incidents.correlate(ev)["incidents"]
+    assert len(out) == 1
+    assert out[0]["actions"] == ["rollback"]
+    assert out[0]["root_cause"]["rule"] == "loss_spike"
+
+
+def test_far_step_never_time_joins():
+    # same µs neighborhood, steps 1000 apart: two incidents
+    ev = [_ev(0, 1000, "anomaly", rule="a", step=5),
+          _ev(1, 2000, "anomaly", rule="b", step=1005)]
+    out = incidents.correlate(ev, step_window=8,
+                              time_window_us=10**9)["incidents"]
+    assert len(out) == 2
+
+
+def test_stepless_events_join_by_time_window():
+    ev = [_ev(0, 1000, "serving", event="admission_pause"),
+          _ev(1, 2000, "serving", event="livelock")]
+    assert len(incidents.correlate(
+        ev, time_window_us=5000)["incidents"]) == 1
+    assert len(incidents.correlate(
+        ev, time_window_us=500)["incidents"]) == 2
+
+
+def test_root_cause_earliest_chaos_wins_over_louder_symptoms():
+    ev = [_ev(0, 1000, "chaos", chaos="divergence", step=8,
+              severity="critical"),
+          _ev(1, 2000, "anomaly", rule="nonfinite_grads", step=9,
+              severity="critical"),
+          _ev(2, 3000, "action", rule="nonfinite_grads", step=10,
+              action="rollback", severity="warning")]
+    out = incidents.correlate(ev)["incidents"]
+    assert len(out) == 1
+    rc = out[0]["root_cause"]
+    assert rc["kind"] == "chaos" and rc["step"] == 8
+    assert "earliest" in rc["why"]
+
+
+def test_root_cause_severity_tie_break_at_same_stamp():
+    ev = [_ev(0, 1000, "anomaly", rule="mild", step=5,
+              severity="warning"),
+          _ev(1, 1000, "anomaly", rule="bad", step=5,
+              severity="critical")]
+    rc = incidents.correlate(ev)["incidents"][0]["root_cause"]
+    assert rc["rule"] == "bad"
+    assert "tie-break" in rc["why"]
+
+
+def test_goodput_cost_sums_overlapping_windows_only():
+    ev = [_ev(0, 10_000, "anomaly", rule="a", step=5),
+          _ev(1, 40_000, "action", rule="a", step=5, action="x"),
+          # window [5_000, 25_000]: overlaps the incident span
+          _ev(2, 25_000, "goodput_window", source="goodput", index=0,
+              dur_us=20_000,
+              categories_us={"device_compute": 10_000, "compile": 6_000,
+                             "input_wait": 4_000}),
+          # window [90_000, 100_000]: outside — must not contribute
+          _ev(3, 100_000, "goodput_window", source="goodput", index=1,
+              dur_us=10_000, categories_us={"input_wait": 10_000})]
+    out = incidents.correlate(ev)["incidents"]
+    assert len(out) == 1
+    cost = out[0]["goodput_cost"]
+    assert cost["window_indices"] == [0]
+    assert cost["badput_us"] == {"compile": 6_000, "input_wait": 4_000}
+    assert cost["badput_total_us"] == 10_000
+
+
+def test_lifecycle_and_goodput_events_are_context_not_members():
+    ev = [_ev(0, 1000, "lifecycle", source="engine", phase="init", step=0),
+          _ev(1, 2000, "goodput_window", source="goodput", index=0,
+              dur_us=1000, categories_us={})]
+    assert incidents.correlate(ev)["incidents"] == []
+
+
+def test_artifact_links_deduplicate_in_order():
+    ev = [_ev(0, 1000, "anomaly", rule="a", step=1,
+              artifact="telemetry/HEALTH.json"),
+          _ev(1, 2000, "anomaly", rule="a", step=1,
+              artifact="telemetry/HEALTH.json"),
+          _ev(2, 3000, "action", rule="a", step=1, action="x",
+              artifact="telemetry/GUARDIAN.json")]
+    inc = incidents.correlate(ev)["incidents"][0]
+    assert inc["artifacts"] == ["telemetry/HEALTH.json",
+                                "telemetry/GUARDIAN.json"]
+
+
+# ============================================================ serving emits
+def test_serving_admission_pause_resume_emit(tmp_path):
+    from deepspeed_tpu.serving.server import ServingEngine
+    from deepspeed_tpu.telemetry.metrics import MetricsRegistry
+
+    class _Stub:
+        registry = MetricsRegistry()
+        _serving_steps = 17
+        _chronicle_serving = ServingEngine._chronicle_serving
+
+    c = RunChronicle()
+    chronicle.set_chronicle(c)
+    stub = _Stub()
+    ServingEngine._pause_admission(stub, "ttft_breach")
+    assert stub._admission_pause_rule == "ttft_breach"
+    ServingEngine._resume_admission(stub)
+    ev = c.snapshot_events()
+    assert [e["event"] for e in ev] == ["admission_pause",
+                                       "admission_resume"]
+    assert ev[0]["rule"] == "ttft_breach" and ev[0]["step"] == 17
+    assert ev[1]["rule"] == "ttft_breach"
+    c.close()
+
+
+# ================================================================ e2e pin
+def _chron_engine(tmp_path):
+    groups.destroy()
+    groups.initialize()
+    run_dir = str(tmp_path / "chron")
+    config = {
+        "train_batch_size": 8,
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 1,
+        "steps_per_print": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "fp16": {"enabled": True, "loss_scale": 0,
+                 "initial_scale_power": 8},
+        "checkpoint": {"async_save": True},
+        "guardian": {"enabled": True, "action_cooldown_steps": 1,
+                     "divergence_streak": 2,
+                     "journal_file": str(tmp_path / "GUARDIAN.json")},
+        "telemetry": {
+            "enabled": True, "trace": False, "jsonl": False,
+            "prometheus": False,
+            "output_path": str(tmp_path / "telemetry"),
+            "health": {"enabled": True, "cadence": 1,
+                       "warmup_samples": 2},
+            "goodput": {"enabled": True, "cadence": 2},
+            "chronicle": {
+                "enabled": True, "run_dir": run_dir,
+                "summary_file": str(tmp_path / "CHRONICLE.json"),
+                "incidents_file": str(tmp_path / "INCIDENTS.json")}},
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=HIDDEN, nlayers=2),
+        config=config, sample_batch=sample_batch(8, HIDDEN))
+    return engine, run_dir
+
+
+def test_e2e_chaos_cascade_is_one_incident_rooted_at_poison(tmp_path):
+    """The acceptance pin: poison -> nonfinite streak -> rollback is ONE
+    incident; root cause = the chaos poison step; strict µs ordering;
+    goodput cost re-adds against the ledger's own window ring."""
+    eng, run_dir = _chron_engine(tmp_path)
+    assert eng._chronicle is not None
+    assert chronicle.get_chronicle() is eng._chronicle
+    data = [(np.random.default_rng(i).standard_normal(
+                 (8, HIDDEN)).astype(np.float32),) * 2 for i in range(16)]
+    it = RepeatingLoader(data)
+    for step in range(1, 6):
+        if step == 3:
+            eng.save_checkpoint(str(tmp_path / "ckpt"), data_iter=it)
+        eng.train_batch(data_iter=it)
+    chaos = DivergenceChaos(eng, at_call=1)
+    with chaos:
+        eng.train_batch(data_iter=it)           # poisoned step
+    for _ in range(3):                          # streak -> rollback -> heal
+        eng.train_batch(data_iter=it)
+    assert eng._guardian.action_counts.get("rollback", 0) == 1
+    eng.close()
+
+    doc = eng.chronicle_report(write=True)      # works on a closed engine
+    events = doc["events"]
+
+    # -- strict (t_us, seq) ordering, integer stamps
+    keys = [(e["t_us"], e["seq"]) for e in events]
+    assert all(isinstance(e["t_us"], int) for e in events)
+    assert all(keys[i] < keys[i + 1] for i in range(len(keys) - 1))
+
+    # -- the full cast emitted: lifecycle, chaos, anomalies, action,
+    #    goodput windows
+    phases = {e.get("phase") for e in events if e["kind"] == "lifecycle"}
+    assert {"init", "first_compile", "checkpoint_save",
+            "checkpoint_load", "close"} <= phases
+    kinds = {e["kind"] for e in events}
+    assert {"chaos", "anomaly", "action", "goodput_window"} <= kinds
+    rollbacks = [e for e in events if e.get("action") == "rollback"]
+    assert len(rollbacks) == 1 and "rule" in rollbacks[0]
+
+    # -- exactly ONE incident, rooted at the poison step
+    incs = doc["incidents"]["incidents"]
+    assert len(incs) == 1, \
+        f"cascade fragmented into {len(incs)} incidents"
+    rc = incs[0]["root_cause"]
+    assert rc["kind"] == "chaos"
+    assert rc["step"] == chaos.poisoned_steps[0]
+    assert "rollback" in incs[0]["actions"]
+    assert incs[0]["severity"] == "critical"
+
+    # -- goodput cost re-adds against the ledger's own window ring
+    cost = incs[0]["goodput_cost"]
+    assert cost is not None and cost["badput_total_us"] > 0
+    ring = {w["index"]: w for w in eng._goodput.ring}
+    expect = {}
+    for idx in cost["window_indices"]:
+        for cat, s in ring[idx]["categories_s"].items():
+            if cat not in incidents.GOOD_CATEGORIES:
+                us = int(round(s * 1e6))
+                if us or cat in cost["badput_us"]:
+                    expect[cat] = expect.get(cat, 0) + us
+    assert cost["badput_us"] == expect
+    assert cost["badput_total_us"] == sum(expect.values())
+
+    # -- committed artifact shapes: strict parse, schema, stream on disk
+    bail = lambda s: pytest.fail(f"bare {s} in artifact")   # noqa: E731
+    cdoc = json.load(open(tmp_path / "CHRONICLE.json"), parse_constant=bail)
+    idoc = json.load(open(tmp_path / "INCIDENTS.json"), parse_constant=bail)
+    assert cdoc["schema"] == chronicle.CHRONICLE_SCHEMA
+    assert idoc["schema"] == incidents.INCIDENTS_SCHEMA
+    assert cdoc["n_events"] == len(events)
+    streamed = chronicle.load_events(run_dir)
+    assert len(streamed) == len(events)
+
+    # -- close was final: the global detached, writer joined, idempotent
+    assert chronicle.get_chronicle().enabled is False
+    assert events[-1].get("phase") == "close"
+    eng.close()                                  # second close never raises

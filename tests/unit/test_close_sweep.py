@@ -1,0 +1,153 @@
+"""Idempotent-close sweep: every closeable telemetry/runtime object.
+
+One parametrized registry instead of one ad-hoc test per subsystem: for
+every object that owns a ``close()`` — monitors, shippers, the guardian,
+the chronicle, the manager — pin the teardown contract once:
+
+* ``close()`` twice never raises (engine teardown, atexit backstops and
+  weakref finalizers can all race to it);
+* ``close()`` after ``report()`` never raises (the report path must not
+  poison teardown state, and vice versa);
+* a ``report()``/snapshot AFTER close never raises either (forensics
+  outlive the object — the livelock guard and ``chronicle_report`` both
+  read closed instances);
+* background writer threads are actually joined by close (no leaked
+  non-daemon work, no writes after join).
+
+New closeables must register here — the sweep is the repo's single
+answer to "is teardown safe in any order".
+"""
+
+import threading
+
+import pytest
+
+from deepspeed_tpu.runtime.guardian import Guardian
+from deepspeed_tpu.telemetry.chronicle import RunChronicle
+from deepspeed_tpu.telemetry.fleet import FleetMonitor, FleetShipper
+from deepspeed_tpu.telemetry.health import HealthMonitor
+from deepspeed_tpu.telemetry.ledger import GoodputLedger
+from deepspeed_tpu.telemetry.manager import TelemetryManager
+from deepspeed_tpu.telemetry.memory_observatory import MemoryMonitor
+from deepspeed_tpu.telemetry.serving_observatory import ServingObservatory
+
+
+def _health(tmp):
+    m = HealthMonitor(snapshot_path=str(tmp / "HEALTH.json"),
+                      warmup_samples=1)
+    return m, m.report
+
+
+def _ledger(tmp):
+    led = GoodputLedger(snapshot_path=str(tmp / "GOODPUT.json"),
+                        profiler_capture=False)
+    with led.attribute("host_dispatch"):
+        pass
+    led.tick(step=1, force=True)
+    return led, led.report
+
+
+def _serving_obs(tmp):
+    obs = ServingObservatory(max_batch=2, decode_steps=1,
+                             snapshot_path=str(tmp / "SERVING.json"),
+                             trace_lanes=False)
+    return obs, obs.report
+
+
+def _fleet_shipper(tmp):
+    sh = FleetShipper(str(tmp / "fleet"), rank=0)
+    sh.note_step_time(0.01)
+    sh.tick(step=1, force=True)
+    return sh, None
+
+
+def _fleet_monitor(tmp):
+    run_dir = str(tmp / "fleet")
+    sh = FleetShipper(run_dir, rank=0, background=False)
+    sh.note_step_time(0.01)
+    sh.tick(step=1, force=True)
+    sh.close()
+    mon = FleetMonitor(run_dir,
+                       snapshot_path=str(tmp / "FLEET_HEALTH.json"))
+    mon.poll(force=True)
+    return mon, mon.report
+
+
+def _memory(tmp):
+    m = MemoryMonitor(snapshot_path=str(tmp / "MEMORY_HEALTH.json"),
+                      report_path=str(tmp / "MEMORY_ANATOMY.json"))
+    return m, m.report
+
+
+def _guardian(tmp):
+    g = Guardian(journal_path=str(tmp / "GUARDIAN.json"),
+                 action_cooldown_steps=0)
+    g.notify("health", [{"rule": "loss_spike", "step": 1,
+                         "severity": "warning"}])
+    g.tick(1)
+    return g, g.report
+
+
+def _chronicle(tmp):
+    c = RunChronicle(run_dir=str(tmp / "chron"), rank=0)
+    c.emit("anomaly", source="health", step=1, rule="loss_spike")
+    return c, c.report
+
+
+def _manager_disabled(tmp):
+    m = TelemetryManager(config=None)
+    return m, None
+
+
+CLOSEABLES = {
+    "health": _health,
+    "goodput_ledger": _ledger,
+    "serving_observatory": _serving_obs,
+    "fleet_shipper": _fleet_shipper,
+    "fleet_monitor": _fleet_monitor,
+    "memory_monitor": _memory,
+    "guardian": _guardian,
+    "chronicle": _chronicle,
+    "telemetry_manager_disabled": _manager_disabled,
+}
+
+
+@pytest.fixture(params=sorted(CLOSEABLES), ids=sorted(CLOSEABLES))
+def closeable(request, tmp_path):
+    return CLOSEABLES[request.param](tmp_path)
+
+
+def test_double_close_never_raises(closeable):
+    obj, _ = closeable
+    obj.close()
+    obj.close()
+
+
+def test_close_after_report_never_raises(closeable):
+    obj, report = closeable
+    if report is not None:
+        report()
+    obj.close()
+    obj.close()
+
+
+def test_report_after_close_never_raises(closeable):
+    obj, report = closeable
+    obj.close()
+    if report is not None:
+        report()
+    obj.close()
+
+
+def test_close_joins_writer_threads(closeable):
+    """Closeables owning a background writer must leave no live thread
+    behind; the rest of the registry just asserts no thread leak."""
+    before = set(threading.enumerate())
+    obj, _ = closeable
+    obj.close()
+    leaked = [t for t in set(threading.enumerate()) - before
+              if t.is_alive()]
+    assert not leaked, f"close() leaked threads: {leaked}"
+    wthread = getattr(obj, "_wthread", None)
+    if wthread is not None:
+        assert not wthread.is_alive()
