@@ -125,6 +125,20 @@ def telemetry_report():
         "causal event timeline -> CHRONICLE.json, correlated "
         "root-caused incident chains -> INCIDENTS.json)")
     try:
+        from deepspeed_tpu.telemetry.obs_server import get_obs_server
+        srv = get_obs_server()
+        live = srv is not None and not srv.report().get("closed", True)
+        row("mission control (obs server + SLO)", True,
+            (f"(telemetry.server block; DS_TELEMETRY_SERVER=1; live at "
+             f"{srv.url} with {len(srv.providers())} provider(s))"
+             if live else
+             "(telemetry.server + telemetry.slo blocks; "
+             "DS_TELEMETRY_SERVER=1 / DS_TELEMETRY_SLO=1; /metrics "
+             "scrape + /api/report/* + burn-rate paging -> "
+             "SLO_REPORT.json; not armed in this process)"))
+    except Exception:
+        row("mission control (obs server + SLO)", False)
+    try:
         from deepspeed_tpu.telemetry.ledger import profiler_available
         row("jax.profiler programmatic capture", profiler_available(),
             "(goodput on-anomaly start_trace/stop_trace)")

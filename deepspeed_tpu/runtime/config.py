@@ -95,8 +95,9 @@ class DeepSpeedTelemetryConfig(DeepSpeedConfigObject):
     = 1/0 force-toggles ``enabled``; ``DS_TELEMETRY_DIR`` overrides
     ``output_path``; ``DS_COST_EXPLORER`` / ``DS_TELEMETRY_HEALTH`` /
     ``DS_TELEMETRY_GOODPUT`` / ``DS_TELEMETRY_MEMORY`` /
-    ``DS_TELEMETRY_CHRONICLE`` = 1/0 force-toggle the cost-explorer /
-    health / goodput / memory / chronicle sub-blocks."""
+    ``DS_TELEMETRY_CHRONICLE`` / ``DS_TELEMETRY_SERVER`` /
+    ``DS_TELEMETRY_SLO`` = 1/0 force-toggle the cost-explorer / health /
+    goodput / memory / chronicle / obs-server / slo sub-blocks."""
 
     def __init__(self, param_dict):
         t = param_dict.get(C.TELEMETRY, {}) or {}
@@ -287,6 +288,44 @@ class DeepSpeedTelemetryConfig(DeepSpeedConfigObject):
             C.CHRONICLE_TIME_WINDOW_S, C.CHRONICLE_TIME_WINDOW_S_DEFAULT))
         self.chronicle_background = ch.get(C.CHRONICLE_BACKGROUND,
                                            C.CHRONICLE_BACKGROUND_DEFAULT)
+        # server sub-block (telemetry/obs_server.py): the live HTTP
+        # scrape/status endpoint. Flattened onto server_*.
+        sv = t.get(C.TELEMETRY_SERVER, {}) or {}
+        self.server_enabled = sv.get(C.SERVER_ENABLED,
+                                     C.SERVER_ENABLED_DEFAULT)
+        self.server_host = sv.get(C.SERVER_HOST, C.SERVER_HOST_DEFAULT)
+        self.server_port = int(sv.get(C.SERVER_PORT,
+                                      C.SERVER_PORT_DEFAULT))
+        self.server_token = sv.get(C.SERVER_TOKEN,
+                                   C.SERVER_TOKEN_DEFAULT)
+        self.server_events_tail = int(sv.get(
+            C.SERVER_EVENTS_TAIL, C.SERVER_EVENTS_TAIL_DEFAULT))
+        # slo sub-block (telemetry/slo.py): multi-window burn-rate
+        # alerting over declarative objectives. Flattened onto slo_*.
+        sl = t.get(C.TELEMETRY_SLO, {}) or {}
+        self.slo_enabled = sl.get(C.SLO_ENABLED, C.SLO_ENABLED_DEFAULT)
+        self.slo_fast_window_s = float(sl.get(
+            C.SLO_FAST_WINDOW_S, C.SLO_FAST_WINDOW_S_DEFAULT))
+        self.slo_slow_window_s = float(sl.get(
+            C.SLO_SLOW_WINDOW_S, C.SLO_SLOW_WINDOW_S_DEFAULT))
+        self.slo_burn_threshold = float(sl.get(
+            C.SLO_BURN_THRESHOLD, C.SLO_BURN_THRESHOLD_DEFAULT))
+        self.slo_eval_interval_s = float(sl.get(
+            C.SLO_EVAL_INTERVAL_S, C.SLO_EVAL_INTERVAL_S_DEFAULT))
+        self.slo_objectives = tuple(sl.get(C.SLO_OBJECTIVES)
+                                    or C.SLO_OBJECTIVES_DEFAULT)
+        self.slo_goodput_target = float(sl.get(
+            C.SLO_GOODPUT_TARGET, C.SLO_GOODPUT_TARGET_DEFAULT))
+        self.slo_ttft_target = float(sl.get(
+            C.SLO_TTFT_TARGET, C.SLO_TTFT_TARGET_DEFAULT))
+        self.slo_ttft_threshold_ms = float(sl.get(
+            C.SLO_TTFT_THRESHOLD_MS, C.SLO_TTFT_THRESHOLD_MS_DEFAULT))
+        self.slo_e2e_target = float(sl.get(
+            C.SLO_E2E_TARGET, C.SLO_E2E_TARGET_DEFAULT))
+        self.slo_e2e_threshold_ms = float(sl.get(
+            C.SLO_E2E_THRESHOLD_MS, C.SLO_E2E_THRESHOLD_MS_DEFAULT))
+        self.slo_snapshot_file = sl.get(C.SLO_SNAPSHOT_FILE,
+                                        C.SLO_SNAPSHOT_FILE_DEFAULT)
         env = os.environ.get("DS_TELEMETRY")
         if env is not None:
             self.enabled = env.lower() in ("1", "true", "yes", "on")
@@ -326,6 +365,14 @@ class DeepSpeedTelemetryConfig(DeepSpeedConfigObject):
         if env_ch is not None:
             self.chronicle_enabled = env_ch.lower() in ("1", "true",
                                                         "yes", "on")
+        env_sv = os.environ.get("DS_TELEMETRY_SERVER")
+        if env_sv is not None:
+            self.server_enabled = env_sv.lower() in ("1", "true", "yes",
+                                                     "on")
+        env_sl = os.environ.get("DS_TELEMETRY_SLO")
+        if env_sl is not None:
+            self.slo_enabled = env_sl.lower() in ("1", "true", "yes",
+                                                  "on")
         if self.anatomy_capture_steps < 1:
             raise DeepSpeedConfigError(
                 f"telemetry.anatomy.capture_steps must be >= 1, got "
@@ -400,6 +447,49 @@ class DeepSpeedTelemetryConfig(DeepSpeedConfigObject):
             raise DeepSpeedConfigError(
                 f"telemetry.chronicle.time_window_s must be > 0, got "
                 f"{self.chronicle_time_window_s}")
+        if not 0 <= self.server_port <= 65535:
+            raise DeepSpeedConfigError(
+                f"telemetry.server.port must be in [0, 65535], got "
+                f"{self.server_port}")
+        if self.server_events_tail < 1:
+            raise DeepSpeedConfigError(
+                f"telemetry.server.events_tail must be >= 1, got "
+                f"{self.server_events_tail}")
+        if not 0.0 < self.slo_fast_window_s < self.slo_slow_window_s:
+            raise DeepSpeedConfigError(
+                f"telemetry.slo windows must satisfy 0 < fast_window_s "
+                f"< slow_window_s, got {self.slo_fast_window_s} / "
+                f"{self.slo_slow_window_s}")
+        if self.slo_burn_threshold <= 0:
+            raise DeepSpeedConfigError(
+                f"telemetry.slo.burn_threshold must be > 0, got "
+                f"{self.slo_burn_threshold}")
+        if self.slo_eval_interval_s <= 0:
+            raise DeepSpeedConfigError(
+                f"telemetry.slo.eval_interval_s must be > 0, got "
+                f"{self.slo_eval_interval_s}")
+        for tname, target in (("goodput_target", self.slo_goodput_target),
+                              ("ttft_target", self.slo_ttft_target),
+                              ("e2e_target", self.slo_e2e_target)):
+            if not 0.0 < target < 1.0:
+                raise DeepSpeedConfigError(
+                    f"telemetry.slo.{tname} must be in (0, 1), got "
+                    f"{target}")
+        for mname, ms in (("ttft_threshold_ms",
+                           self.slo_ttft_threshold_ms),
+                          ("e2e_threshold_ms",
+                           self.slo_e2e_threshold_ms)):
+            if ms <= 0:
+                raise DeepSpeedConfigError(
+                    f"telemetry.slo.{mname} must be > 0, got {ms}")
+        for o in self.slo_objectives:
+            # declarative objectives fail at config time, not first tick
+            from deepspeed_tpu.telemetry.slo import normalize_objective
+            try:
+                normalize_objective(o)
+            except ValueError as e:
+                raise DeepSpeedConfigError(
+                    f"telemetry.slo.objectives: {e}")
 
 
 class DeepSpeedDataPrefetchConfig(DeepSpeedConfigObject):

@@ -322,6 +322,62 @@ CHRONICLE_TIME_WINDOW_S_DEFAULT = 30.0
 CHRONICLE_BACKGROUND = "background"         # stream writes off-thread
 CHRONICLE_BACKGROUND_DEFAULT = True
 
+# telemetry.server: the live observability plane (telemetry/
+# obs_server.py) — a zero-dependency stdlib HTTP endpoint on rank 0
+# serving GET /metrics (render_prometheus over the live registry — a
+# real scrape target; the .prom file sink stays the node_exporter
+# textfile-collector path), /healthz + /readyz (armed-monitor
+# inventory), /api/report/{goodput,health,serving,memory,fleet,
+# guardian,chronicle,incidents,slo} (each monitor's HOST-SIDE report —
+# a scrape never forces a device fetch, sync, or compile) and
+# /api/events (bounded chronicle tail, ?since_seq= resumable).
+# DS_TELEMETRY_SERVER=1/0 force-toggles `enabled`.
+TELEMETRY_SERVER = "server"
+SERVER_ENABLED = "enabled"
+SERVER_ENABLED_DEFAULT = False
+SERVER_HOST = "host"                        # bind address (loopback default)
+SERVER_HOST_DEFAULT = "127.0.0.1"
+SERVER_PORT = "port"                        # 0 -> auto-pick a free port
+SERVER_PORT_DEFAULT = 0
+SERVER_TOKEN = "token"                      # "" -> no auth; else Bearer <token>
+SERVER_TOKEN_DEFAULT = ""
+SERVER_EVENTS_TAIL = "events_tail"          # /api/events max tail length
+SERVER_EVENTS_TAIL_DEFAULT = 256
+
+# telemetry.slo: the SLO burn-rate monitor (telemetry/slo.py) — SRE
+# multi-window error-budget alerting over declarative objectives
+# (latency objectives from registry histograms, training goodput from
+# the ledger). Fast+slow windows both burning -> page-tier
+# `slo_burn_page` anomaly (critical; a guardian admission-pause rule),
+# fast-only -> `slo_burn_fast` (warning); escalation rides the shared
+# protocol into SLO_REPORT.json, the chronicle and the guardian.
+# DS_TELEMETRY_SLO=1/0 force-toggles `enabled`.
+TELEMETRY_SLO = "slo"
+SLO_ENABLED = "enabled"
+SLO_ENABLED_DEFAULT = False
+SLO_FAST_WINDOW_S = "fast_window_s"         # onset window (~5 min)
+SLO_FAST_WINDOW_S_DEFAULT = 300.0
+SLO_SLOW_WINDOW_S = "slow_window_s"         # sustain window (~1 h)
+SLO_SLOW_WINDOW_S_DEFAULT = 3600.0
+SLO_BURN_THRESHOLD = "burn_threshold"       # burn (x budget) that counts as burning
+SLO_BURN_THRESHOLD_DEFAULT = 1.0
+SLO_EVAL_INTERVAL_S = "eval_interval_s"     # tick self-throttle
+SLO_EVAL_INTERVAL_S_DEFAULT = 10.0
+SLO_OBJECTIVES = "objectives"               # [] -> goodput default (+ serving adds ttft/e2e)
+SLO_OBJECTIVES_DEFAULT = ()
+SLO_GOODPUT_TARGET = "goodput_target"       # default training_goodput objective target
+SLO_GOODPUT_TARGET_DEFAULT = 0.90
+SLO_TTFT_TARGET = "ttft_target"             # serving_ttft objective target
+SLO_TTFT_TARGET_DEFAULT = 0.99
+SLO_TTFT_THRESHOLD_MS = "ttft_threshold_ms"
+SLO_TTFT_THRESHOLD_MS_DEFAULT = 500.0
+SLO_E2E_TARGET = "e2e_target"               # serving_e2e objective target
+SLO_E2E_TARGET_DEFAULT = 0.99
+SLO_E2E_THRESHOLD_MS = "e2e_threshold_ms"
+SLO_E2E_THRESHOLD_MS_DEFAULT = 5000.0
+SLO_SNAPSHOT_FILE = "snapshot_file"         # "" -> <output_path>/SLO_REPORT.json
+SLO_SNAPSHOT_FILE_DEFAULT = ""
+
 # Checkpoint
 CHECKPOINT = "checkpoint"
 CHECKPOINT_TAG_VALIDATION = "tag_validation"

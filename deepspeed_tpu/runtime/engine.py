@@ -627,6 +627,78 @@ class DeepSpeedEngine:
                 if self._memory is not None:
                     self._memory.on_anomaly = self._guardian.hook("memory")
 
+        # ---- SLO burn-rate monitor (telemetry/slo.py) ---------------------
+        # multi-window error-budget alerting over the ledger and the
+        # registry histograms — pure host bookkeeping, gated on the
+        # rank-0 telemetry manager like the monitors it reads. The
+        # page-tier rule (slo_burn_page) is a guardian admission-pause
+        # rule, so a sustained burn sheds serving load by itself.
+        self._slo = None
+        if (self.telemetry.enabled
+                and bool(getattr(tcfg, "slo_enabled", False))
+                and not self._abstract_init):
+            from deepspeed_tpu.telemetry.slo import SloMonitor
+            self._slo = SloMonitor.from_config(
+                tcfg, output_path=tcfg.output_path or "telemetry/",
+                job_name=tcfg.job_name or "",
+                registry=self.telemetry.registry, ledger=self._goodput,
+                on_escalate=(self.telemetry._force_trace_export
+                             if tcfg.trace else None))
+            if self._guardian is not None:
+                self._slo.on_anomaly = self._guardian.hook("slo")
+
+        # ---- live observability plane (telemetry/obs_server.py) -----------
+        # The HTTP scrape/status endpoint, rank-0 with the manager.
+        # Providers are MONITOR-LEVEL report() bound methods — each
+        # serves its latest HOST-SIDE snapshot; never the engine's
+        # *_report wrappers, which force a device tick first. A scrape
+        # must never force a device fetch, sync, or compile.
+        self._obs_server = None
+        if (self.telemetry.enabled
+                and bool(getattr(tcfg, "server_enabled", False))
+                and not self._abstract_init):
+            from deepspeed_tpu.telemetry import incidents as _inc_mod
+            from deepspeed_tpu.telemetry import obs_server as _obs_mod
+            srv = _obs_mod.ObsServer.from_config(
+                tcfg, registry=self.telemetry.registry)
+            if self.telemetry.health is not None:
+                srv.register("health", self.telemetry.health.report)
+            if self._goodput is not None:
+                led = self._goodput
+                srv.register(
+                    "goodput", led.report,
+                    age_s_fn=lambda: (
+                        round(led.elapsed()
+                              - (led.last_window["start_s"]
+                                 + led.last_window["dur_s"]), 3)
+                        if led.last_window else None))
+            if self._memory is not None:
+                srv.register("memory", self._memory.report)
+            if self._fleet_monitor is not None:
+                srv.register("fleet", self._fleet_monitor.report)
+            if self._guardian is not None:
+                srv.register("guardian", self._guardian.report)
+            if self._chronicle is not None:
+                chron = self._chronicle
+                srv.register("chronicle", chron.report)
+                srv.register(
+                    "incidents",
+                    lambda: _inc_mod.correlate(
+                        chron.snapshot_events(),
+                        step_window=getattr(tcfg, "chronicle_step_window",
+                                            8),
+                        time_window_us=int(
+                            getattr(tcfg, "chronicle_time_window_s", 30.0)
+                            * 1e6),
+                        job_name=tcfg.job_name or ""))
+            if self._slo is not None:
+                srv.register("slo", self._slo.report,
+                             age_s_fn=self._slo.last_eval_age_s)
+            self._obs_server = srv
+            _obs_mod.set_obs_server(srv)
+            log_dist(f"telemetry: obs server live at {srv.url} "
+                     f"({len(srv.providers())} provider(s))", ranks=[0])
+
         # ---- parameters / state init --------------------------------------
         with self.telemetry.span("engine/init_state"):
             self._init_state(model_parameters, sample_batch)
@@ -3045,6 +3117,11 @@ class DeepSpeedEngine:
             self._last_grad_norm = (
                 sample["grad_norm"] if sample is not None
                 else float(jax.device_get(self._pending_grad_norm)))
+        if self._slo is not None:
+            # burn-rate evaluation (host arithmetic, self-throttled to
+            # eval_interval_s) BEFORE the guardian tick so a page-tier
+            # burn fired this step is actionable this step
+            self._slo.tick(step=self.global_steps)
         if self._guardian is not None:
             # anomaly->action policies run HERE, on the main thread at
             # the step boundary — the only place swapping the live train
@@ -3422,6 +3499,15 @@ class DeepSpeedEngine:
                 with self._led_attr("checkpoint_save"):
                     self._ckpt_writer.close()
         finally:
+            if self._obs_server is not None:
+                from deepspeed_tpu.telemetry import obs_server as _obs_mod
+                try:
+                    # FIRST: stop serving scrapes before the monitors the
+                    # providers point at are torn down underneath them
+                    self._obs_server.close()
+                except Exception as e:
+                    logger.warning("[obs] server close failed: %s", e)
+                _obs_mod.reset_obs_server(if_current=self._obs_server)
             for pl in self._prefetchers:
                 pl.close()
             for _src, wrapped in list(self._prefetch_wrap_cache.values()):
@@ -3459,6 +3545,13 @@ class DeepSpeedEngine:
                     self._guardian.close()
                 except Exception as e:
                     logger.warning("[guardian] final journal failed: %s", e)
+            if self._slo is not None:
+                try:
+                    # final burn snapshot while the registry histograms
+                    # and the ledger are still live
+                    self._slo.close()
+                except Exception as e:
+                    logger.warning("[slo] close failed: %s", e)
             self.telemetry.close()
             if self._chronicle is not None:
                 from deepspeed_tpu.telemetry import chronicle as _chron_mod

@@ -22,9 +22,10 @@ actions:
   step still overflowing) resets the dynamic-scaler state to an escape
   scale with fresh hysteresis; bounded by ``max_fp16_rescues``.
 * ``serving_pause`` / ``serving_resume`` — overload rules
-  (queue_growth, ttft_slo_breach) shed load by pausing admission (new
-  submits fail fast with a structured reason instead of joining a queue
-  that can't drain); admission resumes after the rules stay quiet for
+  (queue_growth, ttft_slo_breach, and the SLO monitor's page-tier
+  slo_burn_page) shed load by pausing admission (new submits fail fast
+  with a structured reason instead of joining a queue that can't
+  drain); admission resumes after the rules stay quiet for
   ``resume_clear_steps`` serving steps.
 
 The guardian itself is pure host-side bookkeeping: it never touches the
@@ -64,7 +65,8 @@ DEFAULT_EMERGENCY_RULES = (
     "input_bound", "goodput_regression", "checkpoint_stall",
     "step_time_skew", "input_wait_skew", "checkpoint_skew", "param_desync",
 )
-DEFAULT_PAUSE_RULES = ("queue_growth", "ttft_slo_breach")
+DEFAULT_PAUSE_RULES = ("queue_growth", "ttft_slo_breach",
+                       "slo_burn_page")
 
 
 def _atomic_json(path, doc):

@@ -85,14 +85,19 @@ class RequestOutput:
 
 class ServingEngine:
     def __init__(self, engine, config=None, registry=None, use_flash=None,
-                 guardian=None):
+                 guardian=None, obs_server=None, slo=None):
         """``engine``: an ``InferenceEngine`` wrapping a GPT-2-family
         model; ``config``: ``DeepSpeedServingConfig``, a ds-config dict
         (with or without the outer ``{"serving": ...}``), or ``None`` for
         defaults; ``guardian``: a :class:`runtime.guardian.Guardian` to
         wire the overload-degradation policy into (falls back to the
         wrapped engine's own, when it has one — training and serving
-        actions then share one journal)."""
+        actions then share one journal). ``obs_server``/``slo``: the
+        mission-control surfaces (telemetry/obs_server.py, telemetry/
+        slo.py) — like the guardian they fall back to the wrapped
+        engine's own, so an engine armed with ``telemetry.server`` /
+        ``telemetry.slo`` config exposes the serving report as a scrape
+        route and burns the serving latency objectives automatically."""
         from deepspeed_tpu.runtime.config import DeepSpeedServingConfig
         if config is None:
             config = DeepSpeedServingConfig({})
@@ -155,6 +160,23 @@ class ServingEngine:
             self.guardian.resume_fn = self._resume_admission
             if self.observatory is not None:
                 self.observatory.on_anomaly = self.guardian.hook("serving")
+        # mission-control plane (telemetry/obs_server.py + slo.py),
+        # shared with the wrapped engine: the serving report becomes one
+        # more scrape route, and the serving latency objectives (ttft /
+        # e2e percentile targets from the registry histograms) join the
+        # burn monitor the training-goodput objective already rides. A
+        # page-tier burn (slo_burn_page) lands on the guardian's
+        # admission-pause rule list — the SLO monitor closes the loop
+        # back to the pause/resume callbacks wired above.
+        self._slo = slo if slo is not None else getattr(
+            engine, "_slo", None)
+        if self._slo is not None and getattr(self._slo, "enabled", False):
+            for obj in getattr(self._slo, "serving_defaults", ()):
+                self._slo.add_objective(obj)
+        self._obs_server = obs_server if obs_server is not None \
+            else getattr(engine, "_obs_server", None)
+        if self._obs_server is not None:
+            self._obs_server.register("serving", self.serving_report)
         # shared-prefix KV reuse (serving.prefix_cache block): the
         # scheduler reads cache.prefix_cache at admission; the server
         # executes the planned COW forks and registers full blocks as
@@ -282,12 +304,17 @@ class ServingEngine:
                     kv_occupancy=self.cache.allocator.occupancy(),
                     kv_fragmentation=self._kv_fragmentation(),
                     progress=progress)
-            if self.guardian is not None:
+            if self.guardian is not None or self._slo is not None:
                 # serving's own step clock (NOT training steps): the
                 # pause policy fires here, and recovery is measured in
                 # quiet serving steps
                 self._serving_steps += 1
-                self.guardian.serving_tick(self._serving_steps)
+                if self._slo is not None:
+                    # burn-rate eval BEFORE the guardian tick so a page
+                    # fired this step pauses admission this step
+                    self._slo.tick(step=self._serving_steps)
+                if self.guardian is not None:
+                    self.guardian.serving_tick(self._serving_steps)
             self._memory_tick()
         return progress
 
@@ -912,7 +939,12 @@ class ServingEngine:
         Anomalies whose only firings landed inside the 5 s snapshot
         throttle window would otherwise exit the process unexplained —
         ``close()`` is what guarantees the last incident reaches
-        ``SERVING_HEALTH.json``."""
+        ``SERVING_HEALTH.json``. The obs-server scrape route is
+        unregistered first — its report provider points at this
+        object."""
+        if self._obs_server is not None:
+            self._obs_server.unregister("serving")
+            self._obs_server = None
         if self.observatory is not None:
             self.observatory.close()
 

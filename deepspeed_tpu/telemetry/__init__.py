@@ -67,7 +67,21 @@ Four pieces (see the per-module docstrings):
   serving and chaos; per-rank atomic JSONL streams) and the incident
   correlator joining it into INCIDENTS.json chains with ranked root
   cause and per-incident goodput cost
-  (``python -m deepspeed_tpu.telemetry.chronicle`` is the CLI).
+  (``python -m deepspeed_tpu.telemetry.chronicle`` is the CLI);
+* ``obs_server`` — the live observability plane: a zero-dependency
+  HTTP endpoint (``telemetry.server`` config block) serving /metrics
+  (a real Prometheus scrape target), /healthz + /readyz probes, every
+  armed monitor's host-side report under /api/report/<name>, and the
+  resumable chronicle tail under /api/events — a scrape never forces a
+  device fetch, sync, or compile. Lazy like xplane (below);
+* ``slo`` — the SLO burn-rate monitor (``telemetry.slo`` block):
+  multi-window error-budget burn over declarative latency/goodput
+  objectives; fast+slow both burning pages ``slo_burn_page`` (a
+  guardian admission-pause rule) -> SLO_REPORT.json
+  (``python -m deepspeed_tpu.telemetry.slo --demo`` is the CLI). Lazy;
+* ``dashboard`` — the mission-control terminal dashboard over either a
+  live ``obs_server`` URL or an artifact dir
+  (``python -m deepspeed_tpu.telemetry.dashboard --url/--dir``). Lazy.
 
 ``TelemetryManager`` (manager.py) wires them per engine run, behind the
 ``telemetry`` config block (see CONFIG.md). Everything is importable and
@@ -131,14 +145,17 @@ __all__ = [
     "RunChronicle", "get_chronicle", "set_chronicle", "reset_chronicle",
     "IncidentCorrelator", "correlate", "write_incidents",
     "xplane", "step_anatomy", "pprof", "memory_observatory",
+    "obs_server", "slo", "dashboard",
 ]
 
 
 def __getattr__(name):
     # lazy submodule access (PEP 562): telemetry.xplane / .step_anatomy /
     # .pprof / .memory_observatory stay un-imported until a capture or a
-    # residency window is actually post-processed
-    if name in ("xplane", "step_anatomy", "pprof", "memory_observatory"):
+    # residency window is actually post-processed; obs_server / slo /
+    # dashboard until the mission-control plane is armed
+    if name in ("xplane", "step_anatomy", "pprof", "memory_observatory",
+                "obs_server", "slo", "dashboard"):
         import importlib
         return importlib.import_module(f"deepspeed_tpu.telemetry.{name}")
     raise AttributeError(

@@ -1,0 +1,238 @@
+"""Mission-control terminal dashboard — one screen over the live plane.
+
+``python -m deepspeed_tpu.telemetry.dashboard --url http://host:port``
+polls an :mod:`obs_server` endpoint (``/api/report/*``); ``--dir
+telemetry/`` reads the same reports off the artifact dir instead
+(GOODPUT.json, SLO_REPORT.json, SERVING_HEALTH.json, INCIDENTS.json) —
+the offline post-mortem view of the exact same screen. Stdlib-only
+ANSI rendering (no curses dependency — works over any dumb ssh tty):
+
+* header — job, source, uptime, scrape age;
+* throughput sparkline — steps/s (training) or tok/s (serving),
+  accumulated across polls;
+* goodput category bars — where the wall-clock went;
+* SLO burn gauges — per objective, fast/slow windows, tier;
+* last incidents — id, severity, root cause, rules.
+
+Rendering is pure (``render_frame(reports, ...) -> str``) so the unit
+tests drive it with canned reports; the loop just polls, clears, and
+prints. ``--once`` renders a single frame and exits (scriptable)."""
+
+import argparse
+import json
+import os
+import time
+from collections import deque
+
+BLOCKS = " ▁▂▃▄▅▆▇█"
+BOLD, DIM, RESET = "\033[1m", "\033[2m", "\033[0m"
+RED, YELLOW, GREEN = "\033[91m", "\033[93m", "\033[92m"
+CLEAR = "\033[2J\033[H"
+
+# goodput categories worth a bar, in ledger order
+_GOODPUT_GOOD = ("device_compute", "host_dispatch")
+
+
+def _color(s, c, plain=False):
+    return s if plain else f"{c}{s}{RESET}"
+
+
+def sparkline(values, width=48):
+    """Unicode sparkline of the last *width* values (empty-safe)."""
+    vals = list(values)[-width:]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    span = (hi - lo) or 1.0
+    return "".join(
+        BLOCKS[1 + int((v - lo) / span * (len(BLOCKS) - 2))]
+        for v in vals)
+
+
+def bar(frac, width=30):
+    frac = min(1.0, max(0.0, frac))
+    n = int(round(frac * width))
+    return "█" * n + "·" * (width - n)
+
+
+def fetch_url(base, name, token="", timeout=3.0):
+    """One ``/api/report/<name>`` poll; None on any failure (a dashboard
+    must survive its server restarting)."""
+    import urllib.request
+    req = urllib.request.Request(
+        f"{base.rstrip('/')}/api/report/{name}")
+    if token:
+        req.add_header("Authorization", f"Bearer {token}")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return json.loads(r.read().decode())
+    except Exception:
+        return None
+
+
+def fetch_dir(dirpath, name):
+    """The artifact-dir counterpart: the committed snapshot files."""
+    files = {"goodput": "GOODPUT.json", "slo": "SLO_REPORT.json",
+             "serving": "SERVING_HEALTH.json",
+             "incidents": "INCIDENTS.json", "health": "HEALTH.json"}
+    path = os.path.join(dirpath, files.get(name, f"{name}.json"))
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def gather(source, is_url, token=""):
+    names = ("goodput", "slo", "serving", "incidents", "health")
+    if is_url:
+        return {n: fetch_url(source, n, token=token) for n in names}
+    reports = {n: fetch_dir(source, n) for n in names}
+    # SLO_REPORT.json embeds its demo incident chain; surface it when
+    # the dir has no standalone INCIDENTS.json
+    slo = reports.get("slo")
+    if reports.get("incidents") is None and isinstance(slo, dict):
+        reports["incidents"] = slo.get("incidents")
+    return reports
+
+
+# ------------------------------------------------------------- rendering
+
+def _throughput_line(reports, history, width, plain):
+    """Update *history* from this poll's reports; render the sparkline.
+    Serving tok/s when a serving report is live, else training steps."""
+    serving = reports.get("serving") or {}
+    goodput = reports.get("goodput") or {}
+    label, value = None, None
+    totals = serving.get("totals") or {}
+    if totals.get("tokens"):
+        label, value = "tok", totals.get("tokens")
+    elif goodput.get("steps_seen"):
+        label, value = "steps", goodput.get("steps_seen")
+    if value is not None:
+        history.append(float(value))
+    deltas = [b - a for a, b in zip(history, list(history)[1:])]
+    line = sparkline(deltas or list(history), width=width - 20)
+    cur = f"{deltas[-1]:g}" if deltas else "-"
+    return (f"{label or 'throughput':>10} {line} "
+            f"{_color(cur, BOLD, plain)}/poll")
+
+
+def _goodput_lines(goodput, width, plain):
+    if not goodput or not goodput.get("enabled", True):
+        return [f"{DIM if not plain else ''}goodput: not armed"
+                f"{RESET if not plain else ''}"]
+    totals = goodput.get("totals") or {}
+    elapsed = goodput.get("elapsed_s") or sum(totals.values()) or 1.0
+    frac = goodput.get("goodput_fraction")
+    head = "goodput"
+    if frac is not None:
+        c = GREEN if frac >= 0.7 else YELLOW if frac >= 0.4 else RED
+        head += f" {_color(f'{frac:.1%}', c, plain)}"
+    lines = [head]
+    for cat, secs in sorted(totals.items(), key=lambda kv: -kv[1])[:6]:
+        f = secs / max(elapsed, 1e-9)
+        mark = "+" if cat in _GOODPUT_GOOD else "-"
+        lines.append(f"  {mark} {cat:<18} {bar(f, width=width - 40)} "
+                     f"{f:6.1%}")
+    return lines
+
+
+def _slo_lines(slo, width, plain):
+    if not slo or not slo.get("enabled", True):
+        return [f"{DIM if not plain else ''}slo: not armed"
+                f"{RESET if not plain else ''}"]
+    lines = [f"slo burn ({slo.get('evals', 0)} evals)"]
+    for name, o in sorted((slo.get("objectives") or {}).items()):
+        tier = o.get("tier", "ok")
+        c = {"page": RED, "fast": YELLOW}.get(tier, GREEN)
+        lines.append(f"  {name:<18} target {o.get('target'):g} "
+                     f"{_color(tier.upper(), c, plain)}")
+        for wname in ("fast", "slow"):
+            w = (o.get("windows") or {}).get(wname)
+            if not w:
+                continue
+            burn = w.get("burn")
+            # gauge scale: full bar at 10x budget burn
+            lines.append(
+                f"    {wname:>4} {w.get('window_s'):>6g}s "
+                f"{bar((burn or 0.0) / 10.0, width=width - 44)} "
+                f"{'-' if burn is None else f'{burn:5.2f}x'}"
+                f"{' BURNING' if w.get('burning') else ''}")
+    return lines
+
+
+def _incident_lines(incidents, plain):
+    incs = (incidents or {}).get("incidents") or []
+    if not incs:
+        return [f"{DIM if not plain else ''}incidents: none"
+                f"{RESET if not plain else ''}"]
+    lines = [f"incidents ({len(incs)})"]
+    for i in incs[-3:]:
+        rc = i.get("root_cause") or {}
+        sev = i.get("severity") or "-"
+        c = RED if sev == "critical" else YELLOW
+        lines.append(
+            f"  #{i.get('id')} {_color(sev, c, plain)} "
+            f"{rc.get('kind')}/{rc.get('source')} "
+            f"{rc.get('rule') or rc.get('chaos') or ''} "
+            f"rules={','.join(i.get('rules') or [])}")
+    return lines
+
+
+def render_frame(reports, history=None, width=80, plain=False,
+                 source=""):
+    """One dashboard frame from a ``{name: report-or-None}`` dict.
+    Pure — the unit tests feed canned reports."""
+    history = history if history is not None else deque(maxlen=120)
+    slo = reports.get("slo") or {}
+    job = slo.get("job_name") or (reports.get("goodput") or {}).get(
+        "job_name") or "-"
+    lines = [
+        _color(f" deepspeed_tpu mission control — job {job} "
+               f"[{source or 'local'}]", BOLD, plain),
+        "─" * min(width, 100),
+        _throughput_line(reports, history, width, plain),
+        "",
+    ]
+    lines += _goodput_lines(reports.get("goodput"), width, plain)
+    lines.append("")
+    lines += _slo_lines(reports.get("slo"), width, plain)
+    lines.append("")
+    lines += _incident_lines(reports.get("incidents"), plain)
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="terminal dashboard over the live observability "
+                    "plane (or an artifact dir)")
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--url", help="obs_server base url "
+                                   "(http://127.0.0.1:PORT)")
+    src.add_argument("--dir", help="artifact dir with the JSON "
+                                   "snapshots (offline view)")
+    ap.add_argument("--token", default="", help="bearer token")
+    ap.add_argument("--interval", type=float, default=2.0)
+    ap.add_argument("--width", type=int, default=100)
+    ap.add_argument("--once", action="store_true",
+                    help="render one frame and exit")
+    ap.add_argument("--plain", action="store_true",
+                    help="no ANSI colors (pipes/tests)")
+    args = ap.parse_args(argv)
+    source = args.url or args.dir
+    history = deque(maxlen=240)
+    while True:
+        reports = gather(source, is_url=bool(args.url),
+                         token=args.token)
+        frame = render_frame(reports, history=history, width=args.width,
+                             plain=args.plain, source=source)
+        if args.once:
+            print(frame)
+            return 0
+        print(CLEAR + frame, flush=True)
+        time.sleep(max(0.2, args.interval))
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,373 @@
+"""Live observability plane — the HTTP scrape/status endpoint.
+
+Every observatory in this repo speaks *files*: the Prometheus exporter
+is a text-file-collector ``.prom`` sink and the goodput / health /
+serving / fleet / memory / chronicle reports are throttled JSON
+snapshots read after the fact. A fleet is operated through a scrape
+endpoint and a status API — this module is that endpoint, zero
+dependencies (stdlib :class:`http.server.ThreadingHTTPServer`):
+
+========================  =================================================
+route                     serves
+========================  =================================================
+``GET /metrics``          :func:`sinks.render_prometheus` over the live
+                          registry — a REAL scrape target (the ``.prom``
+                          file sink remains the node_exporter
+                          textfile-collector path)
+``GET /healthz``          liveness + armed-monitor inventory with
+                          last-tick ages (no auth — LB probes)
+``GET /readyz``           readiness: 200 once at least one monitor is
+                          registered, 503 before/after
+``GET /api/report/<x>``   each armed monitor's ``report()`` — its latest
+                          HOST-SIDE snapshot
+``GET /api/events``       bounded chronicle tail, ``?since_seq=``
+                          resumable (poll-friendly)
+========================  =================================================
+
+The load-bearing contract: **a scrape must NEVER force a device fetch,
+a sync, or a compile**. Providers are monitor-level bound ``report()``
+methods (pure host bookkeeping) — never the engine's ``health_report``/
+``memory_report`` wrappers, which force a device tick before reporting.
+The serving thread runs under the ledger's ``suppress_attribution`` so
+answering a scrape can never book badput into the run it is exposing.
+
+Thread discipline (the chronicle/PR-5 pattern): the serving thread and
+``weakref.finalize`` hold only the stdlib server object and a
+:class:`_ObsState`, never the :class:`ObsServer` wrapper — an abandoned
+server is reclaimed and its port released without an explicit
+``close()``. ``port=0`` auto-picks a free port; the bound address is on
+``server.url``. An optional bearer token guards everything except the
+two probe routes.
+"""
+
+import json
+import math
+import threading
+import weakref
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlsplit
+
+from deepspeed_tpu.telemetry import chronicle as _chronicle
+from deepspeed_tpu.telemetry import clock as _clk
+from deepspeed_tpu.telemetry import metrics as _metrics
+from deepspeed_tpu.utils.logging import logger
+
+OBS_SERVER_SCHEMA = "deepspeed_tpu.obs_server/1"
+
+# every route the API exposes; /api/report/<name> 404s with this
+# inventory so an operator's typo is self-diagnosing
+ROUTES = ("/metrics", "/healthz", "/readyz", "/api/events",
+          "/api/report/<name>")
+
+
+def _json_sane(obj):
+    """Strictly-JSON-serialisable copy: non-finite floats become strings
+    (the chronicle contract), unknown objects their repr."""
+    if isinstance(obj, float):
+        return obj if math.isfinite(obj) else str(obj)
+    if isinstance(obj, dict):
+        return {str(k): _json_sane(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_json_sane(v) for v in obj]
+    if obj is None or isinstance(obj, (bool, int, str)):
+        return obj
+    return repr(obj)
+
+
+class _ObsState:
+    """Everything the request handlers may touch — the server thread and
+    the handlers hold ONLY this (never the ObsServer), so finalize-based
+    teardown works."""
+
+    def __init__(self, registry=None, token="", events_tail=256):
+        self.registry = registry
+        self.token = str(token or "")
+        self.events_tail = max(1, int(events_tail))
+        self.lock = threading.Lock()
+        self.providers = {}          # name -> report() callable
+        self.age_fns = {}            # name -> seconds-since-last-tick fn
+        self.requests_total = 0
+        self.requests_by_route = {}
+        self.errors_total = 0
+        self.started_us = _clk.monotonic_us()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # one handler class shared by every ObsServer; state rides the
+    # stdlib server instance (attached in ObsServer.__init__)
+    server_version = "ds-obs/1"
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):      # scrapes are not log lines
+        logger.debug("[obs_server] " + fmt, *args)
+
+    # ------------------------------------------------------------ replies
+    def _reply(self, code, payload, content_type="application/json"):
+        if isinstance(payload, bytes):
+            body = payload
+        else:
+            # compact separators and a strict-dump fast path: the scrape
+            # path must stay cheap under load (the serving bench pins its
+            # tok/s cost), so the recursive _json_sane copy only runs when
+            # the payload actually holds NaN/Inf or a non-JSON object
+            try:
+                body = json.dumps(payload, separators=(",", ":"),
+                                  allow_nan=False).encode()
+            except (ValueError, TypeError):
+                body = json.dumps(_json_sane(payload),
+                                  separators=(",", ":"),
+                                  allow_nan=False).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        try:
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass                             # scraper went away mid-write
+
+    def _authorized(self, state):
+        if not state.token:
+            return True
+        return (self.headers.get("Authorization", "")
+                == f"Bearer {state.token}")
+
+    # ------------------------------------------------------------- routes
+    def do_GET(self):                                   # noqa: N802
+        state = self.server._obs_state
+        split = urlsplit(self.path)
+        path = split.path.rstrip("/") or "/"
+        with state.lock:
+            state.requests_total += 1
+            state.requests_by_route[path] = \
+                state.requests_by_route.get(path, 0) + 1
+        # the two probe routes skip auth: LB health checks can't carry
+        # bearer headers, and they expose armed-ness, not data
+        if path not in ("/healthz", "/readyz") \
+                and not self._authorized(state):
+            self._reply(401, {"error": "unauthorized",
+                              "detail": "Authorization: Bearer <token> "
+                                        "required"})
+            return
+        try:
+            if path == "/metrics":
+                self._metrics(state)
+            elif path == "/healthz":
+                self._healthz(state, ready=False)
+            elif path == "/readyz":
+                self._healthz(state, ready=True)
+            elif path == "/api/events":
+                self._events(state, parse_qs(split.query))
+            elif path.startswith("/api/report/"):
+                self._report(state, path[len("/api/report/"):])
+            else:
+                self._reply(404, {"error": "unknown route",
+                                  "routes": list(ROUTES)})
+        except Exception as e:   # a broken provider must not kill serving
+            with state.lock:
+                state.errors_total += 1
+            logger.warning("[obs_server] %s failed: %s", path, e)
+            try:
+                self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+            except Exception:
+                pass
+
+    def _metrics(self, state):
+        from deepspeed_tpu.telemetry.sinks import render_prometheus
+        reg = state.registry if state.registry is not None \
+            else _metrics.get_registry()
+        self._reply(200, render_prometheus(reg).encode(),
+                    content_type="text/plain; version=0.0.4")
+
+    def _healthz(self, state, ready):
+        with state.lock:
+            names = sorted(state.providers)
+            age_fns = dict(state.age_fns)
+        monitors = {}
+        for n in names:
+            age_fn = age_fns.get(n)
+            age = None
+            if age_fn is not None:
+                try:
+                    age = age_fn()
+                except Exception:
+                    age = None
+            monitors[n] = {"armed": True, "last_tick_age_s": age}
+        doc = {
+            "status": "ok",
+            "ready": bool(names),
+            "uptime_s": round(
+                (_clk.monotonic_us() - state.started_us) / 1e6, 3),
+            "monitors": monitors,
+            "requests_total": state.requests_total,
+        }
+        if ready and not names:
+            self._reply(503, dict(doc, status="no monitors registered"))
+        else:
+            self._reply(200, doc)
+
+    def _report(self, state, name):
+        with state.lock:
+            fn = state.providers.get(name)
+            known = sorted(state.providers)
+        if fn is None:
+            self._reply(404, {"error": f"unknown report {name!r}",
+                              "known": known})
+            return
+        self._reply(200, fn())
+
+    def _events(self, state, query):
+        chron = _chronicle.get_chronicle()
+        if not chron.enabled:
+            self._reply(200, {"enabled": False, "events": [],
+                              "last_seq": -1})
+            return
+        try:
+            since = int(query.get("since_seq", ["-1"])[0])
+            limit = int(query.get("limit", [state.events_tail])[0])
+        except (TypeError, ValueError):
+            self._reply(400, {"error": "since_seq/limit must be ints"})
+            return
+        limit = max(1, min(limit, state.events_tail))
+        events = [e for e in chron.snapshot_events() if e["seq"] > since]
+        truncated = len(events) > limit
+        events = events[-limit:]
+        self._reply(200, {
+            "enabled": True,
+            "events": events,
+            "n": len(events),
+            "truncated": truncated,
+            "last_seq": events[-1]["seq"] if events else since,
+            "dropped": chron.dropped,
+        })
+
+
+def _serve_loop(httpd):
+    # answering a scrape must never book wall time into the goodput
+    # ledger of the run being scraped (lazy import: the ledger imports
+    # the escalation helper, which imports the chronicle)
+    from deepspeed_tpu.telemetry.ledger import suppress_attribution
+    with suppress_attribution():
+        httpd.serve_forever(poll_interval=0.2)
+
+
+def _finalize_server(httpd, thread):
+    try:
+        httpd.shutdown()
+    except Exception:
+        pass
+    if thread.is_alive():
+        thread.join(timeout=5.0)
+    try:
+        httpd.server_close()
+    except Exception:
+        pass
+
+
+class ObsServer:
+    """The live observability endpoint. Construction binds the socket
+    and starts the serving thread; ``close()`` (idempotent — also run by
+    ``weakref.finalize`` on abandonment) releases the port.
+
+    ``register(name, report_fn, age_s_fn=None)`` arms one monitor on the
+    status API: *report_fn* must be the monitor-level ``report()`` bound
+    method (host-side snapshot — the no-device-fetch contract above),
+    *age_s_fn* an optional seconds-since-last-tick probe for /healthz.
+    """
+
+    def __init__(self, registry=None, host="127.0.0.1", port=0,
+                 token="", events_tail=256, log_fn=None):
+        self._log = log_fn or logger.warning
+        self._state = _ObsState(registry=registry, token=token,
+                                events_tail=events_tail)
+        self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd._obs_state = self._state
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread = threading.Thread(
+            target=_serve_loop, args=(self._httpd,),
+            name=f"ds-obs-server-{self.port}", daemon=True)
+        self._thread.start()
+        self._closed = False
+        self._finalizer = weakref.finalize(
+            self, _finalize_server, self._httpd, self._thread)
+
+    @classmethod
+    def from_config(cls, tcfg, registry=None):
+        """Build from a parsed :class:`DeepSpeedTelemetryConfig`
+        (``telemetry.server`` block)."""
+        return cls(registry=registry, host=tcfg.server_host,
+                   port=tcfg.server_port, token=tcfg.server_token,
+                   events_tail=tcfg.server_events_tail)
+
+    @property
+    def url(self):
+        return f"http://{self.host}:{self.port}"
+
+    # --------------------------------------------------------- providers
+    def register(self, name, report_fn, age_s_fn=None):
+        with self._state.lock:
+            self._state.providers[str(name)] = report_fn
+            if age_s_fn is not None:
+                self._state.age_fns[str(name)] = age_s_fn
+        return self
+
+    def unregister(self, name):
+        with self._state.lock:
+            self._state.providers.pop(name, None)
+            self._state.age_fns.pop(name, None)
+
+    def providers(self):
+        with self._state.lock:
+            return sorted(self._state.providers)
+
+    # ------------------------------------------------------------ report
+    def report(self):
+        st = self._state
+        with st.lock:
+            by_route = dict(st.requests_by_route)
+        return {
+            "schema": OBS_SERVER_SCHEMA,
+            "enabled": True,
+            "closed": self._closed,
+            "url": self.url,
+            "host": self.host,
+            "port": self.port,
+            "auth": bool(st.token),
+            "events_tail": st.events_tail,
+            "providers": self.providers(),
+            "requests_total": st.requests_total,
+            "requests_by_route": by_route,
+            "errors_total": st.errors_total,
+            "uptime_s": round(
+                (_clk.monotonic_us() - st.started_us) / 1e6, 3),
+        }
+
+    def close(self):
+        """Stop serving, join the thread, release the port. Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        self._finalizer()
+
+
+# Process-global handle (the tracer/registry/chronicle pattern) so
+# ds_report can show the armed state + bound address without an engine.
+_GLOBAL = None
+
+
+def get_obs_server():
+    return _GLOBAL
+
+
+def set_obs_server(server):
+    """Install *server* as the process global; returns the old one."""
+    global _GLOBAL
+    old, _GLOBAL = _GLOBAL, server
+    return old
+
+
+def reset_obs_server(if_current=None):
+    global _GLOBAL
+    if if_current is None or _GLOBAL is if_current:
+        _GLOBAL = None

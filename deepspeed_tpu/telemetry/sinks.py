@@ -12,26 +12,62 @@ escaping instead of being mangled into a metric name. Registry metrics
 (counters/gauges/histograms) render under their own sanitised names. The
 .prom file is the *text-file-collector* pattern: node_exporter (or any
 scraper of textfile directories) picks it up; no HTTP server needed on a
-TPU host.
+TPU host. For direct scraping, :mod:`telemetry.obs_server` serves the
+same :func:`render_prometheus` output at ``GET /metrics``.
 """
 
 import json
 import os
 import re
 import time
+import zlib
 
 from deepspeed_tpu.telemetry.metrics import Histogram, MetricsRegistry
+from deepspeed_tpu.utils.logging import logger
 
 _NAME_OK = re.compile(r"[^a-zA-Z0-9_:]")
+
+# sanitized names whose collision has already been warned about — the
+# render runs at scrape/flush cadence, the warning is once per process
+_COLLISION_WARNED = set()
 
 
 def sanitize_metric_name(name):
     """Coerce to the Prometheus metric-name charset
-    ``[a-zA-Z_:][a-zA-Z0-9_:]*``."""
+    ``[a-zA-Z_:][a-zA-Z0-9_:]*``. Lossy: distinct registry families can
+    sanitize to the same name (``a/b`` and ``a.b`` both become
+    ``a_b``) — :func:`render_prometheus` detects that at render time
+    and de-collides deterministically rather than silently merging two
+    families' samples into one."""
     name = _NAME_OK.sub("_", str(name))
     if not name or not (name[0].isalpha() or name[0] in "_:"):
         name = "_" + name
     return name
+
+
+def _sanitized_family_names(families):
+    """``{raw family -> rendered name}`` with collision repair: when two
+    registry families sanitize to the same Prometheus name, the first in
+    sorted order keeps the base name and every other collider gets a
+    deterministic ``_<crc32-of-raw-name>`` suffix (stable across renders
+    and processes — dashboards keep working). Warned once per base."""
+    by_base = {}
+    for fam in sorted(families):
+        by_base.setdefault(sanitize_metric_name(fam), []).append(fam)
+    out = {}
+    for base, fams in by_base.items():
+        out[fams[0]] = base
+        for fam in fams[1:]:
+            out[fam] = f"{base}_{zlib.crc32(fam.encode()):08x}"
+        if len(fams) > 1 and base not in _COLLISION_WARNED:
+            _COLLISION_WARNED.add(base)
+            logger.warning(
+                "[sinks] %d metric families sanitize to %r (%s); "
+                "keeping %r as %r and suffixing the rest — rename the "
+                "families to distinct sanitized names",
+                len(fams), base, ", ".join(map(repr, fams)), fams[0],
+                base)
+    return out
 
 
 def escape_label_value(value):
@@ -80,10 +116,17 @@ def render_prometheus(registry):
     interpolation inside the bucket), so TTFT / step-time percentiles
     reach scrape sinks directly instead of living only in the JSON
     artifacts. Empty histograms render no summary (a quantile of nothing
-    is a lie, not a zero)."""
+    is a lie, not a zero).
+
+    Family names that sanitize to the same Prometheus name are
+    de-collided (:func:`_sanitized_family_names`) — the exposition
+    format forbids a duplicate TYPE line, and merging two families'
+    samples under one name corrupts both series."""
     lines = []
-    for family, ms in sorted(registry.collect().items()):
-        name = sanitize_metric_name(family)
+    collected = registry.collect()
+    names = _sanitized_family_names(collected)
+    for family, ms in sorted(collected.items()):
+        name = names[family]
         help_text = next((m.help for m in ms if m.help), "")
         if help_text:
             lines.append(f"# HELP {name} {escape_help(help_text)}")
@@ -156,7 +199,16 @@ class JSONLSink:
 
 
 class PrometheusSink:
-    """Atomically (re)writes a .prom text file from a registry."""
+    """Atomically (re)writes a .prom text file from a registry.
+
+    This is the *textfile-collector* half of the Prometheus story: a
+    node_exporter (or any textfile-directory scraper) on the host picks
+    the file up — no port, no server, works on locked-down TPU hosts.
+    The *direct-scrape* half is :class:`telemetry.obs_server.ObsServer`,
+    whose ``GET /metrics`` renders the same registry live over HTTP;
+    arm it with the ``telemetry.server`` config block when Prometheus
+    can reach the trainer. Both render through
+    :func:`render_prometheus`, so the two views never disagree."""
 
     def __init__(self, path, registry):
         d = os.path.dirname(path)
@@ -199,7 +251,13 @@ class JSONLMonitor:
 
 class PrometheusMonitor:
     """MonitorMaster backend: scalars as one labelled gauge family,
-    flushed to a text-format file the registry's other metrics share."""
+    flushed to a text-format file the registry's other metrics share.
+
+    File-based by design (see :class:`PrometheusSink` for when to prefer
+    the live ``/metrics`` endpoint instead): when the obs server is
+    armed on the same registry, the scalars written here are ALSO
+    visible on the scrape route for free — the monitor writes into the
+    registry first and flushes the file second."""
 
     SCALAR_FAMILY = "deepspeed_scalar"
 

@@ -53,7 +53,12 @@ Env:  SERVING_BENCH_OUT (default SERVING_BENCH.json at the repo root),
       (tokens per decode dispatch, default 8),
       SERVING_BENCH_PREFIX_N / _PREFIX_POOL / _PREFIX_LEN / _REUSE
       (shared-prefix trace: requests 64, pool 4, prefix length 96,
-      reuse ratio 0.9), SERVING_BENCH_ROUTER_N (router trace size, 32).
+      reuse ratio 0.9), SERVING_BENCH_ROUTER_N (router trace size, 32),
+      BENCH_OBS_SERVER=1 (opt-in: replay the timed trace once more with
+      the live obs endpoint armed and a background scraper polling
+      /metrics + /api/report/serving; records the measured tok/s delta
+      in an ``obs_server`` artifact section and REFUSES the regen when
+      answering scrapes costs more than 2% throughput).
 """
 
 import dataclasses
@@ -67,6 +72,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 import numpy as np
 
 PROMPT_BUCKET = 32         # baseline pads prompts to this multiple
+OBS_SCRAPE_INTERVAL_S = 0.5   # obs-server arm: aggressive dashboard rate
 
 
 def _exact_percentile(values, q):
@@ -218,6 +224,77 @@ def slot_steps_of(srv, warm, max_batch, K):
     }
 
 
+def run_obs_scraped(eng, serving_cfg, trace):
+    """BENCH_OBS_SERVER=1 arm: interleaved A/B pairs on the same timed
+    trace — replays with no server alternating with replays where the
+    live observability endpoint is armed on the serving registry and a
+    background scraper polls ``/metrics`` + ``/api/report/serving``
+    twice a second (an aggressive dashboard cadence; Prometheus default
+    is 15 s). Three pairs, best-of per arm: a scheduler hiccup on
+    either side can neither fake nor mask a regression on a ~4 s CPU
+    replay. Returns (off_elapsed_s, on_elapsed_s, stats)."""
+    import http.client
+    import threading
+
+    from deepspeed_tpu.serving.server import ServingEngine
+    from deepspeed_tpu.telemetry.metrics import MetricsRegistry
+    from deepspeed_tpu.telemetry.obs_server import ObsServer
+
+    scrapes = {"n": 0, "errors": 0}
+
+    def run_off():
+        _, elapsed, _, _, _ = run_serving(
+            lambda: ServingEngine(eng, config=dict(serving_cfg),
+                                  registry=MetricsRegistry()), trace)
+        return elapsed
+
+    def run_on():
+        registry = MetricsRegistry()
+        obs = ObsServer(registry=registry)
+        stop = threading.Event()
+
+        def scraper():
+            # one keep-alive connection for the whole run, exactly like a
+            # real Prometheus scraper — a fresh connection per request
+            # would bill client-side setup and server thread churn to the
+            # scrape cost
+            conn = http.client.HTTPConnection(
+                obs.url.split("//", 1)[1], timeout=2.0)
+            while not stop.is_set():
+                for path in ("/metrics", "/api/report/serving"):
+                    try:
+                        conn.request("GET", path)
+                        conn.getresponse().read()
+                        # any answered status counts (404 until the
+                        # engine registers its provider) — still costed
+                        scrapes["n"] += 1
+                    except Exception:
+                        scrapes["errors"] += 1
+                        conn.close()        # reconnect on next request
+                stop.wait(OBS_SCRAPE_INTERVAL_S)
+            conn.close()
+
+        thread = threading.Thread(target=scraper, daemon=True,
+                                  name="bench-obs-scraper")
+        thread.start()
+        try:
+            _, elapsed, _, _, _ = run_serving(
+                lambda: ServingEngine(eng, config=dict(serving_cfg),
+                                      registry=registry, obs_server=obs),
+                trace)
+        finally:
+            stop.set()
+            thread.join(timeout=5.0)
+            obs.close()
+        return elapsed
+
+    offs, ons = [], []
+    for _ in range(3):
+        offs.append(run_off())
+        ons.append(run_on())
+    return min(offs), min(ons), dict(scrapes, pairs=len(offs))
+
+
 def run_router(eng, serving_cfg, trace, n_replicas, make_registry):
     """Aggregate throughput of ``n_replicas`` cache-armed replicas
     behind the prefix-affinity router (fresh engines per run; every
@@ -319,6 +396,27 @@ def main():
     K = serving_cfg["decode_steps"]
     slot_steps = slot_steps_of(srv, warm, max_batch, K)
     sched_steps, total_units = slot_steps["steps"], slot_steps["total_units"]
+
+    # ---- opt-in obs-server arm: what answering live scrapes costs
+    obs_section = None
+    if os.environ.get("BENCH_OBS_SERVER") == "1":
+        off_s, on_s_obs, scrapes = run_obs_scraped(eng, serving_cfg,
+                                                   trace)
+        obs_section = {
+            "scrape_interval_s": OBS_SCRAPE_INTERVAL_S,
+            "scrapes": scrapes["n"],
+            "scrape_errors": scrapes["errors"],
+            "pairs": scrapes["pairs"],
+            "elapsed_s": {"server_off": round(off_s, 4),
+                          "server_on": round(on_s_obs, 4)},
+            "tok_s": {"server_off": round(useful_tokens / off_s, 1),
+                      "server_on": round(useful_tokens / on_s_obs, 1)},
+            # fraction of throughput lost to answering scrapes
+            # (interleaved A/B pairs on the same warm engine, best-of
+            # per arm)
+            "tok_s_delta_frac": round(
+                max(0.0, 1.0 - off_s / on_s_obs), 4),
+        }
 
     # ---- shared-prefix A/B: equal config, prefix cache off then on
     ptrace = build_prefix_trace(
@@ -438,6 +536,8 @@ def main():
     }
     doc["speedup"] = round(doc["serving"]["tok_s"]
                            / doc["baseline"]["tok_s"], 3)
+    if obs_section is not None:
+        doc["obs_server"] = obs_section
 
     print(json.dumps(doc, indent=2))
     if doc["serving"]["tok_s"] <= doc["baseline"]["tok_s"]:
@@ -476,6 +576,12 @@ def main():
                   f"expected {ss['expected_units']} — the "
                   "by-construction invariant broke", file=sys.stderr)
             sys.exit(1)
+    if obs_section is not None and obs_section["tok_s_delta_frac"] > 0.02:
+        print("REFUSING to write artifact: answering live scrapes cost "
+              f"{obs_section['tok_s_delta_frac']:.1%} of serving tok/s "
+              f"(over {obs_section['scrapes']} scrape(s)) — the "
+              "observability plane stopped being free", file=sys.stderr)
+        sys.exit(1)
     pc_compile = prefix_section["compile"]
     if pc_compile["decode_signatures"] != 1 or pc_compile["retraces"]:
         print("REFUSING to write artifact: cache-on run's decode "

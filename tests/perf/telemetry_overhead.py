@@ -51,6 +51,17 @@ Asserts:
   ``profile_step`` carries no anatomy state, and ``profile_step`` itself
   adds ZERO new train-step signatures (the capture reuses the primed
   dispatch);
+* ``telemetry.server`` (obs server): the scrape endpoint armed AND
+  actively hit between steps (/metrics plus every /api/report/* route)
+  still compiles the train step exactly ONCE over 20 steps and forces
+  no device fetches beyond the health cadence — a scrape reads the
+  latest host-side snapshots only; close() releases the port and joins
+  the serve thread;
+* ``telemetry.slo``: the armed burn monitor is host arithmetic (zero
+  extra compiles, per-step evals at a test-tiny interval), a
+  seconds-long run can never become burn-eligible against production
+  windows (the min-span guard), and the disabled/closed ``tick()``
+  paths fit the <2 µs budget;
 * ``guardian``: an ARMED guardian with no anomalies is free — a 20-step
   run with guardian + health on still compiles the train step exactly
   ONCE (the guardian owns zero compiled programs, statically guarded:
@@ -88,7 +99,8 @@ def _tiny_engine(ce_enabled, health_enabled=False, goodput_enabled=False,
                  prefetch_enabled=False, comm_overlap=False,
                  fleet_enabled=False, guardian_enabled=False,
                  memory_enabled=False, memory_cadence=0,
-                 chronicle_enabled=False, steps_per_print=10 ** 9):
+                 chronicle_enabled=False, server_enabled=False,
+                 slo_enabled=False, steps_per_print=10 ** 9):
     import tempfile
 
     import jax
@@ -138,6 +150,9 @@ def _tiny_engine(ce_enabled, health_enabled=False, goodput_enabled=False,
                               "memory": {"enabled": memory_enabled,
                                          "cadence": memory_cadence},
                               "chronicle": chronicle_cfg,
+                              "server": {"enabled": server_enabled},
+                              "slo": {"enabled": slo_enabled,
+                                      "eval_interval_s": 0.001},
                               "fleet": fleet_cfg}},
         sample_batch=batch)
     return engine, batch
@@ -807,6 +822,136 @@ def check_memory_obs_no_device_access():
           "CLI demo / profile fetcher)")
 
 
+def check_obs_server_zero_extra_compiles(steps=20, cadence=5):
+    """ISSUE-18 acceptance guard: the obs server ARMED and actively
+    scraped mid-run — /metrics plus every /api/report/* route hit
+    between steps — still compiles the train step exactly ONCE over 20
+    steps, and the request path forces no extra device fetches (the
+    health monitor's cadence fetch count is unchanged by the scrapes:
+    providers are host-side report() methods, never the engine's
+    device-ticking *_report wrappers)."""
+    import json as _json
+    import urllib.request
+
+    engine, batch = _tiny_engine(ce_enabled=True, health_enabled=True,
+                                 goodput_enabled=True, server_enabled=True,
+                                 slo_enabled=True, steps_per_print=cadence)
+    srv = engine._obs_server
+    assert srv is not None, "obs server must be armed on this config"
+    assert engine._slo is not None, "slo monitor must be armed"
+    routes = ["/metrics", "/healthz", "/readyz", "/api/events"] + [
+        f"/api/report/{name}" for name in srv.providers()]
+    assert "/api/report/slo" in routes and "/api/report/goodput" in routes
+
+    def scrape_all():
+        for route in routes:
+            with urllib.request.urlopen(srv.url + route, timeout=5) as r:
+                r.read()
+                assert r.status == 200, (route, r.status)
+
+    engine.train_batch(batch=batch)       # the one compile
+    scrape_all()
+    after_prime = _backend_compiles(engine)
+    for _ in range(steps - 1):
+        engine.train_batch(batch=batch)
+        scrape_all()
+    after_steps = _backend_compiles(engine)
+    assert after_steps == after_prime, (
+        f"scraping the obs server recompiled the step: "
+        f"{after_prime} -> {after_steps} over {steps} steps")
+    expected = steps // cadence
+    assert engine.telemetry.health.samples_seen == expected, (
+        f"device stats fetched {engine.telemetry.health.samples_seen}x "
+        f"over {steps} scraped steps; the cadence-{cadence} path must "
+        f"fetch exactly {expected}x — a scrape forced a device sync")
+    with urllib.request.urlopen(srv.url + "/healthz", timeout=5) as r:
+        health = _json.loads(r.read())
+    assert health["monitors"], "healthz must inventory the armed monitors"
+    n_scrapes = srv.report()["requests_total"]
+    engine.close()
+    # close() must release the port and join the serve thread
+    import socket
+    import threading
+    with socket.socket() as s:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((srv.host, srv.port))
+    alive = [t for t in threading.enumerate()
+             if t.is_alive() and t.name.startswith("ds-obs-server")]
+    assert not alive, f"engine.close() leaked obs-server threads: {alive}"
+    print(f"obs server path: 1 compile over {steps} scraped steps "
+          f"({n_scrapes} requests, {len(routes)} routes), device "
+          f"fetches at cadence only, teardown leak-free")
+
+
+def check_slo_armed_inert(steps=20, cadence=5):
+    """SLO monitor ARMED (goodput objective live, production windows) on
+    a healthy short run: zero extra train-step compiles (burn math is
+    host arithmetic over the ledger's own numbers), every eval stays
+    tier-ok (a seconds-long run can never span half a 5-minute window),
+    and no burn anomalies fire."""
+    engine, batch = _tiny_engine(ce_enabled=True, goodput_enabled=True,
+                                 slo_enabled=True, steps_per_print=cadence)
+    slo = engine._slo
+    assert slo is not None, "slo monitor must be armed on this config"
+    assert [o["name"] for o in slo.objectives] == ["training_goodput"]
+    engine.train_batch(batch=batch)       # the one compile
+    after_prime = _backend_compiles(engine)
+    for _ in range(steps - 1):
+        engine.train_batch(batch=batch)
+    after_steps = _backend_compiles(engine)
+    assert after_steps == after_prime, (
+        f"armed slo monitor changed compilation: {after_prime} -> "
+        f"{after_steps} over {steps} steps — burn math must stay on "
+        f"the host")
+    assert slo.evals == steps, (
+        f"slo evaluated {slo.evals}x over {steps} steps at a test-tiny "
+        f"interval — the per-step tick wiring rotted")
+    rep = slo.report()
+    obj = rep["objectives"]["training_goodput"]
+    assert obj["tier"] == "ok" and rep["rule_counts"] == {}, (
+        f"a seconds-long run burned a 5-minute window: {obj}")
+    assert not obj["windows"]["fast"]["eligible"], (
+        "the min-span eligibility guard rotted — a short run must not "
+        "be eligible to burn")
+    print(f"slo armed path: 1 compile over {steps} steps, {slo.evals} "
+          f"host-side evals, tier ok, 0 anomalies")
+
+
+def check_slo_disabled_inert(steps=3, iters=100_000):
+    """telemetry.slo off (the default) => no monitor object, no slo
+    metrics; a DISABLED monitor's tick() and a CLOSED monitor's tick()
+    both fit the same <2 µs budget as the disabled tracer."""
+    from deepspeed_tpu.telemetry.slo import SloMonitor
+    engine, batch = _tiny_engine(ce_enabled=False, goodput_enabled=True)
+    assert engine._slo is None and engine._obs_server is None
+    for _ in range(steps):
+        engine.train_batch(batch=batch)
+    snap = engine.telemetry.registry.snapshot()
+    for name in ("slo_burn_rate", "slo_burn_total",
+                 "slo_anomalies_total"):
+        assert name not in snap, f"unexpected metric {name} while disabled"
+
+    disabled = SloMonitor(enabled=False)
+    tick = disabled.tick
+    t0 = time.perf_counter()
+    for i in range(iters):
+        tick(step=i)
+    dis_us = (time.perf_counter() - t0) / iters * 1e6
+    closed = SloMonitor(objectives=[{"name": "g", "kind": "goodput",
+                                     "target": 0.9}])
+    closed.close()
+    tick = closed.tick
+    t0 = time.perf_counter()
+    for i in range(iters):
+        tick(step=i)
+    closed_us = (time.perf_counter() - t0) / iters * 1e6
+    assert dis_us < DISABLED_BUDGET_US and closed_us < DISABLED_BUDGET_US, (
+        f"slo tick disabled={dis_us:.3f} / closed={closed_us:.3f} us — "
+        f"over the {DISABLED_BUDGET_US} us budget")
+    print(f"disabled slo path: no monitor, no metrics, "
+          f"{dis_us:.3f} us/disabled-tick, {closed_us:.3f} us/closed-tick")
+
+
 def check_guardian_armed_zero_overhead(steps=20, cadence=5):
     """ISSUE-13 acceptance guard: guardian ARMED (with health feeding
     it) on a healthy run — still exactly ONE train-step compile over 20
@@ -1030,6 +1175,9 @@ def main(iters=200_000):
     check_memory_zero_extra_compiles()
     check_memory_disabled_inert()
     check_memory_obs_no_device_access()
+    check_obs_server_zero_extra_compiles()
+    check_slo_armed_inert()
+    check_slo_disabled_inert()
     check_guardian_armed_zero_overhead()
     check_guardian_disabled_inert()
     check_chronicle_armed_zero_extra_compiles()

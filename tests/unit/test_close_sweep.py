@@ -29,7 +29,9 @@ from deepspeed_tpu.telemetry.health import HealthMonitor
 from deepspeed_tpu.telemetry.ledger import GoodputLedger
 from deepspeed_tpu.telemetry.manager import TelemetryManager
 from deepspeed_tpu.telemetry.memory_observatory import MemoryMonitor
+from deepspeed_tpu.telemetry.obs_server import ObsServer
 from deepspeed_tpu.telemetry.serving_observatory import ServingObservatory
+from deepspeed_tpu.telemetry.slo import SloMonitor
 
 
 def _health(tmp):
@@ -99,6 +101,20 @@ def _manager_disabled(tmp):
     return m, None
 
 
+def _obs_server(tmp):
+    srv = ObsServer()
+    srv.register("slo", lambda: {"enabled": True})
+    return srv, srv.report
+
+
+def _slo_monitor(tmp):
+    m = SloMonitor(
+        objectives=[{"name": "g", "kind": "goodput", "target": 0.9}],
+        snapshot_path=str(tmp / "SLO_REPORT.json"))
+    m.tick(step=1, force=True)
+    return m, m.report
+
+
 CLOSEABLES = {
     "health": _health,
     "goodput_ledger": _ledger,
@@ -109,6 +125,8 @@ CLOSEABLES = {
     "guardian": _guardian,
     "chronicle": _chronicle,
     "telemetry_manager_disabled": _manager_disabled,
+    "obs_server": _obs_server,
+    "slo_monitor": _slo_monitor,
 }
 
 

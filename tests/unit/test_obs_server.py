@@ -1,0 +1,461 @@
+"""Live observability endpoint (telemetry/obs_server.py + engine glue).
+
+Covers the mission-control acceptance criteria: every route serves its
+contract (metrics text, probe inventory, report snapshots, resumable
+bounded event tail), auth guards everything except the LB probes, a
+broken provider degrades to a 500 without killing the server, teardown
+releases the port and joins the serve thread, and — the load-bearing
+contract — a scrape against a REAL armed engine never touches the
+device (pinned by poisoning ``jax.device_get`` during the scrapes).
+Also pins the sanitize-collision repair in the Prometheus renderer and
+the dashboard's pure frame rendering over canned reports.
+"""
+
+import gc
+import json
+import socket
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.simple import SimpleModel, sample_batch
+from deepspeed_tpu.telemetry import chronicle as chron_mod
+from deepspeed_tpu.telemetry import dashboard
+from deepspeed_tpu.telemetry import obs_server as obs_mod
+from deepspeed_tpu.telemetry.metrics import MetricsRegistry
+from deepspeed_tpu.telemetry.obs_server import OBS_SERVER_SCHEMA, ObsServer
+from deepspeed_tpu.telemetry.sinks import render_prometheus
+
+
+def _get(url, token=None, timeout=5.0):
+    """(status, body-bytes, content-type) for one GET; HTTP errors are
+    returned, not raised — the tests assert on status codes."""
+    req = urllib.request.Request(url)
+    if token:
+        req.add_header("Authorization", f"Bearer {token}")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, r.read(), r.headers.get("Content-Type", "")
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), e.headers.get("Content-Type", "")
+
+
+def _get_json(url, token=None):
+    status, body, _ = _get(url, token=token)
+    return status, json.loads(body)
+
+
+@pytest.fixture
+def server():
+    reg = MetricsRegistry()
+    reg.counter("pinned_counter_total", "a counter the scrape must see",
+                labels={"k": "v"}).inc(3)
+    srv = ObsServer(registry=reg)
+    yield srv, reg
+    srv.close()
+
+
+class TestRoutes:
+    def test_metrics_is_a_real_scrape_target(self, server):
+        srv, reg = server
+        status, body, ctype = _get(srv.url + "/metrics")
+        assert status == 200
+        assert ctype.startswith("text/plain")
+        text = body.decode()
+        assert 'pinned_counter_total{k="v"} 3' in text
+        # byte-identical to the .prom file sink's renderer: the two
+        # Prometheus views must never disagree
+        assert text == render_prometheus(reg)
+
+    def test_healthz_and_readyz_inventory(self, server):
+        srv, _ = server
+        status, doc = _get_json(srv.url + "/healthz")
+        assert status == 200
+        assert doc["status"] == "ok" and doc["ready"] is False
+        assert doc["monitors"] == {}
+        # readyz is the gating probe: 503 until a provider registers
+        status, _doc = _get_json(srv.url + "/readyz")
+        assert status == 503
+        srv.register("goodput", lambda: {"enabled": True},
+                     age_s_fn=lambda: 1.25)
+        status, doc = _get_json(srv.url + "/readyz")
+        assert status == 200 and doc["ready"] is True
+        assert doc["monitors"]["goodput"] == {"armed": True,
+                                              "last_tick_age_s": 1.25}
+
+    def test_report_route_and_404_inventory(self, server):
+        srv, _ = server
+        srv.register("slo", lambda: {"schema": "x", "tier": "ok"})
+        status, doc = _get_json(srv.url + "/api/report/slo")
+        assert status == 200 and doc == {"schema": "x", "tier": "ok"}
+        status, doc = _get_json(srv.url + "/api/report/nope")
+        assert status == 404 and doc["known"] == ["slo"]
+        srv.unregister("slo")
+        status, doc = _get_json(srv.url + "/api/report/slo")
+        assert status == 404
+
+    def test_unknown_route_lists_the_api(self, server):
+        srv, _ = server
+        status, doc = _get_json(srv.url + "/bogus")
+        assert status == 404
+        assert "/metrics" in doc["routes"]
+
+    def test_report_is_json_sane(self, server):
+        """Non-finite floats in a provider's report must serialize as
+        strings (strict JSON), not crash the route or emit bare NaN."""
+        srv, _ = server
+        srv.register("memory", lambda: {"drift": float("nan"),
+                                        "peak": float("inf")})
+        status, body, _ = _get(srv.url + "/api/report/memory")
+        assert status == 200
+        doc = json.loads(
+            body, parse_constant=lambda tok: pytest.fail(
+                f"response contains bare {tok!r} — not valid JSON"))
+        assert doc == {"drift": "nan", "peak": "inf"}
+
+    def test_broken_provider_is_a_500_not_a_crash(self, server):
+        srv, _ = server
+
+        def boom():
+            raise RuntimeError("monitor died")
+
+        srv.register("fleet", boom)
+        status, doc = _get_json(srv.url + "/api/report/fleet")
+        assert status == 500 and "monitor died" in doc["error"]
+        # the server survives and keeps serving other routes
+        status, _body, _ = _get(srv.url + "/metrics")
+        assert status == 200
+        assert srv.report()["errors_total"] == 1
+
+
+class TestEvents:
+    def test_tail_resumable_and_bounded(self, tmp_path):
+        srv = ObsServer(registry=MetricsRegistry(), events_tail=8)
+        chron = chron_mod.RunChronicle(run_dir=str(tmp_path / "chron"),
+                                       rank=0, background=False)
+        old = chron_mod.set_chronicle(chron)
+        try:
+            for i in range(20):
+                chron.emit("anomaly", source="health", step=i,
+                           rule="loss_spike")
+            status, doc = _get_json(srv.url + "/api/events")
+            assert status == 200 and doc["enabled"] is True
+            # bounded: capped at events_tail, flagged as truncated
+            assert doc["n"] == 8 and doc["truncated"] is True
+            assert [e["step"] for e in doc["events"]] == list(range(12, 20))
+            last = doc["last_seq"]
+            # resume from the cursor: nothing new -> empty, not re-sent
+            status, doc = _get_json(
+                srv.url + f"/api/events?since_seq={last}")
+            assert status == 200
+            assert doc["n"] == 0 and doc["last_seq"] == last
+            chron.emit("anomaly", source="health", step=99, rule="x")
+            status, doc = _get_json(
+                srv.url + f"/api/events?since_seq={last}")
+            assert doc["n"] == 1 and doc["events"][0]["step"] == 99
+            assert doc["truncated"] is False
+            # limit is clamped to the configured tail, never unbounded
+            status, doc = _get_json(srv.url + "/api/events?limit=10000")
+            assert doc["n"] <= 8
+            status, doc = _get_json(srv.url + "/api/events?since_seq=abc")
+            assert status == 400
+        finally:
+            chron_mod.set_chronicle(old)
+            chron.close()
+            srv.close()
+
+    def test_disabled_chronicle_is_inert(self, server):
+        srv, _ = server
+        chron_mod.reset_chronicle()
+        status, doc = _get_json(srv.url + "/api/events")
+        assert status == 200
+        assert doc == {"enabled": False, "events": [], "last_seq": -1}
+
+
+class TestAuth:
+    def test_token_guards_everything_but_the_probes(self):
+        srv = ObsServer(registry=MetricsRegistry(), token="hunter2")
+        srv.register("slo", lambda: {"enabled": True})
+        try:
+            for path in ("/metrics", "/api/report/slo", "/api/events"):
+                status, _body, _ = _get(srv.url + path)
+                assert status == 401, f"{path} must require the token"
+                status, _body, _ = _get(srv.url + path, token="wrong")
+                assert status == 401
+                status, _body, _ = _get(srv.url + path, token="hunter2")
+                assert status == 200
+            # LB probes cannot carry bearer headers: always open
+            for path in ("/healthz", "/readyz"):
+                status, _body, _ = _get(srv.url + path)
+                assert status == 200, f"{path} must be probe-open"
+            assert srv.report()["auth"] is True
+        finally:
+            srv.close()
+
+
+class TestLifecycle:
+    def test_close_idempotent_releases_port_joins_thread(self):
+        srv = ObsServer(registry=MetricsRegistry())
+        host, port = srv.host, srv.port
+        tname = f"ds-obs-server-{port}"
+        assert any(t.name == tname for t in threading.enumerate())
+        srv.close()
+        srv.close()
+        assert not any(t.name == tname and t.is_alive()
+                       for t in threading.enumerate()), \
+            "close() must join the serve thread"
+        with socket.socket() as s:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind((host, port))   # the port is actually released
+        # report() keeps working after close (forensics outlive serving)
+        doc = srv.report()
+        assert doc["schema"] == OBS_SERVER_SCHEMA and doc["closed"]
+
+    def test_abandoned_server_is_finalized(self):
+        """The serve thread and finalizer hold only the stdlib server —
+        dropping the last ObsServer ref must reclaim the port without an
+        explicit close() (the chronicle thread-discipline pattern)."""
+        srv = ObsServer(registry=MetricsRegistry())
+        host, port = srv.host, srv.port
+        del srv
+        gc.collect()
+        with socket.socket() as s:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind((host, port))
+
+    def test_global_handle(self):
+        srv = ObsServer(registry=MetricsRegistry())
+        try:
+            assert obs_mod.set_obs_server(srv) is None
+            assert obs_mod.get_obs_server() is srv
+            # reset with a different current is a no-op
+            other = object()
+            obs_mod.reset_obs_server(if_current=other)
+            assert obs_mod.get_obs_server() is srv
+            obs_mod.reset_obs_server(if_current=srv)
+            assert obs_mod.get_obs_server() is None
+        finally:
+            obs_mod.reset_obs_server()
+            srv.close()
+
+
+# --------------------------------------------------- engine integration
+
+def _mission_config(tmp_path):
+    return {
+        "train_batch_size": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "steps_per_print": 5,
+        "telemetry": {
+            "enabled": True, "trace": False, "jsonl": False,
+            "prometheus": False,
+            "output_path": str(tmp_path),
+            "health": {"enabled": True},
+            "goodput": {"enabled": True, "profiler_capture": False},
+            "server": {"enabled": True},
+            "slo": {"enabled": True, "eval_interval_s": 0.001},
+        },
+    }
+
+
+class TestEngineIntegration:
+    def test_scrape_never_touches_the_device(self, tmp_path,
+                                             monkeypatch):
+        """THE no-device-fetch contract, enforced adversarially: with
+        ``jax.device_get`` poisoned, every route must still answer 200
+        from the latest host-side snapshots — a provider that reaches
+        for the device turns into a 500 and fails here."""
+        import jax
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=SimpleModel(hidden_dim=16, nlayers=2),
+            config=_mission_config(tmp_path),
+            sample_batch=sample_batch(8, 16), seed=42)
+        try:
+            srv = engine._obs_server
+            assert srv is not None and engine._slo is not None
+            assert obs_mod.get_obs_server() is srv
+            batch = sample_batch(8, 16)
+            for _ in range(6):       # past one print cadence
+                engine.train_batch(batch=batch)
+
+            def poisoned(*a, **k):
+                raise AssertionError(
+                    "a scrape forced a device fetch")
+
+            monkeypatch.setattr(jax, "device_get", poisoned)
+            routes = ["/metrics", "/healthz", "/readyz", "/api/events"]
+            routes += [f"/api/report/{n}" for n in srv.providers()]
+            assert {"goodput", "health", "slo"} <= set(srv.providers())
+            for route in routes:
+                status, body, _ = _get(srv.url + route)
+                assert status == 200, (route, status, body[:300])
+            monkeypatch.undo()
+            # the engine's own metrics are on the scrape route
+            _status, body, _ = _get(srv.url + "/metrics")
+            assert b"goodput_fraction" in body
+            status, doc = _get_json(srv.url + "/api/report/slo")
+            assert doc["objectives"]["training_goodput"]["active"]
+            status, doc = _get_json(srv.url + "/healthz")
+            assert doc["monitors"]["slo"]["last_tick_age_s"] is not None
+        finally:
+            engine.close()
+        # engine teardown closed the server, released its port, and
+        # detached the global handle
+        assert obs_mod.get_obs_server() is None
+        with socket.socket() as s:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind((srv.host, srv.port))
+
+
+class TestServingEngineIntegration:
+    def test_serving_provider_objectives_and_scrape(self, tmp_path):
+        """The plane over a ServingEngine: standalone ObsServer/SloMonitor
+        ride in via the ctor kwargs (no training engine), the 'serving'
+        provider and the default latency objectives arm, the scrape sees
+        live serving metrics, and close() unregisters the provider."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+        from deepspeed_tpu.serving.server import ServingEngine
+        from deepspeed_tpu.telemetry.slo import SloMonitor
+        from deepspeed_tpu.utils import groups
+
+        groups.destroy()
+        groups.initialize()
+        cfg = GPT2Config(vocab_size=256, n_positions=64, n_embd=32,
+                         n_layer=2, n_head=2)
+        model = GPT2LMHeadModel(cfg)
+        params = model.init(
+            jax.random.PRNGKey(3),
+            {"input_ids": jnp.zeros((1, 8), jnp.int32)})["params"]
+        eng = deepspeed_tpu.init_inference(model, params=params,
+                                          dtype=jnp.float32)
+        reg = MetricsRegistry()
+        slo = SloMonitor(registry=reg, eval_interval_s=0.001,
+                         snapshot_path=str(tmp_path / "SLO_REPORT.json"))
+        # what SloMonitor.from_config stashes for the ServingEngine
+        slo.serving_defaults = (
+            {"name": "serving_ttft", "kind": "latency",
+             "metric": "serving_ttft_ms", "threshold_ms": 500.0,
+             "target": 0.99},)
+        srv_obs = ObsServer(registry=reg)
+        srv = ServingEngine(
+            eng, config={"max_batch": 2, "block_size": 8,
+                         "max_model_len": 48},
+            registry=reg, obs_server=srv_obs, slo=slo)
+        try:
+            assert srv_obs.providers() == ["serving"]
+            assert [o["name"] for o in slo.objectives] == \
+                ["serving_ttft"]
+            rid = srv.submit(np.arange(6, dtype=np.int32) % 256,
+                             max_new_tokens=4)
+            while srv.scheduler.has_work():
+                srv.step()
+            assert rid in {o.req_id for o in srv.collect()}
+            status, doc = _get_json(srv_obs.url + "/api/report/serving")
+            assert status == 200 and "engine_state" in doc
+            status, body, _ = _get(srv_obs.url + "/metrics")
+            assert status == 200 and b"serving_ttft_ms" in body
+            # the step loop ticked the monitor against live traffic
+            obj = slo.report()["objectives"]["serving_ttft"]
+            assert obj["active"] is True
+            assert obj["totals"]["total"] >= 1
+        finally:
+            srv.close()
+        assert srv_obs.providers() == []
+        srv_obs.close()
+
+
+# ------------------------------------------------- sanitize collisions
+
+class TestSanitizeCollisions:
+    def test_colliding_families_are_split_deterministically(self):
+        reg = MetricsRegistry()
+        reg.gauge("train/loss", "slashed").set(1.0)
+        reg.gauge("train.loss", "dotted").set(2.0)
+        reg.gauge("train_loss", "clean").set(3.0)
+        text = render_prometheus(reg)
+        lines = [ln for ln in text.splitlines()
+                 if ln and not ln.startswith("#")]
+        # three families -> three distinct sample names, no silent merge
+        names = {ln.split("{")[0].split(" ")[0] for ln in lines}
+        assert len(names) == 3, text
+        # first in sorted order keeps the base name; colliders get a
+        # stable crc32 suffix (dashboards keep working across renders)
+        assert "train_loss" in names
+        assert text == render_prometheus(reg), \
+            "the de-collision must be deterministic across renders"
+        type_lines = [ln for ln in text.splitlines()
+                      if ln.startswith("# TYPE ")]
+        typed = [ln.split()[2] for ln in type_lines]
+        assert len(typed) == len(set(typed)), (
+            "duplicate TYPE lines — the exposition format forbids "
+            "re-declaring a family")
+
+    def test_no_collision_no_suffix(self):
+        reg = MetricsRegistry()
+        reg.counter("plain_total", "no collision here").inc()
+        assert "plain_total 1" in render_prometheus(reg)
+
+
+# ------------------------------------------------------------ dashboard
+
+class TestDashboard:
+    CANNED = {
+        "goodput": {"enabled": True, "job_name": "j",
+                    "elapsed_s": 10.0, "steps_seen": 42,
+                    "goodput_fraction": 0.82,
+                    "totals": {"device_compute": 8.2, "input_wait": 1.8}},
+        "slo": {"enabled": True, "job_name": "j", "evals": 7,
+                "objectives": {"serving_ttft": {
+                    "target": 0.95, "tier": "page",
+                    "windows": {
+                        "fast": {"window_s": 300.0, "burn": 6.0,
+                                 "burning": True},
+                        "slow": {"window_s": 3600.0, "burn": 3.6,
+                                 "burning": True}}}}},
+        "serving": None,
+        "health": None,
+        "incidents": {"incidents": [
+            {"id": 0, "severity": "critical",
+             "root_cause": {"kind": "anomaly", "source": "slo",
+                            "rule": "slo_burn_page"},
+             "rules": ["slo_burn_page"]}]},
+    }
+
+    def test_render_frame_is_pure_and_complete(self):
+        frame = dashboard.render_frame(dict(self.CANNED), plain=True,
+                                       source="unit")
+        assert "mission control" in frame and "job j" in frame
+        assert "82.0%" in frame            # goodput headline
+        assert "device_compute" in frame
+        assert "serving_ttft" in frame and "PAGE" in frame
+        assert "BURNING" in frame
+        assert "slo_burn_page" in frame    # incident line
+        # plain mode: no ANSI escapes (pipes/tests)
+        assert "\033[" not in frame
+
+    def test_render_frame_survives_dead_sources(self):
+        """A dashboard must survive its server restarting — every report
+        None renders placeholders, never raises."""
+        frame = dashboard.render_frame(
+            {n: None for n in self.CANNED}, plain=True)
+        assert "not armed" in frame and "incidents: none" in frame
+
+    def test_sparkline_and_bar(self):
+        assert dashboard.sparkline([]) == ""
+        assert len(dashboard.sparkline(list(range(100)), width=10)) == 10
+        assert dashboard.bar(0.0, width=4) == "····"
+        assert dashboard.bar(1.5, width=4) == "████"
+
+    def test_gather_dir_falls_back_to_embedded_incidents(self, tmp_path):
+        (tmp_path / "SLO_REPORT.json").write_text(json.dumps(
+            {"enabled": True,
+             "incidents": {"incidents": [{"id": 0}]}}))
+        reports = dashboard.gather(str(tmp_path), is_url=False)
+        assert reports["incidents"] == {"incidents": [{"id": 0}]}

@@ -1,0 +1,413 @@
+"""SLO burn-rate monitor (telemetry/slo.py).
+
+All burn math runs against an INJECTED integer-µs clock (the ``now_us``
+ctor hook) — no sleeps, no wall-clock flake. Pins: objective
+validation, the cumulative-window delta math (span re-add, anchor
+selection, MIN_SPAN_FRAC eligibility), the two-window AND that
+separates a warning from a page, edge-triggered escalation (a sustained
+burn pages once; it re-fires only after recovery), both metric
+surfaces, the goodput objective over a ledger, the guardian admission
+pause on ``slo_burn_page``, the chronicle emit, and snapshot/teardown
+discipline.
+"""
+
+import json
+
+import pytest
+
+from deepspeed_tpu.runtime.guardian import Guardian
+from deepspeed_tpu.telemetry import chronicle as chron_mod
+from deepspeed_tpu.telemetry.metrics import MetricsRegistry
+from deepspeed_tpu.telemetry.slo import (MIN_SPAN_FRAC, RULE_FAST,
+                                         RULE_PAGE, SLO_SCHEMA,
+                                         SloMonitor, normalize_objective,
+                                         render)
+
+
+class Clock:
+    """Injectable monotonic-µs clock."""
+
+    def __init__(self, start_us=10_000_000):
+        self.us = start_us
+
+    def __call__(self):
+        return self.us
+
+    def advance(self, seconds):
+        self.us += int(seconds * 1e6)
+
+
+TTFT = {"name": "ttft", "kind": "latency", "metric": "ttft_ms",
+        "threshold_ms": 100.0, "target": 0.9}       # budget = 0.1
+
+
+def _latency_monitor(clock, registry, fast=10.0, slow=60.0, **kw):
+    return SloMonitor(objectives=[dict(TTFT)], fast_window_s=fast,
+                      slow_window_s=slow, eval_interval_s=1.0,
+                      registry=registry, now_us=clock, **kw)
+
+
+def _run(mon, clock, hist, latencies, ticks, step0=0):
+    """*ticks* evaluations, observing *latencies* then advancing 1s
+    before each."""
+    for i in range(ticks):
+        for v in latencies:
+            hist.observe(v)
+        clock.advance(1.0)
+        mon.tick(step=step0 + i, force=True)
+
+
+class TestNormalizeObjective:
+    @pytest.mark.parametrize("obj, match", [
+        ("nope", "must be a dict"),
+        ({"kind": "latency"}, "non-empty string 'name'"),
+        ({"name": "x", "kind": "availability"}, "kind must be"),
+        ({"name": "x", "kind": "goodput", "target": 1.0}, "target"),
+        ({"name": "x", "kind": "goodput", "target": 0}, "target"),
+        ({"name": "x", "kind": "latency", "target": 0.9},
+         "'metric' histogram family"),
+        ({"name": "x", "kind": "latency", "target": 0.9,
+          "metric": "m", "threshold_ms": 0}, "threshold_ms"),
+    ])
+    def test_rejects_with_the_field_named(self, obj, match):
+        with pytest.raises(ValueError, match=match):
+            normalize_objective(obj)
+
+    def test_normalizes_to_floats(self):
+        out = normalize_objective({"name": "x", "kind": "latency",
+                                   "metric": "m", "threshold_ms": 100,
+                                   "target": 0.9})
+        assert isinstance(out["target"], float)
+        assert isinstance(out["threshold_ms"], float)
+        # a copy, not the caller's dict
+        src = dict(TTFT)
+        assert normalize_objective(src) is not src
+
+    def test_add_objective_replaces_duplicates(self):
+        mon = SloMonitor(objectives=[dict(TTFT)])
+        mon.add_objective(dict(TTFT, threshold_ms=250.0))
+        assert len(mon.objectives) == 1
+        assert mon.objectives[0]["threshold_ms"] == 250.0
+
+
+class TestBurnMath:
+    def test_eligibility_needs_half_the_window_spanned(self):
+        """Two seconds into a run, one bad request is not a one-hour
+        trend — MIN_SPAN_FRAC gates burning."""
+        clock, reg = Clock(), MetricsRegistry()
+        mon = _latency_monitor(clock, reg, fast=10.0, slow=60.0)
+        hist = reg.histogram("ttft_ms", "t")
+        # all-bad traffic, but only 4s of span (5 samples, 1s apart):
+        # 4 < 0.5 * 10
+        _run(mon, clock, hist, [900.0], ticks=5)
+        w = mon.report()["objectives"]["ttft"]["windows"]
+        assert w["fast"]["eligible"] is False
+        assert w["fast"]["burning"] is False
+        assert mon.report()["objectives"]["ttft"]["tier"] == "ok"
+        # one more second crosses the MIN_SPAN_FRAC line
+        _run(mon, clock, hist, [900.0], ticks=1)
+        w = mon.report()["objectives"]["ttft"]["windows"]
+        assert w["fast"]["span_us"] == int(
+            MIN_SPAN_FRAC * w["fast"]["window_us"])
+        assert w["fast"]["eligible"] is True and w["fast"]["burning"]
+
+    def test_healthy_burn_is_zero_and_spans_readd(self):
+        clock, reg = Clock(), MetricsRegistry()
+        mon = _latency_monitor(clock, reg)
+        hist = reg.histogram("ttft_ms", "t")
+        _run(mon, clock, hist, [40.0], ticks=35)
+        obj = mon.report()["objectives"]["ttft"]
+        assert obj["active"] is True and obj["tier"] == "ok"
+        for w in obj["windows"].values():
+            assert w["eligible"] is True
+            assert w["burn"] == 0.0 and w["burning"] is False
+            # THE axis invariant: the window delta re-adds exactly
+            assert w["span_us"] == w["t_newest_us"] - w["t_anchor_us"]
+        # fast anchor sits exactly at the window start; slow is anchored
+        # at the oldest sample — 35 samples 1s apart span 34s, short of
+        # the 60s window
+        assert obj["windows"]["fast"]["span_us"] == 10_000_000
+        assert obj["windows"]["slow"]["span_us"] == 34_000_000
+        assert mon.rule_counts == {} and mon.anomalies == []
+
+    def test_burn_value_is_bad_frac_over_budget(self):
+        clock, reg = Clock(), MetricsRegistry()
+        mon = _latency_monitor(clock, reg, fast=10.0, slow=60.0)
+        hist = reg.histogram("ttft_ms", "t")
+        _run(mon, clock, hist, [40.0], ticks=30)
+        # one bad + nine good per second for the whole fast window:
+        # bad_frac 0.1 against a 0.1 budget -> burn exactly 1.0x
+        _run(mon, clock, hist, [900.0] + [40.0] * 9, ticks=10, step0=30)
+        w = mon.report()["objectives"]["ttft"]["windows"]["fast"]
+        assert w["delta_bad"] == 10 and w["delta_total"] == 100
+        assert w["bad_frac"] == pytest.approx(0.1)
+        assert w["burn"] == pytest.approx(1.0)
+        assert w["burning"] is True        # threshold is >=, not >
+
+    def test_fast_only_is_a_warning_not_a_page(self):
+        """Slow window not yet eligible: the onset warns (slo_burn_fast)
+        — the two-window AND keeps a blip from paging anyone."""
+        clock, reg = Clock(), MetricsRegistry()
+        mon = _latency_monitor(clock, reg, fast=10.0, slow=60.0)
+        hist = reg.histogram("ttft_ms", "t")
+        _run(mon, clock, hist, [900.0], ticks=8)
+        obj = mon.report()["objectives"]["ttft"]
+        assert obj["windows"]["fast"]["burning"] is True
+        assert obj["windows"]["slow"]["eligible"] is False
+        assert obj["tier"] == "fast"
+        assert obj["warns"] == 1 and obj["pages"] == 0
+        assert mon.rule_counts == {RULE_FAST: 1}
+        [a] = mon.anomalies
+        assert a["rule"] == RULE_FAST and a["severity"] == "warning"
+        assert "'ttft'" in a["detail"]
+
+    def test_both_windows_page_once_then_refire_after_recovery(self):
+        clock, reg = Clock(), MetricsRegistry()
+        mon = _latency_monitor(clock, reg, fast=10.0, slow=60.0)
+        hist = reg.histogram("ttft_ms", "t")
+        _run(mon, clock, hist, [40.0] * 5, ticks=35)            # healthy
+        _run(mon, clock, hist, [900.0] * 10, ticks=10, step0=35)
+        obj = mon.report()["objectives"]["ttft"]
+        assert obj["tier"] == "page"
+        assert obj["windows"]["fast"]["burning"]
+        assert obj["windows"]["slow"]["burning"]
+        assert obj["pages"] == 1
+        page = [a for a in mon.anomalies if a["rule"] == RULE_PAGE]
+        assert len(page) == 1 and page[0]["severity"] == "critical"
+        assert page[0]["objective"] == "ttft"
+        assert page[0]["burn_fast"] >= 1.0
+        assert page[0]["burn_slow"] >= 1.0
+        # edge-triggered: the burn sustains, the page does NOT re-fire
+        _run(mon, clock, hist, [900.0] * 10, ticks=5, step0=45)
+        assert mon.rule_counts[RULE_PAGE] == 1
+        assert mon.report()["objectives"]["ttft"]["pages"] == 1
+        # recovery: all-good traffic drains the fast window
+        _run(mon, clock, hist, [40.0] * 10, ticks=15, step0=50)
+        assert mon.report()["objectives"]["ttft"]["tier"] == "ok"
+        # a SECOND degradation is a new edge -> pages again
+        _run(mon, clock, hist, [900.0] * 10, ticks=12, step0=65)
+        assert mon.rule_counts[RULE_PAGE] == 2
+        assert mon.report()["objectives"]["ttft"]["pages"] == 2
+
+    def test_metric_surfaces(self):
+        clock, reg = Clock(), MetricsRegistry()
+        mon = _latency_monitor(clock, reg, fast=10.0, slow=60.0)
+        hist = reg.histogram("ttft_ms", "t")
+        _run(mon, clock, hist, [40.0] * 5, ticks=35)
+        _run(mon, clock, hist, [900.0] * 10, ticks=10, step0=35)
+        snap = reg.snapshot()
+        gauges = {tuple(sorted(r["labels"].items())): r["value"]
+                  for r in snap["slo_burn_rate"]}
+        assert gauges[(("objective", "ttft"), ("window", "fast"))] >= 1.0
+        assert gauges[(("objective", "ttft"), ("window", "slow"))] >= 1.0
+        burns = {r["labels"]["window"]: r["value"]
+                 for r in snap["slo_burn_total"]}
+        assert burns["fast"] >= 1 and burns["slow"] >= 1
+        anoms = {r["labels"]["rule"]: r["value"]
+                 for r in snap["slo_anomalies_total"]}
+        # the onset warned (slow not yet burning), then paged
+        assert anoms == {RULE_FAST: 1, RULE_PAGE: 1}
+
+    def test_effective_threshold_snaps_to_a_bucket_edge(self):
+        """A 300ms ask against the default bucket grid is really a 500ms
+        SLO — the snap is computed AND reported, never silent."""
+        clock, reg = Clock(), MetricsRegistry()
+        mon = SloMonitor(
+            objectives=[dict(TTFT, threshold_ms=300.0)],
+            fast_window_s=10.0, slow_window_s=60.0, eval_interval_s=1.0,
+            registry=reg, now_us=clock)
+        hist = reg.histogram("ttft_ms", "t")
+        _run(mon, clock, hist, [400.0], ticks=8)
+        obj = mon.report()["objectives"]["ttft"]
+        assert obj["effective_threshold_ms"] == 500.0
+        # 400ms sits under the EFFECTIVE threshold: good, no burn
+        assert obj["windows"]["fast"]["delta_bad"] == 0
+
+    def test_unarmed_source_reports_inactive(self):
+        clock = Clock()
+        mon = _latency_monitor(clock, MetricsRegistry())  # no histogram
+        clock.advance(1.0)
+        mon.tick(step=1, force=True)
+        obj = mon.report()["objectives"]["ttft"]
+        assert obj == {"kind": "latency", "target": 0.9,
+                       "error_budget": pytest.approx(0.1),
+                       "metric": "ttft_ms", "threshold_ms": 100.0,
+                       "tier": "ok", "active": False}
+        assert mon.evals == 1
+
+    def test_throttled_to_eval_interval(self):
+        clock, reg = Clock(), MetricsRegistry()
+        mon = SloMonitor(objectives=[dict(TTFT)], eval_interval_s=10.0,
+                         registry=reg, now_us=clock)
+        for _ in range(100):
+            clock.advance(0.5)
+            mon.tick(step=1)          # unforced: self-throttles
+        assert mon.evals == 5
+
+
+class _FakeLedger:
+    enabled = True
+
+    def __init__(self):
+        self.elapsed_s = 0.0
+        self.good_s = 0.0
+
+    def elapsed(self):
+        return self.elapsed_s
+
+    def totals(self):
+        return {"device_compute": self.good_s}
+
+
+class TestGoodputObjective:
+    def test_bad_is_elapsed_minus_good_categories(self):
+        clock, led = Clock(), _FakeLedger()
+        mon = SloMonitor(
+            objectives=[{"name": "goodput", "kind": "goodput",
+                         "target": 0.9}],
+            fast_window_s=100.0, slow_window_s=200.0,
+            eval_interval_s=1.0, ledger=led, now_us=clock)
+        mon.tick(step=0, force=True)          # (0, 0): anchors the axis
+        clock.advance(60.0)
+        led.elapsed_s, led.good_s = 100.0, 95.0
+        mon.tick(step=1, force=True)
+        w = mon.report()["objectives"]["goodput"]["windows"]
+        # 5s badput over 100s: bad_frac 0.05 / budget 0.1 -> 0.5x
+        assert w["fast"]["eligible"] and w["fast"]["burn"] == \
+            pytest.approx(0.5)
+        assert w["slow"]["eligible"] is False         # 60s < 100s span
+        clock.advance(60.0)
+        led.elapsed_s, led.good_s = 200.0, 100.0      # badput hour
+        mon.tick(step=2, force=True)
+        obj = mon.report()["objectives"]["goodput"]
+        # window delta: 95s bad of 100s elapsed -> 9.5x -- page on both
+        for w in obj["windows"].values():
+            assert w["burn"] == pytest.approx(100 / 200 / 0.1) or \
+                w["burn"] == pytest.approx(95 / 100 / 0.1)
+        assert obj["tier"] == "page"
+        assert obj["totals"] == {"bad": 100.0, "total": 200.0}
+        assert mon.rule_counts == {RULE_PAGE: 1}
+
+    def test_disabled_ledger_is_inactive(self):
+        led = _FakeLedger()
+        led.enabled = False
+        mon = SloMonitor(objectives=[{"name": "g", "kind": "goodput",
+                                      "target": 0.9}], ledger=led,
+                         now_us=Clock())
+        mon.tick(step=1, force=True)
+        assert mon.report()["objectives"]["g"]["active"] is False
+
+
+class TestEscalationPlumbing:
+    def test_page_pauses_admission_and_lands_in_the_chronicle(
+            self, tmp_path):
+        """The closed loop: burn -> page anomaly -> chronicle event ->
+        guardian hook -> serving_tick drains -> admission pause."""
+        clock, reg = Clock(), MetricsRegistry()
+        chron = chron_mod.RunChronicle(run_dir=str(tmp_path / "chron"),
+                                       rank=0, background=False)
+        old = chron_mod.set_chronicle(chron)
+        guardian = Guardian(journal_path=None, action_cooldown_steps=1,
+                            registry=reg)
+        pauses = []
+        guardian.pause_fn = pauses.append
+        try:
+            mon = _latency_monitor(
+                clock, reg, fast=10.0, slow=60.0,
+                snapshot_path=str(tmp_path / "SLO_REPORT.json"),
+                on_anomaly=guardian.hook("slo"))
+            hist = reg.histogram("ttft_ms", "t")
+            _run(mon, clock, hist, [40.0] * 5, ticks=35)
+            assert not guardian.admission_paused
+            step = 35
+            while not guardian.admission_paused and step < 60:
+                for _ in range(10):
+                    hist.observe(900.0)
+                clock.advance(1.0)
+                mon.tick(step=step, force=True)
+                guardian.serving_tick(step)
+                step += 1
+            assert guardian.admission_paused
+            assert RULE_PAGE in guardian.rules_seen
+            assert [str(r) for r in pauses] == [RULE_PAGE]
+            events = [e for e in chron.snapshot_events()
+                      if e["kind"] == "anomaly" and e["source"] == "slo"]
+            # warn on the onset, page when the slow window joins
+            assert [e["rule"] for e in events] == [RULE_FAST, RULE_PAGE]
+            page_ev = events[-1]
+            assert page_ev["severity"] == "critical"
+            assert "'ttft'" in page_ev["detail"]
+            # first firing forced the snapshot to disk
+            doc = json.loads(
+                (tmp_path / "SLO_REPORT.json").read_text())
+            assert doc["schema"] == SLO_SCHEMA
+            assert doc["rule_counts"] == {RULE_FAST: 1, RULE_PAGE: 1}
+        finally:
+            chron_mod.set_chronicle(old)
+            chron.close()
+
+    def test_throwing_hook_never_kills_the_tick(self):
+        clock, reg = Clock(), MetricsRegistry()
+        mon = _latency_monitor(
+            clock, reg, fast=10.0, slow=60.0,
+            on_anomaly=lambda anoms: 1 / 0,
+            on_escalate=lambda: (_ for _ in ()).throw(RuntimeError()))
+        hist = reg.histogram("ttft_ms", "t")
+        _run(mon, clock, hist, [900.0], ticks=8)     # fires slo_burn_fast
+        assert mon.rule_counts == {RULE_FAST: 1}     # tick survived
+
+
+class TestSnapshotAndTeardown:
+    def _paged(self, tmp_path, snapshot=None):
+        clock, reg = Clock(), MetricsRegistry()
+        mon = _latency_monitor(clock, reg, fast=10.0, slow=60.0,
+                               snapshot_path=snapshot)
+        hist = reg.histogram("ttft_ms", "t")
+        _run(mon, clock, hist, [40.0] * 5, ticks=35)
+        _run(mon, clock, hist, [900.0] * 10, ticks=10, step0=35)
+        return mon
+
+    def test_snapshot_strict_json_and_throttled(self, tmp_path):
+        path = tmp_path / "SLO_REPORT.json"
+        mon = self._paged(tmp_path, snapshot=str(path))
+        doc = json.loads(path.read_text(), parse_constant=lambda t:
+                         pytest.fail(f"bare {t!r} in snapshot"))
+        assert doc["schema"] == SLO_SCHEMA
+        assert doc["params"]["min_span_frac"] == MIN_SPAN_FRAC
+        assert doc["objectives"]["ttft"]["tier"] == "page"
+        # throttled: the escalation write just happened; unforced ->
+        # skipped, forced -> writes
+        assert mon.write_snapshot() is None
+        assert mon.write_snapshot(force=True) == str(path)
+
+    def test_close_writes_final_snapshot_and_report_survives(
+            self, tmp_path):
+        path = tmp_path / "SLO_REPORT.json"
+        mon = self._paged(tmp_path, snapshot=str(path))
+        evals = mon.evals
+        path.unlink()
+        mon.close()
+        assert path.exists()       # something to explain -> final write
+        mon.close()                # idempotent
+        mon.tick(step=99, force=True)
+        assert mon.evals == evals  # closed tick is a no-op
+        doc = mon.report()
+        assert doc["closed"] is True and doc["evals"] == evals
+        assert render(doc).startswith("slo:")
+
+    def test_quiet_close_writes_nothing(self, tmp_path):
+        path = tmp_path / "quiet.json"
+        clock, reg = Clock(), MetricsRegistry()
+        mon = _latency_monitor(clock, reg, snapshot_path=str(path))
+        hist = reg.histogram("ttft_ms", "t")
+        _run(mon, clock, hist, [40.0], ticks=5)
+        mon.close()
+        assert not path.exists()   # healthy run: no artifact litter
+
+    def test_disabled_monitor_is_a_stub(self):
+        mon = SloMonitor(enabled=False)
+        mon.tick(step=1, force=True)
+        assert mon.report() == {"schema": SLO_SCHEMA, "enabled": False}
+        assert mon.write_snapshot(force=True) is None
+        assert mon.last_eval_age_s() is None
+        mon.close()
