@@ -139,6 +139,16 @@ def telemetry_report():
     except Exception:
         row("mission control (obs server + SLO)", False)
     try:
+        from deepspeed_tpu.telemetry.federation import FleetAggregator
+        del FleetAggregator
+        row("fleet federation (cross-process)", True,
+            "(telemetry.federation block; DS_TELEMETRY_FEDERATION=1; "
+            "peer registry + aggregator scrape -> /federation/metrics, "
+            "/api/fleet/events, fleet SLO burn + cross-rank incidents "
+            "-> FLEET_CONTROL.json)")
+    except Exception:
+        row("fleet federation (cross-process)", False)
+    try:
         from deepspeed_tpu.telemetry.ledger import profiler_available
         row("jax.profiler programmatic capture", profiler_available(),
             "(goodput on-anomaly start_trace/stop_trace)")

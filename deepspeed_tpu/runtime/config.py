@@ -326,6 +326,35 @@ class DeepSpeedTelemetryConfig(DeepSpeedConfigObject):
             C.SLO_E2E_THRESHOLD_MS, C.SLO_E2E_THRESHOLD_MS_DEFAULT))
         self.slo_snapshot_file = sl.get(C.SLO_SNAPSHOT_FILE,
                                         C.SLO_SNAPSHOT_FILE_DEFAULT)
+        # federation sub-block (telemetry/federation.py): cross-process
+        # mission control — peer-scraping aggregator, merged fleet
+        # timeline, fleet-level SLO burn. Flattened onto federation_*.
+        fed = t.get(C.TELEMETRY_FEDERATION, {}) or {}
+        self.federation_enabled = fed.get(C.FEDERATION_ENABLED,
+                                          C.FEDERATION_ENABLED_DEFAULT)
+        self.federation_peers = tuple(fed.get(C.FEDERATION_PEERS)
+                                      or C.FEDERATION_PEERS_DEFAULT)
+        self.federation_run_dir = fed.get(C.FEDERATION_RUN_DIR,
+                                          C.FEDERATION_RUN_DIR_DEFAULT)
+        self.federation_aggregator = str(fed.get(
+            C.FEDERATION_AGGREGATOR, C.FEDERATION_AGGREGATOR_DEFAULT))
+        self.federation_scrape_interval_s = float(fed.get(
+            C.FEDERATION_SCRAPE_INTERVAL_S,
+            C.FEDERATION_SCRAPE_INTERVAL_S_DEFAULT))
+        self.federation_timeout_s = float(fed.get(
+            C.FEDERATION_TIMEOUT_S, C.FEDERATION_TIMEOUT_S_DEFAULT))
+        self.federation_stale_after_s = float(fed.get(
+            C.FEDERATION_STALE_AFTER_S,
+            C.FEDERATION_STALE_AFTER_S_DEFAULT))
+        self.federation_events_ring = int(fed.get(
+            C.FEDERATION_EVENTS_RING, C.FEDERATION_EVENTS_RING_DEFAULT))
+        self.federation_snapshot_file = fed.get(
+            C.FEDERATION_SNAPSHOT_FILE, C.FEDERATION_SNAPSHOT_FILE_DEFAULT)
+        self.federation_goodput_target = float(fed.get(
+            C.FEDERATION_GOODPUT_TARGET,
+            C.FEDERATION_GOODPUT_TARGET_DEFAULT))
+        self.federation_ttft_target = float(fed.get(
+            C.FEDERATION_TTFT_TARGET, C.FEDERATION_TTFT_TARGET_DEFAULT))
         env = os.environ.get("DS_TELEMETRY")
         if env is not None:
             self.enabled = env.lower() in ("1", "true", "yes", "on")
@@ -373,6 +402,20 @@ class DeepSpeedTelemetryConfig(DeepSpeedConfigObject):
         if env_sl is not None:
             self.slo_enabled = env_sl.lower() in ("1", "true", "yes",
                                                   "on")
+        env_fe = os.environ.get("DS_TELEMETRY_FEDERATION")
+        if env_fe is not None:
+            self.federation_enabled = env_fe.lower() in ("1", "true",
+                                                         "yes", "on")
+        env_frd = os.environ.get("DS_TELEMETRY_FEDERATION_RUN_DIR")
+        if env_frd:
+            self.federation_run_dir = env_frd
+        env_fp = os.environ.get("DS_TELEMETRY_FEDERATION_PEERS")
+        if env_fp:
+            self.federation_peers = tuple(
+                p.strip() for p in env_fp.split(",") if p.strip())
+        env_fa = os.environ.get("DS_TELEMETRY_FEDERATION_AGGREGATOR")
+        if env_fa:
+            self.federation_aggregator = env_fa
         if self.anatomy_capture_steps < 1:
             raise DeepSpeedConfigError(
                 f"telemetry.anatomy.capture_steps must be >= 1, got "
@@ -490,6 +533,34 @@ class DeepSpeedTelemetryConfig(DeepSpeedConfigObject):
             except ValueError as e:
                 raise DeepSpeedConfigError(
                     f"telemetry.slo.objectives: {e}")
+        if self.federation_aggregator not in ("auto", "always", "never"):
+            raise DeepSpeedConfigError(
+                f"telemetry.federation.aggregator must be one of "
+                f"auto/always/never, got {self.federation_aggregator!r}")
+        for fname, fval in (
+                ("scrape_interval_s", self.federation_scrape_interval_s),
+                ("timeout_s", self.federation_timeout_s),
+                ("stale_after_s", self.federation_stale_after_s)):
+            if fval <= 0:
+                raise DeepSpeedConfigError(
+                    f"telemetry.federation.{fname} must be > 0, got "
+                    f"{fval}")
+        if self.federation_events_ring < 16:
+            raise DeepSpeedConfigError(
+                f"telemetry.federation.events_ring must be >= 16, got "
+                f"{self.federation_events_ring}")
+        for tname, target in (
+                ("goodput_target", self.federation_goodput_target),
+                ("ttft_target", self.federation_ttft_target)):
+            if not 0.0 < target < 1.0:
+                raise DeepSpeedConfigError(
+                    f"telemetry.federation.{tname} must be in (0, 1), "
+                    f"got {target}")
+        for p in self.federation_peers:
+            if not isinstance(p, str) or not p.startswith("http"):
+                raise DeepSpeedConfigError(
+                    f"telemetry.federation.peers entries must be http "
+                    f"base urls, got {p!r}")
 
 
 class DeepSpeedDataPrefetchConfig(DeepSpeedConfigObject):

@@ -378,6 +378,44 @@ SLO_E2E_THRESHOLD_MS_DEFAULT = 5000.0
 SLO_SNAPSHOT_FILE = "snapshot_file"         # "" -> <output_path>/SLO_REPORT.json
 SLO_SNAPSHOT_FILE_DEFAULT = ""
 
+# telemetry.federation: cross-process mission control (telemetry/
+# federation.py) — a FleetAggregator on the aggregator rank discovers
+# peers (static `peers` URL list + the run-dir registry every rank's
+# ObsServer announces into), scrapes each peer's /metrics, reports and
+# resumable /api/events over keep-alive HTTP with per-peer timeouts
+# (a hanging peer degrades to `stale`, never blocks the loop), and
+# serves merged views from its own ObsServer: /federation/metrics
+# (every family rank-labelled), /federation/status, /api/fleet/events
+# (one (t_us, seq, rank)-ordered timeline), /api/fleet/report/<name>.
+# Fleet-scope SLO burn + cross-rank incident correlation ride the
+# merged stream into FLEET_CONTROL.json. DS_TELEMETRY_FEDERATION=1/0
+# force-toggles `enabled`; DS_TELEMETRY_FEDERATION_RUN_DIR,
+# DS_TELEMETRY_FEDERATION_PEERS (comma list) and
+# DS_TELEMETRY_FEDERATION_AGGREGATOR override their keys.
+TELEMETRY_FEDERATION = "federation"
+FEDERATION_ENABLED = "enabled"
+FEDERATION_ENABLED_DEFAULT = False
+FEDERATION_PEERS = "peers"                  # static peer base-url list
+FEDERATION_PEERS_DEFAULT = ()
+FEDERATION_RUN_DIR = "run_dir"              # peer-registry dir ("" -> chronicle run_dir)
+FEDERATION_RUN_DIR_DEFAULT = ""
+FEDERATION_AGGREGATOR = "aggregator"        # auto (rank 0) / always / never
+FEDERATION_AGGREGATOR_DEFAULT = "auto"
+FEDERATION_SCRAPE_INTERVAL_S = "scrape_interval_s"
+FEDERATION_SCRAPE_INTERVAL_S_DEFAULT = 2.0
+FEDERATION_TIMEOUT_S = "timeout_s"          # per-request peer timeout
+FEDERATION_TIMEOUT_S_DEFAULT = 2.0
+FEDERATION_STALE_AFTER_S = "stale_after_s"  # last-seen age that marks a peer stale
+FEDERATION_STALE_AFTER_S_DEFAULT = 10.0
+FEDERATION_EVENTS_RING = "events_ring"      # merged per-peer event buffer
+FEDERATION_EVENTS_RING_DEFAULT = 4096
+FEDERATION_SNAPSHOT_FILE = "snapshot_file"  # "" -> <output_path>/FLEET_CONTROL.json
+FEDERATION_SNAPSHOT_FILE_DEFAULT = ""
+FEDERATION_GOODPUT_TARGET = "goodput_target"   # fleet_goodput objective target
+FEDERATION_GOODPUT_TARGET_DEFAULT = 0.90
+FEDERATION_TTFT_TARGET = "ttft_target"         # fleet_ttft objective target
+FEDERATION_TTFT_TARGET_DEFAULT = 0.99
+
 # Checkpoint
 CHECKPOINT = "checkpoint"
 CHECKPOINT_TAG_VALIDATION = "tag_validation"

@@ -660,7 +660,13 @@ class DeepSpeedEngine:
             from deepspeed_tpu.telemetry import incidents as _inc_mod
             from deepspeed_tpu.telemetry import obs_server as _obs_mod
             srv = _obs_mod.ObsServer.from_config(
-                tcfg, registry=self.telemetry.registry)
+                tcfg, registry=self.telemetry.registry,
+                # rank identity rides every /metrics sample as a const
+                # label so a federation aggregator's merged view stays
+                # attributable without rewriting scraped text
+                identity=({"rank": str(dist.get_rank())}
+                          if bool(getattr(tcfg, "federation_enabled",
+                                          False)) else None))
             if self.telemetry.health is not None:
                 srv.register("health", self.telemetry.health.report)
             if self._goodput is not None:
@@ -675,7 +681,8 @@ class DeepSpeedEngine:
             if self._memory is not None:
                 srv.register("memory", self._memory.report)
             if self._fleet_monitor is not None:
-                srv.register("fleet", self._fleet_monitor.report)
+                srv.register("fleet", self._fleet_monitor.report,
+                             age_s_fn=self._fleet_monitor.last_poll_age_s)
             if self._guardian is not None:
                 srv.register("guardian", self._guardian.report)
             if self._chronicle is not None:
@@ -698,6 +705,48 @@ class DeepSpeedEngine:
             _obs_mod.set_obs_server(srv)
             log_dist(f"telemetry: obs server live at {srv.url} "
                      f"({len(srv.providers())} provider(s))", ranks=[0])
+
+        # ---- fleet federation (telemetry/federation.py) -------------------
+        # Cross-process mission control. EVERY rank with a live plane
+        # announces its endpoint into the run-dir peer registry; the
+        # aggregator rank (policy: auto -> rank 0) additionally scrapes
+        # the whole fleet and serves the merged views off its own obs
+        # server (/federation/*, /api/fleet/*). Scraping is host-side
+        # HTTP only — zero device work, zero extra compiles on any rank.
+        self._fleet_aggregator = None
+        if (self._obs_server is not None
+                and bool(getattr(tcfg, "federation_enabled", False))):
+            fed_run_dir = getattr(tcfg, "federation_run_dir", "") or (
+                self._chronicle.run_dir if self._chronicle is not None
+                else os.path.join(tcfg.output_path or "telemetry/",
+                                  "chronicle"))
+            self._obs_server.announce(
+                fed_run_dir, rank=dist.get_rank(),
+                job_name=tcfg.job_name or "")
+            policy = str(getattr(tcfg, "federation_aggregator", "auto"))
+            arm_agg = (policy == "always"
+                       or (policy == "auto" and dist.get_rank() == 0))
+            if arm_agg:
+                from deepspeed_tpu.telemetry import federation as _fed_mod
+                try:
+                    self._fleet_aggregator = \
+                        _fed_mod.FleetAggregator.from_config(
+                            tcfg,
+                            output_path=tcfg.output_path or "telemetry/",
+                            run_dir=fed_run_dir,
+                            job_name=tcfg.job_name or "")
+                    self._fleet_aggregator.attach(self._obs_server)
+                    log_dist(
+                        "telemetry: fleet aggregator armed "
+                        f"(run_dir={fed_run_dir}, "
+                        f"{len(self._fleet_aggregator.peers())} peer(s) "
+                        "at start)", ranks=[0])
+                except Exception as e:
+                    # federation is an observer of the fleet, never a
+                    # reason a rank fails to come up
+                    logger.warning(
+                        "[federation] aggregator arming failed: %s", e)
+                    self._fleet_aggregator = None
 
         # ---- parameters / state init --------------------------------------
         with self.telemetry.span("engine/init_state"):
@@ -3499,6 +3548,14 @@ class DeepSpeedEngine:
                 with self._led_attr("checkpoint_save"):
                     self._ckpt_writer.close()
         finally:
+            if self._fleet_aggregator is not None:
+                try:
+                    # before the obs server: the aggregator's routes are
+                    # mounted on it, and close() persists cursors + the
+                    # final fleet snapshot while peers are still known
+                    self._fleet_aggregator.close()
+                except Exception as e:
+                    logger.warning("[federation] close failed: %s", e)
             if self._obs_server is not None:
                 from deepspeed_tpu.telemetry import obs_server as _obs_mod
                 try:

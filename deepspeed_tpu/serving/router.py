@@ -103,6 +103,15 @@ class ServingRouter:
         self._next_id += 1
         self._placement[rid] = (decision.replica, local)
         self.routed_by_replica[decision.replica] += 1
+        # chronicle the placement so the (federated) timeline explains
+        # WHY traffic moved, not just that latency followed; one cheap
+        # attribute check when the chronicle is disabled
+        from deepspeed_tpu.telemetry.chronicle import get_chronicle
+        get_chronicle().emit(
+            "serving", "router", request_id=rid,
+            replica=decision.replica,
+            score=round(decision.score, 6),
+            affinity_blocks=decision.affinity_blocks)
         return rid
 
     # --------------------------------------------------------------- loop

@@ -81,7 +81,16 @@ Four pieces (see the per-module docstrings):
   (``python -m deepspeed_tpu.telemetry.slo --demo`` is the CLI). Lazy;
 * ``dashboard`` — the mission-control terminal dashboard over either a
   live ``obs_server`` URL or an artifact dir
-  (``python -m deepspeed_tpu.telemetry.dashboard --url/--dir``). Lazy.
+  (``python -m deepspeed_tpu.telemetry.dashboard --url/--dir``). Lazy;
+* ``federation`` — fleet federation (``telemetry.federation`` block):
+  every rank's obs server announces itself into a run-dir peer
+  registry; the aggregator rank scrapes each peer's /metrics, reports
+  and resumable /api/events over keep-alive HTTP and serves the
+  rank-labelled merged scrape, one (t_us, seq, rank)-ordered fleet
+  timeline, fleet-scope SLO burn with per-rank attribution and
+  cross-rank incident chains under /federation/* and /api/fleet/* ->
+  FLEET_CONTROL.json
+  (``python -m deepspeed_tpu.telemetry.federation --demo``). Lazy.
 
 ``TelemetryManager`` (manager.py) wires them per engine run, behind the
 ``telemetry`` config block (see CONFIG.md). Everything is importable and
@@ -145,7 +154,7 @@ __all__ = [
     "RunChronicle", "get_chronicle", "set_chronicle", "reset_chronicle",
     "IncidentCorrelator", "correlate", "write_incidents",
     "xplane", "step_anatomy", "pprof", "memory_observatory",
-    "obs_server", "slo", "dashboard",
+    "obs_server", "slo", "dashboard", "federation",
 ]
 
 
@@ -153,9 +162,9 @@ def __getattr__(name):
     # lazy submodule access (PEP 562): telemetry.xplane / .step_anatomy /
     # .pprof / .memory_observatory stay un-imported until a capture or a
     # residency window is actually post-processed; obs_server / slo /
-    # dashboard until the mission-control plane is armed
+    # dashboard / federation until the mission-control plane is armed
     if name in ("xplane", "step_anatomy", "pprof", "memory_observatory",
-                "obs_server", "slo", "dashboard"):
+                "obs_server", "slo", "dashboard", "federation"):
         import importlib
         return importlib.import_module(f"deepspeed_tpu.telemetry.{name}")
     raise AttributeError(

@@ -42,9 +42,17 @@ merge point:
   chronicle can never book badput into the ledger it is chronicling.
 
 * The in-memory log is bounded (``max_events``): past the cap NEW events
-  are dropped and counted (``dropped``) — append-only means the
-  committed prefix, with the earliest (causally richest) events, is
-  never rewritten out from under a reader.
+  are dropped from the ring and counted (``dropped``) — append-only
+  means the committed prefix, with the earliest (causally richest)
+  events, is never rewritten out from under a reader. When a stream is
+  armed, overflow events are still APPENDED to the on-disk JSONL
+  (``overflow_shipped`` counts them), so a resumable consumer
+  (:meth:`RunChronicle.events_since`, the obs server's ``/api/events``)
+  never silently loses the tail — the ring bounds memory, not the
+  record. An elastically-resumed rank continues its sequence numbering
+  from the existing stream instead of restarting at 0 (the fleet
+  shipper's window-resume discipline), so a SIGKILL + restart keeps the
+  merged fleet timeline strictly ordered.
 
 * :meth:`RunChronicle.report` -> CHRONICLE.json summary; the
   :class:`deepspeed_tpu.telemetry.incidents.IncidentCorrelator` joins
@@ -122,6 +130,36 @@ def _atomic_write_bytes(path, payload):
     _fsync_dir(os.path.dirname(path))
 
 
+def _append_bytes(path, payload):
+    """Durable append (overflow lines past the ring cap). Not a rename —
+    the committed prefix is already on disk whole; a torn final line is
+    tolerated by every stream reader."""
+    with open(path, "ab") as f:
+        f.write(payload)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def _read_stream(path):
+    """Parse a rank JSONL stream, tolerating a torn final line (an
+    append interrupted by SIGKILL)."""
+    events = []
+    try:
+        with open(path, "rb") as f:
+            raw = f.read()
+    except OSError:
+        return events
+    for line in raw.decode(errors="replace").splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            events.append(json.loads(line))
+        except ValueError:
+            continue            # torn tail — the committed prefix stands
+    return events
+
+
 def _json_sane(obj):
     """Make *obj* strictly-JSON-serialisable: non-finite floats become
     strings (the health.json_safe contract, local copy to keep the
@@ -167,10 +205,13 @@ def _writer_loop(state):
                     state.cond.wait(timeout=0.5)
                 if not state.queue and state.stopped:
                     return
-                path, payload = state.queue.popleft()
+                mode, path, payload = state.queue.popleft()
                 state.busy = True
             try:
-                _atomic_write_bytes(path, payload)
+                if mode == "append":
+                    _append_bytes(path, payload)
+                else:
+                    _atomic_write_bytes(path, payload)
             except Exception as e:   # forensics must never kill a run
                 state.errors += 1
                 if not state.warned:
@@ -202,6 +243,8 @@ class RunChronicle:
         self.rank = int(rank)
         self.job_name = job_name
         self.dropped = 0
+        self.overflow_shipped = 0
+        self.resumed_seq = None
         if not self.enabled:
             return
         self.run_dir = run_dir
@@ -218,6 +261,20 @@ class RunChronicle:
             os.makedirs(run_dir, exist_ok=True)
             self.stream_path = os.path.join(
                 run_dir, _STREAM_FMT.format(self.rank))
+            if os.path.isfile(self.stream_path):
+                # elastic resume: continue the sequence numbering behind
+                # the pre-crash stream (the fleet shipper's window-resume
+                # discipline) — a restarted-at-zero rank would collide
+                # seqs and break the merged fleet timeline's strict
+                # (t_us, seq, rank) order. Prior events reload into the
+                # ring (up to the cap) so rewrites keep the whole record;
+                # past the cap the old file stays the committed prefix
+                # and new events ride the overflow-append path.
+                prior = _read_stream(self.stream_path)
+                if prior:
+                    self.resumed_seq = max(e.get("seq", -1) for e in prior)
+                    self._seq = self.resumed_seq + 1
+                    self.events = prior[:self.max_events]
             if background:
                 self._wstate = _WriterState()
                 self._wthread = threading.Thread(
@@ -238,7 +295,8 @@ class RunChronicle:
             # nobody drains would just dangle)
             return None
         with self._lock:
-            if len(self.events) >= self.max_events:
+            overflow = len(self.events) >= self.max_events
+            if overflow and self.stream_path is None:
                 # append-only: past the cap the committed prefix wins
                 # and NEW events drop (counted — a summary with
                 # dropped>0 says "timeline truncated", never "rewritten")
@@ -258,29 +316,50 @@ class RunChronicle:
                 if v is not None:
                     event[k] = _json_sane(v)
             self._seq += 1
-            self.events.append(event)
-            snapshot = list(self.events) if self.stream_path else None
-        if snapshot is not None:
-            self._ship(snapshot)
+            if overflow:
+                # the ring bounds MEMORY, not the record: the event drops
+                # from the in-memory log (counted) but still APPENDS to
+                # the committed stream, so events_since / the obs
+                # server's disk fallback can serve it to a resumed
+                # consumer. Shipped under the lock so the writer queue
+                # preserves seq order against the ring-fill rewrite.
+                self.dropped += 1
+                self.overflow_shipped += 1
+                self._ship_locked("append", self._payload([event]))
+            else:
+                self.events.append(event)
+                if self.stream_path:
+                    self._ship_locked("rewrite",
+                                      self._payload(self.events))
         return event
 
     def _payload(self, events):
         return ("\n".join(json.dumps(e, sort_keys=True, allow_nan=False)
                           for e in events) + "\n").encode()
 
-    def _ship(self, events):
+    def _ship_locked(self, mode, payload):
+        """Enqueue (or perform) one stream write. Called with ``_lock``
+        held so the writer queue preserves seq order — the ring-fill
+        rewrite always precedes the overflow appends that follow it."""
         if self._wstate is not None:
-            payload = self._payload(events)
             with self._wstate.cond:
-                # coalesce: a newer full-log rewrite supersedes any
-                # queued one — the stream is always written whole
-                self._wstate.queue.clear()
-                self._wstate.queue.append((self.stream_path, payload))
+                if mode == "rewrite":
+                    # coalesce: a newer full-log rewrite supersedes any
+                    # queued one — the stream is always written whole.
+                    # Appends are never discarded (each carries an event
+                    # that lives nowhere else).
+                    self._wstate.queue = deque(
+                        op for op in self._wstate.queue
+                        if op[0] != "rewrite")
+                self._wstate.queue.append((mode, self.stream_path,
+                                           payload))
                 self._wstate.cond.notify_all()
         else:
             try:
-                _atomic_write_bytes(self.stream_path,
-                                    self._payload(events))
+                if mode == "append":
+                    _append_bytes(self.stream_path, payload)
+                else:
+                    _atomic_write_bytes(self.stream_path, payload)
             except OSError as e:
                 self._log("[chronicle] stream write failed: %s", e)
 
@@ -291,6 +370,41 @@ class RunChronicle:
             return []
         with self._lock:
             return list(self.events)
+
+    def events_since(self, since_seq, limit=None):
+        """Events with ``seq > since_seq`` — the resumable-consumer read.
+
+        Serves from the in-memory ring when it still holds the requested
+        range; once events have overflowed past the cap (or a resume
+        preloaded only a prefix), falls back to the on-disk JSONL stream
+        so a consumer that paused across the drop horizon still gets the
+        FULL tail instead of a silent gap plus a ``dropped`` counter.
+        Returned events are seq-ordered; ``limit`` (when set) keeps the
+        NEWEST events, mirroring the obs server's tail semantics."""
+        if not self.enabled:
+            return []
+        since = int(since_seq)
+        with self._lock:
+            ring = list(self.events)
+            # _seq counts every RECORDED event (drop-without-stream never
+            # increments it), so the ring is the whole record iff it
+            # holds _seq events — overflow and resume-truncation both
+            # break that equality.
+            ring_complete = len(ring) == self._seq
+            stream = self.stream_path
+        if not ring_complete and stream:
+            # the ring dropped (or never held) part of the range — the
+            # committed stream is the whole record. Drain first so every
+            # queued append is readable.
+            self.drain(timeout=2.0)
+            disk = _read_stream(stream)
+            if disk:
+                ring = disk
+        out = [e for e in ring if e.get("seq", -1) > since]
+        out.sort(key=lambda e: e.get("seq", 0))
+        if limit is not None and len(out) > int(limit):
+            out = out[-int(limit):]
+        return out
 
     def drain(self, timeout=10.0):
         """Block until every queued stream write is durably on disk."""
@@ -318,6 +432,8 @@ class RunChronicle:
             "run_dir": self.run_dir,
             "n_events": len(events),
             "dropped": self.dropped,
+            "overflow_shipped": self.overflow_shipped,
+            "resumed_seq": self.resumed_seq,
             "counts_by_kind": by_kind,
             "counts_by_source": by_source,
             "first_t_us": events[0]["t_us"] if events else None,
@@ -337,10 +453,13 @@ class RunChronicle:
         if not self.enabled or self._closed:
             return
         self._closed = True
-        if self.stream_path is not None:
+        if self.stream_path is not None and self.overflow_shipped == 0:
+            # belt-and-braces final rewrite — but ONLY while the stream
+            # is ring-shaped: once overflow appends ride behind the last
+            # ring rewrite, a full rewrite of the ring would truncate
+            # them off the committed record.
             with self._lock:
-                events = list(self.events)
-            self._ship(events)
+                self._ship_locked("rewrite", self._payload(self.events))
         self.drain()
         if self._wstate is not None:
             _finalize_writer(self._wstate, self._wthread)

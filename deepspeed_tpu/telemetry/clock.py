@@ -59,3 +59,16 @@ def to_unix_us(t_us):
 def unix_us():
     """Approximate unix µs of *now*, via the same anchor."""
     return to_unix_us(monotonic_us())
+
+
+def from_unix_us(u_us):
+    """Inverse of :func:`to_unix_us`: map a unix-µs stamp back onto THIS
+    process's monotonic axis through the same fixed anchor pair. This is
+    the cross-process rebase the fleet federation merge rides: two
+    ranks' raw ``t_us`` values are NOT comparable (each process's
+    monotonic origin is boot-arbitrary), but every chronicle event also
+    carries its ``unix_us`` rendering — converting that back through the
+    aggregator's anchor puts every peer's events on ONE ordering axis,
+    skewed only by cross-host wall-clock error (NTP-bounded), never by
+    origin mismatch (unbounded)."""
+    return int(u_us) - _ANCHOR_UNIX_US + _ANCHOR_MONO_US

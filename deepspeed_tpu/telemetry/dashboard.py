@@ -12,11 +12,15 @@ ANSI rendering (no curses dependency — works over any dumb ssh tty):
   accumulated across polls;
 * goodput category bars — where the wall-clock went;
 * SLO burn gauges — per objective, fast/slow windows, tier;
+* fleet lanes — one per federated rank (status, staleness, scrape
+  health) + fleet-scope burn, when the federation aggregator is live;
 * last incidents — id, severity, root cause, rules.
 
 Rendering is pure (``render_frame(reports, ...) -> str``) so the unit
-tests drive it with canned reports; the loop just polls, clears, and
-prints. ``--once`` renders a single frame and exits (scriptable)."""
+tests drive it with canned reports. The default renders ONE frame and
+exits (scriptable; ``--once`` kept as an explicit alias); ``--watch``
+auto-refreshes every ``--interval`` seconds and exits cleanly on
+Ctrl-C. ``--plain`` pins the no-ANSI render the tests drive."""
 
 import argparse
 import json
@@ -74,7 +78,8 @@ def fetch_dir(dirpath, name):
     """The artifact-dir counterpart: the committed snapshot files."""
     files = {"goodput": "GOODPUT.json", "slo": "SLO_REPORT.json",
              "serving": "SERVING_HEALTH.json",
-             "incidents": "INCIDENTS.json", "health": "HEALTH.json"}
+             "incidents": "INCIDENTS.json", "health": "HEALTH.json",
+             "federation": "FLEET_CONTROL.json"}
     path = os.path.join(dirpath, files.get(name, f"{name}.json"))
     try:
         with open(path) as f:
@@ -84,7 +89,8 @@ def fetch_dir(dirpath, name):
 
 
 def gather(source, is_url, token=""):
-    names = ("goodput", "slo", "serving", "incidents", "health")
+    names = ("goodput", "slo", "serving", "incidents", "health",
+             "federation")
     if is_url:
         return {n: fetch_url(source, n, token=token) for n in names}
     reports = {n: fetch_dir(source, n) for n in names}
@@ -162,6 +168,36 @@ def _slo_lines(slo, width, plain):
     return lines
 
 
+def _fleet_lines(federation, width, plain):
+    """The fleet view: one lane per rank (status, last-seen age, scrape
+    health) + the fleet-scope burn gauges from the aggregator's merged
+    SLO. Rendered only when a federation report is live — a
+    single-process plane keeps its single-process screen."""
+    if not federation or not federation.get("enabled", True):
+        return []
+    peers = federation.get("peers") or []
+    n_stale = federation.get("n_stale", 0)
+    c = RED if n_stale else GREEN
+    lines = [f"fleet ({len(peers)} peer(s), "
+             f"{_color(str(n_stale), c, plain)} stale, "
+             f"{federation.get('n_merged_events', federation.get('counters', {}).get('events_merged_total', 0))} "
+             f"merged event(s))"]
+    for p in peers:
+        status = p.get("status", "?")
+        sc = {"ok": GREEN, "stale": RED}.get(status, YELLOW)
+        age = p.get("last_seen_age_s")
+        lines.append(
+            f"  r{p.get('rank')!s:<4} {_color(f'{status:<5}', sc, plain)} "
+            f"{p.get('url', ''):<28} "
+            f"seen {'never' if age is None else f'{age:5.1f}s ago'}  "
+            f"{p.get('events_held', 0):>5} ev  "
+            f"{p.get('errors', 0)} err")
+    fleet_slo = federation.get("slo")
+    if fleet_slo:
+        lines += _slo_lines(fleet_slo, width, plain)
+    return lines
+
+
 def _incident_lines(incidents, plain):
     incs = (incidents or {}).get("incidents") or []
     if not incs:
@@ -199,6 +235,10 @@ def render_frame(reports, history=None, width=80, plain=False,
     lines.append("")
     lines += _slo_lines(reports.get("slo"), width, plain)
     lines.append("")
+    fleet = _fleet_lines(reports.get("federation"), width, plain)
+    if fleet:
+        lines += fleet
+        lines.append("")
     lines += _incident_lines(reports.get("incidents"), plain)
     return "\n".join(lines)
 
@@ -216,22 +256,33 @@ def main(argv=None):
     ap.add_argument("--interval", type=float, default=2.0)
     ap.add_argument("--width", type=int, default=100)
     ap.add_argument("--once", action="store_true",
-                    help="render one frame and exit")
+                    help="render one frame and exit (the default; kept "
+                         "for scripts that pinned the flag)")
+    ap.add_argument("--watch", action="store_true",
+                    help="auto-refresh every --interval seconds until "
+                         "Ctrl-C (clean exit, no traceback)")
     ap.add_argument("--plain", action="store_true",
-                    help="no ANSI colors (pipes/tests)")
+                    help="no ANSI colors (pipes/tests); the render pin "
+                         "the frame tests drive")
     args = ap.parse_args(argv)
     source = args.url or args.dir
     history = deque(maxlen=240)
-    while True:
-        reports = gather(source, is_url=bool(args.url),
-                         token=args.token)
-        frame = render_frame(reports, history=history, width=args.width,
-                             plain=args.plain, source=source)
-        if args.once:
-            print(frame)
-            return 0
-        print(CLEAR + frame, flush=True)
-        time.sleep(max(0.2, args.interval))
+    try:
+        while True:
+            reports = gather(source, is_url=bool(args.url),
+                             token=args.token)
+            frame = render_frame(reports, history=history,
+                                 width=args.width, plain=args.plain,
+                                 source=source)
+            if not args.watch:
+                print(frame)
+                return 0
+            print((frame if args.plain else CLEAR + frame), flush=True)
+            time.sleep(max(0.2, args.interval))
+    except KeyboardInterrupt:
+        # a watch session ends at the keyboard; that is not an error
+        print("", flush=True)
+        return 0
 
 
 if __name__ == "__main__":

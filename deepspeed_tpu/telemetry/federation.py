@@ -1,0 +1,1094 @@
+"""Fleet federation — cross-process mission control.
+
+PR 18's observability plane (ObsServer + SLO burn + dashboard) and
+PR 17's causal timeline are strictly single-process; PR 11's
+FleetMonitor merges ranks but only through post-mortem run-dir files.
+ROADMAP item 2's collective self-healing needs the missing quadrant:
+a LIVE merged view of N ranks — one scrape target, one timeline, one
+burn figure — before any rank-0 policy can act on fleet evidence. This
+module is that aggregation-before-action layer:
+
+* **Discovery** — a static ``telemetry.federation.peers`` URL list,
+  plus the run-dir peer registry every rank's ObsServer writes
+  (:meth:`ObsServer.announce`, tmp+fsync+atomic-rename): drop N ranks
+  on one run dir and the aggregator finds them all, surviving restarts
+  that re-bind ports (the registry file is re-announced; the worker
+  reconnects to the new URL).
+
+* **Scraping** — one worker thread per peer over keep-alive HTTP
+  (stdlib ``http.client``) with a per-request timeout: ``/healthz``
+  (provider inventory), ``/metrics`` (exposition text, already stamped
+  with the peer's ``rank`` identity label by
+  :func:`sinks.render_prometheus` ``extra_labels``),
+  ``/api/report/<name>`` for every armed monitor, and the resumable
+  ``/api/events?since_seq=<cursor>``. A dead or HANGING peer times out
+  on its own thread, is marked ``stale`` with its last-seen age, and
+  never blocks another peer's scrape or the merge.
+
+* **Merged views**, mounted on any ObsServer via :meth:`attach`:
+
+  =============================  ====================================
+  ``/federation/metrics``        every peer's families concatenated
+                                 (HELP/TYPE deduped, rank label
+                                 guaranteed) + the aggregator's own
+                                 fleet registry as rank ``fleet``
+  ``/federation/status``         peer inventory + staleness
+  ``/api/fleet/report/<name>``   per-rank report merge (``slo`` /
+                                 ``incidents`` serve the FLEET-level
+                                 documents)
+  ``/api/fleet/events``          ONE strictly ``(t_us, seq, rank)``-
+                                 ordered timeline, ``?cursor=``
+                                 resumable
+  =============================  ====================================
+
+* **One time axis** — raw ``t_us`` stamps are NOT comparable across
+  processes (boot-arbitrary monotonic origins), so every merged event
+  is rebased through its ``unix_us`` rendering onto the aggregator's
+  own monotonic axis (:func:`clock.from_unix_us` — NTP-bounded skew,
+  never origin-unbounded); the peer's original stamp survives as
+  ``src_t_us``. The aggregator's per-peer scrape cursors persist to
+  ``<run_dir>/peers/aggregator_cursors.json``, so an aggregator
+  restart resumes each peer exactly where it left off — the peer's
+  chronicle serves ring-dropped seqs from its on-disk stream.
+
+* **Fleet SLO** — a :class:`slo.SloMonitor` subclass whose samples are
+  the UNION of peer samples: ``fleet_goodput`` re-adds every peer's
+  ledger seconds, ``fleet_ttft`` every peer's TTFT totals. Burn is the
+  fleet's burn; each escalation carries **per-rank attribution** (which
+  peer dominates the window's bad delta) so "the fleet is burning"
+  always arrives with "and rank 2 is why".
+
+* **Cross-rank incidents** — :func:`incidents.correlate` over the
+  merged timeline: a chaos SIGKILL on rank 2 roots the
+  ``step_time_skew`` anomalies every OTHER rank fires, and the root
+  cause names the rank (the correlator's cross-rank join).
+
+``report()`` is the FLEET_CONTROL.json document; the committed
+repo-root artifact comes from ``--demo`` (3 subprocess ranks, one
+injected SIGKILL fault — the chaos-harness self-documenting pattern).
+A scrape of a peer costs that peer ZERO device work: every scraped
+route is host-side by the obs-server contract, pinned by
+tests/perf/telemetry_overhead.py.
+
+CLI: ``python -m deepspeed_tpu.telemetry.federation --demo`` writes
+FLEET_CONTROL.json; ``--simulate-peer N --run-dir D`` runs one
+synthetic rank (a real ObsServer + chronicle; the subprocess harness
+the tests and the demo share); ``--render FLEET_CONTROL.json``
+pretty-prints the fleet view.
+"""
+
+import argparse
+import json
+import os
+import threading
+import weakref
+from collections import deque
+from http.client import HTTPConnection
+from urllib.parse import urlsplit
+
+from deepspeed_tpu.telemetry import chronicle as _chronicle
+from deepspeed_tpu.telemetry import clock as _clk
+from deepspeed_tpu.telemetry import incidents as _incidents
+from deepspeed_tpu.telemetry import slo as _slo
+from deepspeed_tpu.telemetry.ledger import GOOD_CATEGORIES
+from deepspeed_tpu.utils.logging import logger
+
+FLEET_CONTROL_SCHEMA = "deepspeed_tpu.fleet_control/1"
+
+_CURSOR_FILE = "aggregator_cursors.json"
+_PEERS_DIR = "peers"
+_PEER_FMT = "peer_rank_{:05d}.json"
+
+# fleet objective names the _FleetSlo sampler dispatches on
+FLEET_GOODPUT = "fleet_goodput"
+FLEET_TTFT = "fleet_ttft"
+
+# how many catch-up /api/events fetches one scrape pass may chain when
+# the peer reports a truncated tail (bounds a worker's time inside one
+# pass; the next pass continues from the cursor)
+_EVENTS_CATCHUP_FETCHES = 20
+
+
+# ------------------------------------------------------------------ HTTP
+
+def _http_get(peer, path, timeout_s, token=""):
+    """One keep-alive GET against *peer* (a :class:`_Peer`). Returns
+    ``(status, body_bytes)``; raises on transport errors (caller marks
+    the peer). The connection is rebuilt when the peer's URL changed
+    (a restarted rank re-announcing on a new port)."""
+    parts = urlsplit(peer.url)
+    conn = peer.conn
+    if conn is None or peer.conn_netloc != parts.netloc:
+        if conn is not None:
+            try:
+                conn.close()
+            except Exception:
+                pass
+        conn = HTTPConnection(parts.hostname, parts.port or 80,
+                              timeout=timeout_s)
+        peer.conn = conn
+        peer.conn_netloc = parts.netloc
+    headers = {}
+    if token:
+        headers["Authorization"] = f"Bearer {token}"
+    try:
+        conn.request("GET", path, headers=headers)
+        resp = conn.getresponse()
+        body = resp.read()
+        return resp.status, body
+    except Exception:
+        # a broken keep-alive socket poisons every later request on it
+        try:
+            conn.close()
+        except Exception:
+            pass
+        peer.conn = None
+        raise
+
+
+def _http_get_json(peer, path, timeout_s, token=""):
+    status, body = _http_get(peer, path, timeout_s, token)
+    if status != 200:
+        raise RuntimeError(f"GET {path} -> {status}")
+    return json.loads(body)
+
+
+# ------------------------------------------------------------ peer state
+
+class _Peer:
+    """Everything one peer's worker thread maintains. Mutated by the
+    worker, read under the state lock by the merge/report paths."""
+    __slots__ = ("key", "url", "rank", "job_name", "conn", "conn_netloc",
+                 "last_seen_us", "scrapes", "errors", "last_error",
+                 "cursor", "events", "metrics_text", "reports",
+                 "providers", "dropped", "static")
+
+    def __init__(self, key, url, rank=None, job_name="", cursor=-1,
+                 events_ring=4096, static=False):
+        self.key = key
+        self.url = url
+        self.rank = rank
+        self.job_name = job_name
+        self.conn = None
+        self.conn_netloc = None
+        self.last_seen_us = None
+        self.scrapes = 0
+        self.errors = 0
+        self.last_error = None
+        self.cursor = int(cursor)      # last chronicle seq fetched
+        self.events = deque(maxlen=events_ring)
+        self.metrics_text = ""
+        self.reports = {}
+        self.providers = ()
+        self.dropped = 0
+        self.static = static
+
+    def status(self, now_us, stale_after_s):
+        if self.last_seen_us is None:
+            return "never"
+        age = (now_us - self.last_seen_us) / 1e6
+        return "stale" if age > stale_after_s else "ok"
+
+    def last_seen_age_s(self, now_us):
+        if self.last_seen_us is None:
+            return None
+        return round((now_us - self.last_seen_us) / 1e6, 3)
+
+
+class _AggState:
+    """Everything the aggregator's threads may touch — workers and the
+    tick thread hold ONLY this (never the FleetAggregator), the
+    chronicle/obs-server finalize discipline."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.stop = threading.Event()
+        self.peers = {}              # key -> _Peer
+        self.saved_cursors = {}      # key -> persisted resume seq
+        self.threads = []
+        self.scrapes_total = 0
+        self.scrape_errors_total = 0
+        self.events_merged_total = 0
+        self.last_tick_us = None
+        self.started_us = _clk.monotonic_us()
+        # filled by FleetAggregator.__init__ before threads start
+        self.run_dir = None
+        self.peers_dir = None
+        self.cursor_path = None
+        self.static_peers = ()
+        self.token = ""
+        self.timeout_s = 2.0
+        self.scrape_interval_s = 1.0
+        self.stale_after_s = 10.0
+        self.events_ring = 4096
+        self.job_name = ""
+        self.slo = None              # _FleetSlo
+        self.contrib = {}            # objective -> {rank: deque[(t,b,tot)]}
+        self.log = logger.warning
+
+
+# --------------------------------------------------------------- scraping
+
+def _scrape_peer(state, peer):
+    """One full scrape pass against one peer: inventory, metrics,
+    reports, resumable events. Any transport error marks the peer and
+    returns — staleness is judged by last-seen age, and the worker
+    retries next interval."""
+    t = state.timeout_s
+    tok = state.token
+    try:
+        healthz = _http_get_json(peer, "/healthz", t, tok)
+        providers = tuple(sorted((healthz.get("monitors") or {})))
+        _status, metrics_body = _http_get(peer, "/metrics", t, tok)
+        reports = {}
+        for name in providers:
+            reports[name] = _http_get_json(
+                peer, f"/api/report/{name}", t, tok)
+        new_events, dropped = [], 0
+        cursor = peer.cursor
+        for _ in range(_EVENTS_CATCHUP_FETCHES):
+            # oldest=1: gapless pagination from the cursor (the default
+            # tail view would skip the middle of a large backlog)
+            doc = _http_get_json(
+                peer, f"/api/events?since_seq={cursor}&oldest=1", t, tok)
+            if not doc.get("enabled", False):
+                break
+            evs = doc.get("events", [])
+            dropped = int(doc.get("dropped", 0))
+            new_events.extend(evs)
+            cursor = int(doc.get("last_seq", cursor))
+            if not doc.get("truncated"):
+                break
+    except Exception as e:
+        with state.lock:
+            peer.errors += 1
+            peer.last_error = f"{type(e).__name__}: {e}"
+            state.scrape_errors_total += 1
+        return
+    now = _clk.monotonic_us()
+    with state.lock:
+        peer.scrapes += 1
+        peer.last_seen_us = now
+        peer.last_error = None
+        peer.providers = providers
+        peer.metrics_text = metrics_body.decode(errors="replace")
+        peer.reports = reports
+        peer.dropped = dropped
+        if peer.rank is None:
+            # static peers learn their rank from the first event
+            for e in new_events:
+                if "rank" in e:
+                    peer.rank = int(e["rank"])
+                    break
+        rank = peer.rank if peer.rank is not None else -1
+        for e in new_events:
+            ev = dict(e)
+            ev["src_t_us"] = e.get("t_us")
+            # one ordering axis: rebase through the peer's wall-clock
+            # rendering onto THIS process's monotonic anchor
+            if "unix_us" in e:
+                ev["t_us"] = _clk.from_unix_us(e["unix_us"])
+            ev.setdefault("rank", rank)
+            peer.events.append(ev)
+        peer.cursor = cursor
+        state.scrapes_total += 1
+        state.events_merged_total += len(new_events)
+
+
+def _peer_loop(state, key):
+    # scraping a co-resident rank must never book badput into the run
+    # being scraped (lazy import: ledger imports escalation imports
+    # chronicle)
+    from deepspeed_tpu.telemetry.ledger import suppress_attribution
+    with suppress_attribution():
+        while not state.stop.is_set():
+            with state.lock:
+                peer = state.peers.get(key)
+            if peer is None:
+                return
+            _scrape_peer(state, peer)
+            if state.stop.wait(state.scrape_interval_s):
+                return
+
+
+def _load_cursors(state):
+    if not state.cursor_path or not os.path.isfile(state.cursor_path):
+        return {}
+    try:
+        with open(state.cursor_path) as f:
+            doc = json.load(f)
+        return {str(k): int(v) for k, v in
+                (doc.get("cursors") or {}).items()}
+    except (OSError, ValueError):
+        return {}
+
+
+def _persist_cursors(state):
+    if not state.cursor_path:
+        return
+    with state.lock:
+        cursors = {p.key: p.cursor for p in state.peers.values()}
+    doc = {"schema": "deepspeed_tpu.fleet_cursors/1", "cursors": cursors}
+    try:
+        _chronicle._atomic_write_bytes(
+            state.cursor_path,
+            json.dumps(doc, sort_keys=True).encode())
+    except OSError as e:
+        state.log("[federation] cursor persist failed: %s", e)
+
+
+def _discover(state):
+    """Merge the static peer list and the run-dir registry into the
+    peer table; spawn a worker for every NEW peer. A re-announced rank
+    (restart on a new port) updates the existing peer's URL in place —
+    its worker reconnects on the next pass. New peers resume from any
+    persisted cursor (aggregator-restart continuity)."""
+    found = []
+    for i, url in enumerate(state.static_peers):
+        found.append((f"static:{i}", str(url).rstrip("/"), None, ""))
+    if state.peers_dir and os.path.isdir(state.peers_dir):
+        for fname in sorted(os.listdir(state.peers_dir)):
+            if not fname.startswith("peer_rank_") \
+                    or not fname.endswith(".json") \
+                    or _chronicle._TMP_MARK in fname:
+                continue
+            try:
+                with open(os.path.join(state.peers_dir, fname)) as f:
+                    doc = json.load(f)
+                found.append((f"rank:{int(doc['rank'])}",
+                              str(doc["url"]).rstrip("/"),
+                              int(doc["rank"]),
+                              doc.get("job_name", "")))
+            except (OSError, ValueError, KeyError):
+                continue          # torn or foreign file — skip, re-scan
+    spawned = []
+    with state.lock:
+        for key, url, rank, job in found:
+            peer = state.peers.get(key)
+            if peer is None:
+                cursor = state.saved_cursors.get(key, -1)
+                peer = _Peer(key, url, rank=rank, job_name=job,
+                             cursor=cursor,
+                             events_ring=state.events_ring,
+                             static=key.startswith("static:"))
+                state.peers[key] = peer
+                spawned.append(key)
+            elif peer.url != url:
+                peer.url = url    # restarted rank, new port
+    for key in spawned:
+        th = threading.Thread(target=_peer_loop, args=(state, key),
+                              name=f"ds-fed-{key}", daemon=True)
+        th.start()
+        state.threads.append(th)
+
+
+def _tick_loop(state):
+    from deepspeed_tpu.telemetry.ledger import suppress_attribution
+    with suppress_attribution():
+        while not state.stop.wait(state.scrape_interval_s):
+            try:
+                _discover(state)
+                if state.slo is not None:
+                    state.slo.tick()
+                _persist_cursors(state)
+                state.last_tick_us = _clk.monotonic_us()
+            except Exception as e:   # forensics must never die loudly
+                state.log("[federation] tick failed: %s", e)
+
+
+def _finalize_agg(state):
+    state.stop.set()
+    for th in state.threads:
+        if th.is_alive():
+            th.join(timeout=state.timeout_s + 2.0)
+
+
+# -------------------------------------------------------------- fleet SLO
+
+def _fleet_sample(state, obj):
+    """Cumulative ``(bad, total)`` for one fleet objective — the UNION
+    of every peer's samples, re-added from their scraped reports. Also
+    books each rank's contribution for burn attribution. None until at
+    least one peer exposes the source."""
+    name = obj["name"]
+    now = _clk.monotonic_us()
+    with state.lock:
+        peers = list(state.peers.values())
+        contrib = state.contrib.setdefault(name, {})
+    bad = total = 0.0
+    seen = False
+    for p in peers:
+        if name == FLEET_GOODPUT:
+            rep = p.reports.get("goodput")
+            if not rep or not rep.get("enabled", True):
+                continue
+            elapsed = float(rep.get("elapsed_s") or 0.0)
+            good = sum(float((rep.get("categories_s") or {}).get(c, 0.0))
+                       for c in GOOD_CATEGORIES)
+            p_bad, p_total = max(0.0, elapsed - good), elapsed
+        elif name == FLEET_TTFT:
+            rep = p.reports.get("slo")
+            totals = (((rep or {}).get("objectives") or {})
+                      .get("serving_ttft") or {}).get("totals")
+            if not totals:
+                continue
+            p_bad = float(totals.get("bad", 0))
+            p_total = float(totals.get("total", 0))
+        else:
+            continue
+        seen = True
+        bad += p_bad
+        total += p_total
+        rank = p.rank if p.rank is not None else p.key
+        with state.lock:
+            dq = contrib.setdefault(rank, deque(maxlen=512))
+            dq.append((now, p_bad, p_total))
+    return (bad, total) if seen else None
+
+
+def _attribute(state, anom):
+    """Enrich one fleet burn anomaly with per-rank attribution: which
+    peer dominates the bad delta over the fast window."""
+    name = anom.get("objective")
+    window_us = int(state.slo.fast_window_s * 1e6) if state.slo else 0
+    now = anom.get("t_us") or _clk.monotonic_us()
+    with state.lock:
+        contrib = {r: list(dq) for r, dq in
+                   state.contrib.get(name, {}).items()}
+    deltas = {}
+    for rank, samples in contrib.items():
+        if not samples:
+            continue
+        newest = samples[-1]
+        anchor = samples[0]
+        for s in samples:
+            if s[0] <= now - window_us:
+                anchor = s
+            else:
+                break
+        deltas[rank] = round(max(0.0, newest[1] - anchor[1]), 6)
+    if deltas:
+        dominant = max(deltas, key=deltas.get)
+        anom["dominant_rank"] = dominant
+        anom["rank_bad_deltas"] = deltas
+        anom["detail"] = (anom.get("detail", "")
+                          + f" [dominant rank {dominant}]")
+    return anom
+
+
+class _FleetSlo(_slo.SloMonitor):
+    """SloMonitor whose sample source is the merged fleet view instead
+    of the local registry/ledger, and whose escalations carry per-rank
+    attribution. Everything else — multi-window burn, tier edges, the
+    shared escalation protocol — is inherited unchanged."""
+
+    def __init__(self, state, **kwargs):
+        self._fed_state = state
+        super().__init__(**kwargs)
+
+    def _sample(self, obj):
+        return _fleet_sample(self._fed_state, obj)
+
+    def _escalate(self, anoms, step):
+        for a in anoms:
+            _attribute(self._fed_state, a)
+        super()._escalate(anoms, step)
+
+
+# ------------------------------------------------------------- aggregator
+
+class FleetAggregator:
+    """The cross-process mission-control aggregator. See the module
+    docstring. Construction loads persisted cursors, discovers peers
+    and starts the scrape/tick threads; :meth:`attach` mounts the
+    merged routes on an ObsServer; ``close()`` (idempotent, also run by
+    ``weakref.finalize``) stops every thread and persists cursors."""
+
+    def __init__(self, peers=(), run_dir=None, registry=None,
+                 scrape_interval_s=1.0, timeout_s=2.0, stale_after_s=10.0,
+                 events_ring=4096, snapshot_path=None, token="",
+                 job_name="", enabled=True, goodput_target=0.9,
+                 ttft_target=0.99, fast_window_s=300.0,
+                 slow_window_s=3600.0, burn_threshold=1.0,
+                 eval_interval_s=10.0, log_fn=None):
+        self.enabled = bool(enabled)
+        if not self.enabled:
+            return
+        from deepspeed_tpu.telemetry.metrics import MetricsRegistry
+        self._log = log_fn or logger.warning
+        self.registry = registry if registry is not None \
+            else MetricsRegistry()
+        self.snapshot_path = snapshot_path
+        self.job_name = job_name
+        self._closed = False
+        self._last_snapshot_s = None
+        st = _AggState()
+        st.run_dir = run_dir
+        st.static_peers = tuple(peers or ())
+        st.token = str(token or "")
+        st.timeout_s = float(timeout_s)
+        st.scrape_interval_s = float(scrape_interval_s)
+        st.stale_after_s = float(stale_after_s)
+        st.events_ring = max(16, int(events_ring))
+        st.job_name = job_name
+        st.log = self._log
+        if run_dir:
+            st.peers_dir = os.path.join(run_dir, _PEERS_DIR)
+            os.makedirs(st.peers_dir, exist_ok=True)
+            st.cursor_path = os.path.join(st.peers_dir, _CURSOR_FILE)
+        st.slo = _FleetSlo(
+            st,
+            objectives=[
+                {"name": FLEET_GOODPUT, "kind": "goodput",
+                 "target": float(goodput_target)},
+                {"name": FLEET_TTFT, "kind": "goodput",
+                 "target": float(ttft_target)},
+            ],
+            fast_window_s=fast_window_s, slow_window_s=slow_window_s,
+            burn_threshold=burn_threshold,
+            eval_interval_s=eval_interval_s,
+            registry=self.registry, job_name=job_name, log_fn=self._log)
+        self._state = st
+        st.saved_cursors = _load_cursors(st)
+        _discover(st)
+        tick = threading.Thread(target=_tick_loop, args=(st,),
+                                name="ds-fed-tick", daemon=True)
+        tick.start()
+        st.threads.append(tick)
+        self._finalizer = weakref.finalize(self, _finalize_agg, st)
+
+    @classmethod
+    def from_config(cls, tcfg, output_path="telemetry/", run_dir=None,
+                    registry=None, job_name="", log_fn=None):
+        """Build from a parsed :class:`DeepSpeedTelemetryConfig`
+        (``telemetry.federation`` block). The snapshot lands under the
+        telemetry output dir unless the configured name is absolute
+        (never a bare CWD default)."""
+        snap = tcfg.federation_snapshot_file or "FLEET_CONTROL.json"
+        if not os.path.isabs(snap):
+            snap = os.path.join(output_path or "telemetry/", snap)
+        return cls(peers=tcfg.federation_peers,
+                   run_dir=run_dir or tcfg.federation_run_dir,
+                   registry=registry,
+                   scrape_interval_s=tcfg.federation_scrape_interval_s,
+                   timeout_s=tcfg.federation_timeout_s,
+                   stale_after_s=tcfg.federation_stale_after_s,
+                   events_ring=tcfg.federation_events_ring,
+                   snapshot_path=snap, token=tcfg.server_token,
+                   job_name=job_name,
+                   goodput_target=tcfg.federation_goodput_target,
+                   ttft_target=tcfg.federation_ttft_target,
+                   fast_window_s=tcfg.slo_fast_window_s,
+                   slow_window_s=tcfg.slo_slow_window_s,
+                   burn_threshold=tcfg.slo_burn_threshold,
+                   eval_interval_s=tcfg.slo_eval_interval_s,
+                   log_fn=log_fn)
+
+    # ---------------------------------------------------------- the merge
+    def peers(self):
+        """Peer inventory with live staleness judgement."""
+        if not self.enabled:
+            return []
+        now = _clk.monotonic_us()
+        st = self._state
+        with st.lock:
+            peers = list(st.peers.values())
+        out = []
+        for p in sorted(peers, key=lambda p: (p.rank is None,
+                                              p.rank, p.key)):
+            out.append({
+                "key": p.key, "url": p.url, "rank": p.rank,
+                "job_name": p.job_name, "static": p.static,
+                "status": p.status(now, st.stale_after_s),
+                "last_seen_age_s": p.last_seen_age_s(now),
+                "scrapes": p.scrapes, "errors": p.errors,
+                "last_error": p.last_error, "cursor": p.cursor,
+                "events_held": len(p.events),
+                "peer_dropped": p.dropped,
+                "providers": list(p.providers),
+            })
+        return out
+
+    def merged_events(self, cursor=None, limit=None):
+        """The fleet timeline: every peer's events on the aggregator's
+        rebased axis, strictly ``(t_us, seq, rank)``-ordered.
+        *cursor* is an opaque ``"t_us:seq:rank"`` string from a prior
+        response — only strictly-later events return (resumable)."""
+        if not self.enabled:
+            return []
+        st = self._state
+        with st.lock:
+            events = [e for p in st.peers.values() for e in p.events]
+        events.sort(key=_order_key)
+        if cursor:
+            after = _parse_cursor(cursor)
+            events = [e for e in events if _order_key(e) > after]
+        if limit is not None and len(events) > int(limit):
+            events = events[-int(limit):]
+        return events
+
+    def merged_metrics(self):
+        """One exposition document for the whole fleet: every peer's
+        scraped ``/metrics`` text (already identity-stamped at the
+        source when the peer runs with ``identity=``; any line still
+        missing a ``rank`` label gets one injected here) plus the
+        aggregator's own fleet registry as rank ``fleet``. HELP/TYPE
+        lines are deduped per family — the exposition format forbids
+        repeating them."""
+        from deepspeed_tpu.telemetry.sinks import render_prometheus
+        st = self._state
+        with st.lock:
+            texts = [(p.rank if p.rank is not None else p.key,
+                      p.metrics_text) for p in st.peers.values()]
+        texts.append(("fleet", render_prometheus(
+            self.registry, extra_labels={"rank": "fleet"})))
+        lines, seen_meta = [], set()
+        for rank, text in texts:
+            stamp = f'rank="{rank}"'
+            for line in text.splitlines():
+                if not line.strip():
+                    continue
+                if line.startswith("#"):
+                    if line not in seen_meta:
+                        seen_meta.add(line)
+                        lines.append(line)
+                    continue
+                lines.append(_stamp_sample_line(line, stamp))
+        return "\n".join(lines) + "\n"
+
+    def fleet_incidents(self):
+        """Cross-rank incident correlation over the merged timeline."""
+        return _incidents.correlate(self.merged_events(),
+                                    job_name=self.job_name)
+
+    def fleet_report(self, name):
+        """``/api/fleet/report/<name>``: the FLEET-level document for
+        ``slo`` / ``incidents`` / ``status``; otherwise every peer's
+        scraped report for *name*, keyed by rank."""
+        if name == "slo":
+            return self._state.slo.report()
+        if name == "incidents":
+            return self.fleet_incidents()
+        if name == "status":
+            return self.status()
+        st = self._state
+        with st.lock:
+            docs = {str(p.rank if p.rank is not None else p.key):
+                    p.reports[name] for p in st.peers.values()
+                    if name in p.reports}
+        if not docs:
+            known = sorted({n for p in self.peers()
+                            for n in p["providers"]}
+                           | {"slo", "incidents", "status"})
+            return (404, {"error": f"unknown fleet report {name!r}",
+                          "known": known}, "application/json")
+        return {"report": name, "peers": docs}
+
+    def status(self):
+        """The ``/federation/status`` document."""
+        st = self._state
+        peers = self.peers()
+        n_stale = sum(1 for p in peers if p["status"] != "ok")
+        return {
+            "schema": FLEET_CONTROL_SCHEMA,
+            "enabled": self.enabled,
+            "closed": self._closed,
+            "job_name": self.job_name,
+            "params": {
+                "scrape_interval_s": st.scrape_interval_s,
+                "timeout_s": st.timeout_s,
+                "stale_after_s": st.stale_after_s,
+                "events_ring": st.events_ring,
+                "run_dir": st.run_dir,
+            },
+            "n_peers": len(peers),
+            "n_stale": n_stale,
+            "peers": peers,
+            "counters": {
+                "scrapes_total": st.scrapes_total,
+                "scrape_errors_total": st.scrape_errors_total,
+                "events_merged_total": st.events_merged_total,
+            },
+            "uptime_s": round(
+                (_clk.monotonic_us() - st.started_us) / 1e6, 3),
+        }
+
+    def last_scrape_age_s(self):
+        """Seconds since the last aggregator tick (the obs server's
+        /healthz age probe); None before the first."""
+        if not self.enabled or self._state.last_tick_us is None:
+            return None
+        return round(
+            (_clk.monotonic_us() - self._state.last_tick_us) / 1e6, 3)
+
+    # ------------------------------------------------------------- routes
+    def attach(self, server):
+        """Mount the merged views on *server* (an ObsServer). The
+        handlers run on the serving thread and read only scraped state
+        — a fleet scrape never touches any rank's device."""
+        server.add_route("/federation/metrics", self._route_metrics)
+        server.add_route("/federation/status",
+                         lambda path, q: self.status())
+        server.add_route("/api/fleet/events", self._route_events)
+        server.add_route("/api/fleet/report/", self._route_report,
+                         prefix=True)
+        server.register("federation", self.report,
+                        age_s_fn=self.last_scrape_age_s)
+        return self
+
+    def _route_metrics(self, path, query):
+        return (200, self.merged_metrics().encode(),
+                "text/plain; version=0.0.4")
+
+    def _route_events(self, path, query):
+        cursor = (query.get("cursor") or [None])[0]
+        try:
+            limit = int((query.get("limit")
+                         or [self._state.events_ring])[0])
+        except (TypeError, ValueError):
+            return (400, {"error": "limit must be an int"},
+                    "application/json")
+        events = self.merged_events(cursor=cursor)
+        truncated = len(events) > limit
+        events = events[-limit:]
+        return {
+            "enabled": True,
+            "events": events,
+            "n": len(events),
+            "truncated": truncated,
+            "cursor": _format_cursor(events[-1]) if events
+                      else (cursor or ""),
+        }
+
+    def _route_report(self, path, query):
+        return self.fleet_report(path[len("/api/fleet/report/"):])
+
+    # ------------------------------------------------------------- output
+    def report(self):
+        """The FLEET_CONTROL.json document."""
+        if not self.enabled:
+            return {"schema": FLEET_CONTROL_SCHEMA, "enabled": False}
+        doc = self.status()
+        events = self.merged_events()
+        doc["slo"] = self._state.slo.report()
+        doc["incidents"] = _incidents.correlate(events,
+                                                job_name=self.job_name)
+        doc["n_merged_events"] = len(events)
+        doc["events_tail"] = events[-256:]
+        return doc
+
+    def write_snapshot(self, path=None, force=False, report=None):
+        """Throttled FLEET_CONTROL.json write (the monitors' shared
+        discipline)."""
+        if not self.enabled:
+            return None
+        path = path or self.snapshot_path
+        if path is None:
+            return None
+        now_s = _clk.monotonic_s()
+        if not force and self._last_snapshot_s is not None \
+                and now_s - self._last_snapshot_s < 5.0:
+            return None
+        self._last_snapshot_s = now_s
+        doc = report if report is not None else self.report()
+        try:
+            _chronicle._atomic_write_bytes(
+                path, json.dumps(doc, indent=1, default=repr,
+                                 allow_nan=False).encode())
+        except (OSError, ValueError) as e:
+            self._log("[federation] snapshot write failed: %s", e)
+            return None
+        return path
+
+    def close(self):
+        """Stop every worker, persist cursors, final snapshot when the
+        fleet saw anything. Idempotent; ``report()`` keeps working."""
+        if not self.enabled or self._closed:
+            return
+        self._closed = True
+        self._finalizer()
+        _persist_cursors(self._state)
+        if self._state.scrapes_total:
+            self.write_snapshot(force=True)
+        self._state.slo.close()
+
+
+# ----------------------------------------------------- merge helpers
+
+def _order_key(e):
+    return (e.get("t_us", 0), e.get("seq", 0), _rank_key(e.get("rank")))
+
+
+def _rank_key(rank):
+    # ranks are ints for announced peers, strings for static strangers;
+    # a mixed fleet must still sort deterministically
+    return (0, rank, "") if isinstance(rank, int) else (1, -1, str(rank))
+
+
+def _format_cursor(e):
+    return f"{e.get('t_us', 0)}:{e.get('seq', 0)}:{e.get('rank', '')}"
+
+
+def _parse_cursor(cursor):
+    try:
+        t, s, r = str(cursor).split(":", 2)
+        try:
+            rank = int(r)
+        except ValueError:
+            rank = r
+        return (int(t), int(s), _rank_key(rank))
+    except (TypeError, ValueError):
+        return (-1, -1, _rank_key(-1))
+
+
+def _stamp_sample_line(line, stamp):
+    """Inject an identity label into one exposition sample line UNLESS
+    it already carries a ``rank`` label (the extra_labels fast path —
+    peers running with ``identity=`` never take the parse branch)."""
+    brace = line.find("{")
+    space = line.find(" ")
+    if space < 0:
+        return line
+    if 0 <= brace < space:
+        close = line.find("}", brace)
+        inner = line[brace + 1:close]
+        if "rank=" in inner:
+            return line
+        merged = f"{inner},{stamp}" if inner else stamp
+        return f"{line[:brace + 1]}{merged}{line[close:]}"
+    return f"{line[:space]}{{{stamp}}}{line[space:]}"
+
+
+# --------------------------------------------------------------------- CLI
+
+def render(doc):
+    """Human-readable fleet view of a FLEET_CONTROL.json document."""
+    if not doc.get("enabled", True):
+        return "federation: disabled"
+    lines = [f"fleet: {doc.get('n_peers', 0)} peer(s), "
+             f"{doc.get('n_stale', 0)} stale, "
+             f"{doc.get('n_merged_events', 0)} merged event(s)"]
+    for p in doc.get("peers", []):
+        age = p.get("last_seen_age_s")
+        lines.append(
+            f"  rank {p.get('rank')!s:>5} [{p['status']:>5}] "
+            f"{p['url']} seen "
+            f"{'never' if age is None else f'{age:.1f}s ago'} "
+            f"({p['scrapes']} scrape(s), {p['errors']} error(s), "
+            f"cursor {p['cursor']})")
+    slo_doc = doc.get("slo") or {}
+    for name, o in sorted((slo_doc.get("objectives") or {}).items()):
+        lines.append(f"  slo {name}: tier {o.get('tier', 'ok').upper()}")
+    incs = (doc.get("incidents") or {}).get("incidents", [])
+    lines.append(f"  incidents: {len(incs)}")
+    for inc in incs:
+        rc = inc.get("root_cause") or {}
+        lines.append(
+            f"    #{inc['id']} [{inc.get('severity') or '-'}] root "
+            f"{rc.get('kind')}/{rc.get('rule') or rc.get('chaos') or ''} "
+            f"rank {rc.get('rank')} step {rc.get('step')}")
+    return "\n".join(lines)
+
+
+def _simulate_peer(args):
+    """One synthetic rank: a REAL ObsServer + RunChronicle + registry,
+    announced into the shared run dir — the subprocess harness the
+    federation tests and ``--demo`` drive (the PR-11 _simulate_rank
+    pattern). Emits step lifecycle + goodput reports; at
+    ``--fault-step``, the fault rank chronicles a chaos event (the
+    injector self-documents, PR-12) and every OTHER rank fires a
+    ``step_time_skew`` anomaly one step later."""
+    import time as _time
+
+    from deepspeed_tpu.telemetry.metrics import MetricsRegistry
+    from deepspeed_tpu.telemetry.obs_server import ObsServer
+
+    rank = int(args.simulate_peer)
+    registry = MetricsRegistry()
+    chron = _chronicle.RunChronicle(run_dir=args.run_dir, rank=rank,
+                                    job_name=args.job,
+                                    max_events=args.chronicle_ring)
+    _chronicle.set_chronicle(chron)
+    state = {"step": 0, "elapsed": 0.0, "good": 0.0,
+             "ttft_bad": 0, "ttft_total": 0}
+
+    def goodput_report():
+        return {"schema": "deepspeed_tpu.goodput/1", "enabled": True,
+                "elapsed_s": round(state["elapsed"], 6),
+                "categories_s": {"device_compute": round(state["good"],
+                                                         6)},
+                "goodput_fraction": (state["good"] / state["elapsed"]
+                                     if state["elapsed"] else None),
+                "counters": {"steps_seen": state["step"]}}
+
+    def slo_report():
+        return {"schema": "deepspeed_tpu.slo/1", "enabled": True,
+                "objectives": {"serving_ttft": {
+                    "kind": "latency", "tier": "ok",
+                    "totals": {"bad": state["ttft_bad"],
+                               "total": state["ttft_total"]}}}}
+
+    srv = ObsServer(registry=registry, port=args.port,
+                    identity={"rank": rank})
+    srv.announce(args.run_dir, rank=rank, job_name=args.job)
+    srv.register("goodput", goodput_report)
+    srv.register("slo", slo_report)
+    registry.counter("sim_steps_total", "synthetic steps").inc(0)
+    if chron.resumed_seq is None:
+        chron.emit("lifecycle", "engine", step=0, phase="init")
+    else:
+        chron.emit("lifecycle", "engine", step=0, phase="elastic_resume",
+                   detail=f"resumed after seq {chron.resumed_seq}")
+    print(f"PEER_READY rank={rank} url={srv.url}", flush=True)
+    step_s = args.step_ms / 1e3
+    for _ in range(args.steps):
+        _time.sleep(step_s)
+        state["step"] += 1
+        step = state["step"]
+        state["elapsed"] += step_s
+        state["good"] += step_s * (1.0 - args.bad_frac)
+        state["ttft_total"] += 10
+        state["ttft_bad"] += int(10 * args.bad_frac)
+        registry.counter("sim_steps_total", "synthetic steps").inc()
+        chron.emit("lifecycle", "engine", step=step, phase="step")
+        if args.fault_step and step == args.fault_step \
+                and rank == args.fault_rank:
+            chron.emit("chaos", "chaos", step=step,
+                       chaos="sigkill", severity="critical",
+                       detail="injected SIGKILL (fault rank)")
+        elif args.fault_step and step == args.fault_step + 1 \
+                and rank != args.fault_rank:
+            # one step AFTER the injection — the observers react to the
+            # fault, so the merged axis keeps the causal order
+            chron.emit("anomaly", "health", step=step,
+                       rule="step_time_skew", severity="warning",
+                       detail=f"step time skewed vs rank "
+                              f"{args.fault_rank}")
+    chron.drain()
+    print(f"PEER_DONE rank={rank} seq={chron._seq}", flush=True)
+    # keep serving scrapes until the parent is done with us
+    _time.sleep(args.linger_s)
+    chron.close()
+    srv.close()
+    return 0
+
+
+def _spawn_peer(run_dir, rank, steps=40, step_ms=25.0, bad_frac=0.0,
+                fault_step=0, fault_rank=-1, linger_s=60.0, job="fed",
+                chronicle_ring=16384):
+    import subprocess
+    import sys
+    cmd = [sys.executable, "-m", "deepspeed_tpu.telemetry.federation",
+           "--simulate-peer", str(rank), "--run-dir", run_dir,
+           "--steps", str(steps), "--step-ms", str(step_ms),
+           "--bad-frac", str(bad_frac), "--fault-step", str(fault_step),
+           "--fault-rank", str(fault_rank), "--linger-s", str(linger_s),
+           "--job", job, "--chronicle-ring", str(chronicle_ring)]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True, env=env)
+
+
+def _demo(args):
+    """The committed-artifact scenario: N simulated ranks on one run
+    dir, a chaos SIGKILL injected on one of them (chronicled by the
+    victim, then the process REALLY killed so the fleet view shows a
+    stale peer), the others firing ``step_time_skew`` — the aggregator
+    merges one ordered timeline, roots the cross-rank incident at the
+    fault rank, and writes FLEET_CONTROL.json."""
+    import signal as _signal
+    import tempfile
+    import time as _time
+
+    run_dir = tempfile.mkdtemp(prefix="federation_demo_")
+    n = max(3, args.peers)
+    fault_rank = n - 1
+    fault_step = args.steps // 2
+    procs = [
+        _spawn_peer(run_dir, r, steps=args.steps, step_ms=args.step_ms,
+                    bad_frac=(0.6 if r == 1 else 0.05),
+                    fault_step=fault_step, fault_rank=fault_rank,
+                    job="federation_demo")
+        for r in range(n)]
+    agg = FleetAggregator(
+        run_dir=run_dir, job_name="federation_demo",
+        scrape_interval_s=0.2, timeout_s=2.0,
+        stale_after_s=args.step_ms * args.steps / 1e3,
+        snapshot_path=os.path.abspath(args.out),
+        fast_window_s=1.0, slow_window_s=4.0, eval_interval_s=0.1)
+    # let every rank pass the fault step, then REALLY kill the victim —
+    # the chaos event is already on its stream (the injector
+    # self-documented before dying), and the fleet view must degrade it
+    # to stale without blocking the others
+    deadline = _clk.monotonic_s() + 60.0
+    fault_seen = False
+    while _clk.monotonic_s() < deadline and not fault_seen:
+        _time.sleep(0.3)
+        fault_seen = any(e.get("chaos") == "sigkill"
+                         for e in agg.merged_events())
+    procs[fault_rank].send_signal(_signal.SIGKILL)
+    deadline = _clk.monotonic_s() + 60.0
+    while _clk.monotonic_s() < deadline:
+        _time.sleep(0.3)
+        peers = {p["rank"]: p for p in agg.peers()}
+        victim = peers.get(fault_rank)
+        others_done = all(
+            any(e.get("step") == args.steps and e.get("rank") == r
+                for e in agg.merged_events())
+            for r in range(n) if r != fault_rank)
+        if victim and victim["status"] == "stale" and others_done:
+            break
+    agg._state.slo.tick(force=True)
+    doc = agg.report()
+    agg.write_snapshot(force=True, report=doc)
+    agg.close()
+    for p in procs:
+        try:
+            p.kill()
+            p.wait(timeout=10)
+        except Exception:
+            pass
+    print(render(doc))
+    print(f"wrote {args.out}")
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="fleet federation aggregator demo/CLI")
+    ap.add_argument("--demo", action="store_true",
+                    help="run the N-rank chaos demo and write the "
+                         "committed FLEET_CONTROL.json")
+    ap.add_argument("--render", metavar="PATH",
+                    help="render an existing FLEET_CONTROL.json")
+    ap.add_argument("--simulate-peer", type=int, default=None,
+                    metavar="RANK", help="run one synthetic rank "
+                    "(subprocess harness; used by --demo and tests)")
+    ap.add_argument("--run-dir", default=None)
+    ap.add_argument("--out", default="FLEET_CONTROL.json")
+    ap.add_argument("--peers", type=int, default=3)
+    ap.add_argument("--steps", type=int, default=40)
+    ap.add_argument("--step-ms", type=float, default=25.0)
+    ap.add_argument("--bad-frac", type=float, default=0.0)
+    ap.add_argument("--fault-step", type=int, default=0)
+    ap.add_argument("--fault-rank", type=int, default=-1)
+    ap.add_argument("--linger-s", type=float, default=60.0)
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--job", default="fed")
+    ap.add_argument("--chronicle-ring", type=int, default=16384)
+    args = ap.parse_args(argv)
+    if args.simulate_peer is not None:
+        if not args.run_dir:
+            ap.error("--simulate-peer requires --run-dir")
+        return _simulate_peer(args)
+    if args.demo:
+        return _demo(args)
+    if args.render:
+        with open(args.render) as f:
+            print(render(json.load(f)))
+        return 0
+    ap.error("one of --demo / --render / --simulate-peer is required")
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

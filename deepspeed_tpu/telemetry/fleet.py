@@ -602,6 +602,7 @@ class FleetMonitor:
         self.last_desync = None
         self._snapshots_written = 0
         self._last_snapshot_t = float("-inf")
+        self._last_poll_t = None
 
     @classmethod
     def from_config(cls, tconfig, run_dir, output_path="telemetry/",
@@ -709,6 +710,7 @@ class FleetMonitor:
         window stops being waited for (judged partial) — a dead host
         must not disable the very sentinels that exist to catch it.
         ``force=True`` (the report path) judges everything pending."""
+        self._last_poll_t = time.monotonic()
         self.scan()
         known = set(self._rank_next)
         newest = max(self._pending, default=-1)
@@ -730,6 +732,14 @@ class FleetMonitor:
             if idx in self._judged:
                 del self._pending[idx]
         return judged
+
+    def last_poll_age_s(self):
+        """Seconds since the last ``poll()`` — the obs server's
+        freshness stamp for the fleet provider (None before the first
+        poll, matching the other monitors' age semantics)."""
+        if self._last_poll_t is None:
+            return None
+        return round(time.monotonic() - self._last_poll_t, 3)
 
     def _accumulate_totals(self, rank, rec):
         """Per-rank exact integer sums — accumulated at JUDGE time from

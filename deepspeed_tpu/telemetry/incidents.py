@@ -115,7 +115,9 @@ class IncidentCorrelator:
                                           _severity_rank(m.get("severity")),
                                           m["seq"]))
         rc = {k: best[k] for k in ("seq", "t_us", "kind", "source")}
-        for k in ("step", "rule", "chaos", "severity", "detail"):
+        # rank rides along so a MERGED (cross-rank) timeline's root cause
+        # names WHICH rank the fault landed on, not just when
+        for k in ("step", "rule", "chaos", "severity", "detail", "rank"):
             if k in best:
                 rc[k] = best[k]
         rc["why"] = ("earliest causally-linked "

@@ -21,8 +21,19 @@ route                     serves
 ``GET /api/report/<x>``   each armed monitor's ``report()`` — its latest
                           HOST-SIDE snapshot
 ``GET /api/events``       bounded chronicle tail, ``?since_seq=``
-                          resumable (poll-friendly)
+                          resumable (poll-friendly); seqs the in-memory
+                          ring has drop-NEW'd are served from the rank's
+                          on-disk JSONL stream when ``run_dir`` is armed
+                          (:meth:`RunChronicle.events_since`)
 ========================  =================================================
+
+Federation hooks: ``identity={"rank": N}`` stamps every ``/metrics``
+family with the rank label (:func:`sinks.render_prometheus`
+``extra_labels``), :meth:`ObsServer.add_route` mounts the aggregator's
+merged ``/federation/*`` + ``/api/fleet/*`` views, and
+:meth:`ObsServer.announce` writes the endpoint into the run-dir peer
+registry so a :class:`telemetry.federation.FleetAggregator` discovers
+ranks without static config.
 
 The load-bearing contract: **a scrape must NEVER force a device fetch,
 a sync, or a compile**. Providers are monitor-level bound ``report()``
@@ -42,6 +53,7 @@ two probe routes.
 
 import json
 import math
+import os
 import threading
 import weakref
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -79,13 +91,17 @@ class _ObsState:
     the handlers hold ONLY this (never the ObsServer), so finalize-based
     teardown works."""
 
-    def __init__(self, registry=None, token="", events_tail=256):
+    def __init__(self, registry=None, token="", events_tail=256,
+                 identity=None):
         self.registry = registry
         self.token = str(token or "")
         self.events_tail = max(1, int(events_tail))
+        self.identity = dict(identity or {})
         self.lock = threading.Lock()
         self.providers = {}          # name -> report() callable
         self.age_fns = {}            # name -> seconds-since-last-tick fn
+        self.routes = {}             # exact extra path -> handler fn
+        self.prefix_routes = {}      # path prefix -> handler fn
         self.requests_total = 0
         self.requests_by_route = {}
         self.errors_total = 0
@@ -161,8 +177,20 @@ class _Handler(BaseHTTPRequestHandler):
             elif path.startswith("/api/report/"):
                 self._report(state, path[len("/api/report/"):])
             else:
-                self._reply(404, {"error": "unknown route",
-                                  "routes": list(ROUTES)})
+                with state.lock:
+                    fn = state.routes.get(path)
+                    if fn is None:
+                        for pref, pfn in state.prefix_routes.items():
+                            if path.startswith(pref):
+                                fn = pfn
+                                break
+                    extra = sorted(state.routes) + [
+                        p + "<...>" for p in sorted(state.prefix_routes)]
+                if fn is not None:
+                    self._extra(fn, path, parse_qs(split.query))
+                else:
+                    self._reply(404, {"error": "unknown route",
+                                      "routes": list(ROUTES) + extra})
         except Exception as e:   # a broken provider must not kill serving
             with state.lock:
                 state.errors_total += 1
@@ -172,12 +200,26 @@ class _Handler(BaseHTTPRequestHandler):
             except Exception:
                 pass
 
+    def _extra(self, fn, path, query):
+        """Dispatch one registered extra route (the federation hook).
+        The handler returns either a JSON payload (200) or a
+        ``(code, payload, content_type)`` tuple for full control."""
+        out = fn(path, query)
+        if isinstance(out, tuple):
+            code, payload, ctype = out
+            self._reply(code, payload, content_type=ctype)
+        else:
+            self._reply(200, out)
+
     def _metrics(self, state):
         from deepspeed_tpu.telemetry.sinks import render_prometheus
         reg = state.registry if state.registry is not None \
             else _metrics.get_registry()
-        self._reply(200, render_prometheus(reg).encode(),
-                    content_type="text/plain; version=0.0.4")
+        # identity labels ride EVERY family so a federated aggregator's
+        # merge needs no exposition re-parse (fleet satellite 2)
+        self._reply(200, render_prometheus(
+            reg, extra_labels=state.identity or None).encode(),
+            content_type="text/plain; version=0.0.4")
 
     def _healthz(self, state, ready):
         with state.lock:
@@ -229,9 +271,16 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(400, {"error": "since_seq/limit must be ints"})
             return
         limit = max(1, min(limit, state.events_tail))
-        events = [e for e in chron.snapshot_events() if e["seq"] > since]
+        # events_since falls back to the on-disk JSONL stream when the
+        # bounded ring has drop-NEW'd part of the requested range — a
+        # resumed consumer gets the FULL tail, not a silent gap
+        events = chron.events_since(since)
         truncated = len(events) > limit
-        events = events[-limit:]
+        # ?oldest=1 pages forward from the cursor (gapless catch-up —
+        # the federation scraper's mode); the default keeps the
+        # dashboard-friendly newest-tail view
+        oldest = (query.get("oldest", ["0"])[0] in ("1", "true"))
+        events = events[:limit] if oldest else events[-limit:]
         self._reply(200, {
             "enabled": True,
             "events": events,
@@ -276,10 +325,11 @@ class ObsServer:
     """
 
     def __init__(self, registry=None, host="127.0.0.1", port=0,
-                 token="", events_tail=256, log_fn=None):
+                 token="", events_tail=256, identity=None, log_fn=None):
         self._log = log_fn or logger.warning
         self._state = _ObsState(registry=registry, token=token,
-                                events_tail=events_tail)
+                                events_tail=events_tail,
+                                identity=identity)
         self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
         self._httpd.daemon_threads = True
         self._httpd._obs_state = self._state
@@ -293,12 +343,13 @@ class ObsServer:
             self, _finalize_server, self._httpd, self._thread)
 
     @classmethod
-    def from_config(cls, tcfg, registry=None):
+    def from_config(cls, tcfg, registry=None, identity=None):
         """Build from a parsed :class:`DeepSpeedTelemetryConfig`
         (``telemetry.server`` block)."""
         return cls(registry=registry, host=tcfg.server_host,
                    port=tcfg.server_port, token=tcfg.server_token,
-                   events_tail=tcfg.server_events_tail)
+                   events_tail=tcfg.server_events_tail,
+                   identity=identity)
 
     @property
     def url(self):
@@ -317,6 +368,47 @@ class ObsServer:
             self._state.providers.pop(name, None)
             self._state.age_fns.pop(name, None)
 
+    def add_route(self, path, handler, prefix=False):
+        """Mount *handler* at *path* (exact, or every path under it when
+        ``prefix=True``) — how :mod:`telemetry.federation` serves its
+        merged ``/federation/*`` and ``/api/fleet/*`` views from the
+        rank's own endpoint. *handler* is called as ``handler(path,
+        query)`` (query already ``parse_qs``-parsed) and returns a JSON
+        payload (200) or a ``(code, payload, content_type)`` tuple; it
+        runs on the serving thread, so the no-device-fetch scrape
+        contract applies to it too."""
+        with self._state.lock:
+            if prefix:
+                self._state.prefix_routes[str(path)] = handler
+            else:
+                self._state.routes[str(path)] = handler
+        return self
+
+    def announce(self, run_dir, rank=0, job_name="", extra=None):
+        """Write this endpoint into the run-dir peer registry
+        (``<run_dir>/peers/peer_rank_<rank>.json``, tmp+fsync+rename) so
+        a :class:`telemetry.federation.FleetAggregator` scanning the
+        shared run dir discovers the rank without static config. Returns
+        the registry path (None on write failure — announcing is
+        forensics, never fatal)."""
+        doc = {"url": self.url, "rank": int(rank),
+               "job_name": job_name, "pid": os.getpid(),
+               "started_unix_us": _clk.to_unix_us(
+                   self._state.started_us)}
+        if extra:
+            doc.update(extra)
+        path = os.path.join(run_dir, "peers",
+                            f"peer_rank_{int(rank):05d}.json")
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            _chronicle._atomic_write_bytes(
+                path, json.dumps(doc, sort_keys=True,
+                                 allow_nan=False).encode())
+        except OSError as e:
+            self._log("[obs_server] peer announce failed: %s", e)
+            return None
+        return path
+
     def providers(self):
         with self._state.lock:
             return sorted(self._state.providers)
@@ -326,6 +418,7 @@ class ObsServer:
         st = self._state
         with st.lock:
             by_route = dict(st.requests_by_route)
+            extra_routes = sorted(st.routes) + sorted(st.prefix_routes)
         return {
             "schema": OBS_SERVER_SCHEMA,
             "enabled": True,
@@ -335,6 +428,8 @@ class ObsServer:
             "port": self.port,
             "auth": bool(st.token),
             "events_tail": st.events_tail,
+            "identity": dict(st.identity),
+            "extra_routes": extra_routes,
             "providers": self.providers(),
             "requests_total": st.requests_total,
             "requests_by_route": by_route,

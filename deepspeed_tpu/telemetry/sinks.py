@@ -107,7 +107,7 @@ def _labels_str(labels, extra=None):
 SUMMARY_QUANTILES = (0.5, 0.9, 0.99)
 
 
-def render_prometheus(registry):
+def render_prometheus(registry, extra_labels=None):
     """Render *registry* in the Prometheus text exposition format v0.0.4.
 
     Histograms additionally render a sibling ``<name>_summary`` family of
@@ -121,10 +121,28 @@ def render_prometheus(registry):
     Family names that sanitize to the same Prometheus name are
     de-collided (:func:`_sanitized_family_names`) — the exposition
     format forbids a duplicate TYPE line, and merging two families'
-    samples under one name corrupts both series."""
+    samples under one name corrupts both series.
+
+    ``extra_labels`` stamps constant labels (e.g. ``{"rank": 3}``) onto
+    EVERY sample line at render time — the fleet-federation identity
+    injection: a peer renders its own exposition already labelled, so
+    the aggregator's merge never re-parses sample text. A metric's own
+    label wins a key collision (per-sample truth beats the const
+    stamp)."""
     lines = []
     collected = registry.collect()
     names = _sanitized_family_names(collected)
+    if extra_labels:
+        # metric-level labels override the const stamp on key collision:
+        # _labels_str applies `extra` (the metric's labels) LAST
+        def _ls(labels, extra=None):
+            merged = dict(extra_labels)
+            merged.update(labels or {})
+            if extra:
+                merged.update(extra)
+            return _labels_str(merged)
+    else:
+        _ls = _labels_str
     for family, ms in sorted(collected.items()):
         name = names[family]
         help_text = next((m.help for m in ms if m.help), "")
@@ -138,17 +156,17 @@ def render_prometheus(registry):
                 for le, c in zip([*m.buckets, float("inf")], cum):
                     lines.append(
                         f"{name}_bucket"
-                        f"{_labels_str(m.labels, {'le': _fmt_value(float(le))})}"
+                        f"{_ls(m.labels, {'le': _fmt_value(float(le))})}"
                         f" {c}")
                 lines.append(
-                    f"{name}_sum{_labels_str(m.labels)} {_fmt_value(m.sum)}")
+                    f"{name}_sum{_ls(m.labels)} {_fmt_value(m.sum)}")
                 lines.append(
-                    f"{name}_count{_labels_str(m.labels)} {m.count}")
+                    f"{name}_count{_ls(m.labels)} {m.count}")
                 if m.count:
                     summaries.append(m)
             else:
                 lines.append(
-                    f"{name}{_labels_str(m.labels)} {_fmt_value(m.value)}")
+                    f"{name}{_ls(m.labels)} {_fmt_value(m.value)}")
         if summaries:
             sname = f"{name}_summary"
             lines.append(f"# TYPE {sname} summary")
@@ -157,12 +175,12 @@ def render_prometheus(registry):
                     v = m.quantile(q)
                     lines.append(
                         f"{sname}"
-                        f"{_labels_str(m.labels, {'quantile': _fmt_value(q)})}"
+                        f"{_ls(m.labels, {'quantile': _fmt_value(q)})}"
                         f" {_fmt_value(v)}")
                 lines.append(
-                    f"{sname}_sum{_labels_str(m.labels)} "
+                    f"{sname}_sum{_ls(m.labels)} "
                     f"{_fmt_value(m.sum)}")
-                lines.append(f"{sname}_count{_labels_str(m.labels)} "
+                lines.append(f"{sname}_count{_ls(m.labels)} "
                              f"{m.count}")
     return "\n".join(lines) + "\n"
 

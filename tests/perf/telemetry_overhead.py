@@ -62,6 +62,12 @@ Asserts:
   seconds-long run can never become burn-eligible against production
   windows (the min-span guard), and the disabled/closed ``tick()``
   paths fit the <2 µs budget;
+* ``telemetry.federation``: the fleet aggregator is statically
+  host-only (no jax import anywhere in the module) and an ARMED
+  federation — the rank announced + actively scraped by the aggregator
+  — adds ZERO train-step compiles; with ``jax.device_get`` poisoned
+  the aggregator keeps scraping and every merged view still answers
+  (a fleet scrape is host HTTP over host snapshots, nothing more);
 * ``guardian``: an ARMED guardian with no anomalies is free — a 20-step
   run with guardian + health on still compiles the train step exactly
   ONCE (the guardian owns zero compiled programs, statically guarded:
@@ -100,7 +106,8 @@ def _tiny_engine(ce_enabled, health_enabled=False, goodput_enabled=False,
                  fleet_enabled=False, guardian_enabled=False,
                  memory_enabled=False, memory_cadence=0,
                  chronicle_enabled=False, server_enabled=False,
-                 slo_enabled=False, steps_per_print=10 ** 9):
+                 slo_enabled=False, federation_enabled=False,
+                 steps_per_print=10 ** 9):
     import tempfile
 
     import jax
@@ -132,6 +139,13 @@ def _tiny_engine(ce_enabled, health_enabled=False, goodput_enabled=False,
             "enabled": True, "run_dir": os.path.join(cdir, "chronicle"),
             "summary_file": os.path.join(cdir, "CHRONICLE.json"),
             "incidents_file": os.path.join(cdir, "INCIDENTS.json")}
+    federation_cfg = {"enabled": False}
+    if federation_enabled:
+        ddir = tempfile.mkdtemp(prefix="ds_fed_oh_")
+        federation_cfg = {
+            "enabled": True, "run_dir": os.path.join(ddir, "fleet"),
+            "scrape_interval_s": 0.1, "stale_after_s": 5.0,
+            "snapshot_file": os.path.join(ddir, "FLEET_CONTROL.json")}
     engine, _, _, _ = deepspeed_tpu.initialize(
         model=GPT2LMHeadModel(cfg),
         config={"train_batch_size": 8,
@@ -153,6 +167,7 @@ def _tiny_engine(ce_enabled, health_enabled=False, goodput_enabled=False,
                               "server": {"enabled": server_enabled},
                               "slo": {"enabled": slo_enabled,
                                       "eval_interval_s": 0.001},
+                              "federation": federation_cfg,
                               "fleet": fleet_cfg}},
         sample_batch=batch)
     return engine, batch
@@ -1139,6 +1154,103 @@ def check_chronicle_writer_books_nothing_into_ledger(events=500):
           f"0 s booked into the ledger")
 
 
+def check_federation_zero_extra_compiles(steps=10, cadence=5):
+    """ISSUE-19 acceptance guard: fleet federation ARMED — the rank's
+    obs server announced into the peer registry and the aggregator
+    scraping it at a test-tiny interval — adds exactly ZERO train-step
+    compiles, and a federated scrape can never reach the device: with
+    ``jax.device_get`` poisoned, the aggregator must keep scraping OK
+    and every merged view (metrics / timeline / status / fleet SLO)
+    must still answer from host-side snapshots."""
+    import jax
+
+    engine, batch = _tiny_engine(ce_enabled=True, goodput_enabled=True,
+                                 chronicle_enabled=True,
+                                 server_enabled=True, slo_enabled=True,
+                                 federation_enabled=True,
+                                 steps_per_print=cadence)
+    agg = engine._fleet_aggregator
+    assert agg is not None, \
+        "the auto policy must arm the aggregator on rank 0"
+    assert engine._obs_server.report()["identity"] == {"rank": "0"}, \
+        "federated ranks must stamp their scrape with their rank"
+    engine.train_batch(batch=batch)       # the one compile
+    after_prime = _backend_compiles(engine)
+    for _ in range(steps - 1):
+        engine.train_batch(batch=batch)
+    after_steps = _backend_compiles(engine)
+    assert after_steps == after_prime, (
+        f"armed federation changed compilation: {after_prime} -> "
+        f"{after_steps} over {steps} steps")
+    # now poison the device boundary and let the aggregator keep
+    # scraping the live plane — a scrape that fetches anything dies here
+    orig = jax.device_get
+
+    def poisoned(*a, **k):
+        raise AssertionError("a federated scrape touched the device")
+
+    jax.device_get = poisoned
+    try:
+        scrapes0 = agg.status()["counters"]["scrapes_total"]
+        deadline = time.perf_counter() + 15.0
+        while time.perf_counter() < deadline:
+            if agg.status()["counters"]["scrapes_total"] >= scrapes0 + 3:
+                break
+            time.sleep(0.05)
+        st = agg.status()
+        assert st["counters"]["scrapes_total"] >= scrapes0 + 3, (
+            f"aggregator stopped scraping under the poisoned device: "
+            f"{st['counters']}")
+        peers = agg.peers()
+        assert peers and peers[0]["status"] == "ok", peers
+        text = agg.merged_metrics()
+        samples = [ln for ln in text.splitlines()
+                   if ln and not ln.startswith("#")]
+        assert samples and all("rank=" in ln for ln in samples), (
+            "merged scrape carries unlabelled sample lines")
+        events = agg.merged_events()
+        assert events, "no events merged from the live chronicle"
+        agg.fleet_report("slo")
+    finally:
+        jax.device_get = orig
+    after_scrapes = _backend_compiles(engine)
+    assert after_scrapes == after_steps, (
+        f"federated scraping compiled {after_scrapes - after_steps} "
+        f"programs on the scraped rank — a scrape must be host HTTP "
+        f"only")
+    n_scraped = agg.status()["counters"]["scrapes_total"]
+    engine.close()
+    print(f"federation path: 1 compile over {steps} steps, "
+          f"{n_scraped} device-poisoned scrapes, merged views all "
+          f"rank-labelled, 0 extra compiles")
+
+
+def check_federation_no_device_access():
+    """telemetry/federation.py must stay PURE HOST bookkeeping — the
+    static guard every observatory carries: no jax import anywhere in
+    the module (even the CLI harness builds only obs servers and
+    chronicles; the subprocess peers it spawns set JAX_PLATFORMS=cpu
+    in their own environment)."""
+    import ast
+
+    import deepspeed_tpu.telemetry.federation as fed_ast_mod
+    with open(fed_ast_mod.__file__) as f:
+        tree = ast.parse(f.read())
+    offenders = []
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Import):
+            offenders += [a.name for a in n.names
+                          if a.name.split(".")[0] == "jax"]
+        elif isinstance(n, ast.ImportFrom) and \
+                (n.module or "").split(".")[0] == "jax":
+            offenders.append(n.module)
+    assert not offenders, (
+        f"telemetry/federation.py imports jax ({offenders}) — the "
+        f"aggregator must stay host-only so a fleet scrape cannot add "
+        f"device syncs anywhere")
+    print("federation: statically host-only (no jax imports at all)")
+
+
 def main(iters=200_000):
     from deepspeed_tpu.telemetry import Tracer
 
@@ -1183,6 +1295,8 @@ def main(iters=200_000):
     check_chronicle_armed_zero_extra_compiles()
     check_chronicle_disabled_emit_under_2us()
     check_chronicle_writer_books_nothing_into_ledger()
+    check_federation_zero_extra_compiles()
+    check_federation_no_device_access()
     print("OK")
 
 

@@ -1,0 +1,454 @@
+"""Fleet federation (telemetry/federation.py + engine glue).
+
+Covers the cross-process mission-control acceptance criteria: the
+cursor/order helpers the merge rests on, an in-process aggregator
+against a REAL peer plane (scrape, rank-labelled merged metrics,
+resumable fleet timeline), the fault-tolerance contract (a hanging
+peer accepts the TCP connection and never answers — it must go
+non-ok within the scrape timeout without blocking the healthy peer
+or the merged views; a dead port degrades the same way), the
+subprocess e2e (N=3 ranks, injected chaos SIGKILL on one, cross-rank
+incident rooted at the fault rank, killed peer stale, strictly
+ordered resumable merged timeline) and the elastic-resume contract
+(a SIGKILL'd rank restarted on the same run dir keeps its chronicle
+numbering and re-announces its new endpoint).
+"""
+
+import json
+import os
+import signal
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from deepspeed_tpu.telemetry import chronicle as chron_mod
+from deepspeed_tpu.telemetry import federation as fed_mod
+from deepspeed_tpu.telemetry.chronicle import RunChronicle
+from deepspeed_tpu.telemetry.federation import (FLEET_CONTROL_SCHEMA,
+                                                FleetAggregator)
+from deepspeed_tpu.telemetry.metrics import MetricsRegistry
+from deepspeed_tpu.telemetry.obs_server import ObsServer
+
+
+def _get_json(url, timeout=5.0):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+def _wait_for(predicate, timeout_s=20.0, interval_s=0.05, what=""):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        v = predicate()
+        if v:
+            return v
+    pytest.fail(f"timed out waiting for {what or predicate}")
+
+
+# -------------------------------------------------- helpers (pure)
+
+class TestCursorAndOrdering:
+    def test_cursor_round_trip_is_strictly_resumable(self):
+        e = {"t_us": 123456, "seq": 7, "rank": 2}
+        cur = fed_mod._format_cursor(e)
+        after = fed_mod._parse_cursor(cur)
+        # the event AT the cursor is not strictly later than itself
+        assert fed_mod._order_key(e) == after
+        later = {"t_us": 123456, "seq": 8, "rank": 0}
+        assert fed_mod._order_key(later) > after
+
+    def test_bad_cursor_parses_to_the_beginning(self):
+        assert fed_mod._parse_cursor("garbage") == \
+            fed_mod._parse_cursor(None)
+        # "from the beginning" sorts before any real event
+        assert fed_mod._parse_cursor(None) < fed_mod._order_key(
+            {"t_us": 0, "seq": 0, "rank": 0})
+
+    def test_order_key_sorts_mixed_int_and_str_ranks(self):
+        evs = [{"t_us": 5, "seq": 1, "rank": "static:0"},
+               {"t_us": 5, "seq": 1, "rank": 3},
+               {"t_us": 5, "seq": 1, "rank": 0},
+               {"t_us": 4, "seq": 9, "rank": 7}]
+        ordered = sorted(evs, key=fed_mod._order_key)
+        assert [e["rank"] for e in ordered] == [7, 0, 3, "static:0"]
+
+    def test_stamp_sample_line(self):
+        stamp = 'rank="3"'
+        assert fed_mod._stamp_sample_line("foo_total 3", stamp) == \
+            'foo_total{rank="3"} 3'
+        assert fed_mod._stamp_sample_line(
+            'foo_total{k="v"} 3', stamp) == 'foo_total{k="v",rank="3"} 3'
+        # a line already carrying rank= is the extra_labels fast path —
+        # never double-stamped
+        already = 'foo_total{rank="1"} 3'
+        assert fed_mod._stamp_sample_line(already, stamp) == already
+
+
+# ------------------------------------------- in-process aggregator
+
+@pytest.fixture
+def local_peer(tmp_path):
+    """One REAL peer plane in this process: ObsServer + registry +
+    announced RunChronicle (the global one — /api/events reads it)."""
+    run_dir = str(tmp_path / "fleet")
+    reg = MetricsRegistry()
+    reg.counter("peer_steps_total", "synthetic steps").inc(5)
+    chron = RunChronicle(run_dir=run_dir, rank=0, job_name="fedtest",
+                         max_events=64)
+    chron_mod.set_chronicle(chron)
+    srv = ObsServer(registry=reg, identity={"rank": "0"})
+    srv.register("goodput", lambda: {
+        "enabled": True, "elapsed_s": 10.0,
+        "categories_s": {"device_compute": 9.0},
+        "goodput_fraction": 0.9, "counters": {"steps_seen": 10}})
+    srv.announce(run_dir, rank=0, job_name="fedtest")
+    for step in range(4):
+        chron.emit("lifecycle", "engine", step=step, phase="step")
+    yield run_dir, srv, chron
+    srv.close()
+    chron.close()
+    chron_mod.reset_chronicle(if_current=chron)
+
+
+class TestAggregatorInProcess:
+    def test_discovers_scrapes_and_merges_a_real_peer(self, local_peer):
+        run_dir, srv, _chron = local_peer
+        agg = FleetAggregator(run_dir=run_dir, scrape_interval_s=0.1,
+                              timeout_s=2.0, eval_interval_s=0.05)
+        try:
+            _wait_for(lambda: any(p["scrapes"] and p["status"] == "ok"
+                                  for p in agg.peers()),
+                      what="first successful scrape")
+            peers = agg.peers()
+            assert [p["rank"] for p in peers] == [0]
+            assert peers[0]["url"] == srv.url
+            assert "goodput" in peers[0]["providers"]
+            # merged metrics: every sample line rank-labelled, peer
+            # families present, HELP/TYPE never repeated per family
+            text = agg.merged_metrics()
+            samples = [ln for ln in text.splitlines()
+                       if ln and not ln.startswith("#")]
+            assert samples and all("rank=" in ln for ln in samples)
+            assert 'peer_steps_total{rank="0"} 5' in text
+            helps = [ln for ln in text.splitlines()
+                     if ln.startswith("# HELP")]
+            assert len(helps) == len({ln.split()[2] for ln in helps})
+            # merged timeline: strictly ordered, resumable mid-stream
+            events = _wait_for(
+                lambda: (agg.merged_events()
+                         if len(agg.merged_events()) >= 4 else None),
+                what="events merged")
+            keys = [fed_mod._order_key(e) for e in events]
+            assert keys == sorted(keys) and len(set(keys)) == len(keys)
+            assert all(e["rank"] == 0 for e in events)
+            cur = fed_mod._format_cursor(events[1])
+            resumed = agg.merged_events(cursor=cur)
+            assert resumed == events[2:]
+            # fleet report plumbing
+            doc = agg.fleet_report("status")
+            assert doc["schema"] == FLEET_CONTROL_SCHEMA
+            assert doc["n_peers"] == 1 and doc["n_stale"] == 0
+            per_peer = agg.fleet_report("goodput")
+            assert per_peer["peers"]["0"]["goodput_fraction"] == 0.9
+            code, _doc, _ct = agg.fleet_report("nope")
+            assert code == 404
+        finally:
+            agg.close()
+
+    def test_hanging_peer_goes_stale_without_blocking(self, local_peer):
+        """THE fault-tolerance contract: a peer that accepts the TCP
+        connection and never answers must be judged non-ok within the
+        scrape timeout, while the healthy peer keeps scraping and the
+        merged views keep answering promptly."""
+        run_dir, _srv, _chron = local_peer
+        lsock = socket.socket()
+        lsock.bind(("127.0.0.1", 0))
+        lsock.listen(8)
+        lsock.settimeout(0.2)
+        stop = threading.Event()
+        held = []
+
+        def _accept_and_stall():
+            while not stop.is_set():
+                try:
+                    conn, _ = lsock.accept()
+                    held.append(conn)     # hold open, never reply
+                except OSError:
+                    continue
+
+        t = threading.Thread(target=_accept_and_stall, daemon=True)
+        t.start()
+        hang_url = f"http://127.0.0.1:{lsock.getsockname()[1]}"
+        agg = FleetAggregator(peers=(hang_url,), run_dir=run_dir,
+                              scrape_interval_s=0.1, timeout_s=0.5,
+                              stale_after_s=0.5, eval_interval_s=0.05)
+        try:
+            _wait_for(lambda: any(
+                p["errors"] for p in agg.peers() if p["static"]),
+                what="hanging peer timing out")
+            by_static = {p["static"]: p for p in agg.peers()}
+            assert by_static[True]["status"] != "ok"
+            assert by_static[True]["last_error"]
+            # the healthy peer is unaffected by the hung socket
+            _wait_for(lambda: any(
+                p["status"] == "ok" for p in agg.peers()
+                if not p["static"]), what="healthy peer scraped")
+            # and the merged views answer promptly, not after a hang
+            t0 = time.monotonic()
+            agg.merged_events()
+            agg.merged_metrics()
+            doc = agg.status()
+            assert time.monotonic() - t0 < 2.0
+            assert doc["n_stale"] >= 1
+        finally:
+            agg.close()
+            stop.set()
+            for c in held:
+                c.close()
+            lsock.close()
+
+    def test_dead_port_counts_errors_and_never_blocks(self, tmp_path):
+        # grab a port and close it: connection refused, not a hang
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        agg = FleetAggregator(peers=(f"http://127.0.0.1:{port}",),
+                              run_dir=str(tmp_path / "fleet"),
+                              scrape_interval_s=0.05, timeout_s=0.5)
+        try:
+            _wait_for(lambda: agg.peers()
+                      and agg.peers()[0]["errors"] >= 1,
+                      what="dead peer erroring")
+            p = agg.peers()[0]
+            assert p["status"] == "never" and p["scrapes"] == 0
+        finally:
+            agg.close()
+
+    def test_disabled_aggregator_is_inert(self):
+        agg = FleetAggregator(enabled=False)
+        assert agg.peers() == [] and agg.merged_events() == []
+        agg.close()
+
+    def test_snapshot_report_and_close_idempotent(self, local_peer,
+                                                  tmp_path):
+        run_dir, _srv, _chron = local_peer
+        snap = str(tmp_path / "FLEET_CONTROL.json")
+        agg = FleetAggregator(run_dir=run_dir, scrape_interval_s=0.1,
+                              timeout_s=2.0, snapshot_path=snap,
+                              job_name="fedtest")
+        try:
+            _wait_for(lambda: any(p["scrapes"] for p in agg.peers()),
+                      what="first scrape")
+            doc = agg.report()
+            assert doc["schema"] == FLEET_CONTROL_SCHEMA
+            assert doc["job_name"] == "fedtest"
+            assert "slo" in doc and "incidents" in doc
+            # strict JSON end to end (the artifact contract)
+            json.loads(json.dumps(doc, allow_nan=False))
+        finally:
+            agg.close()
+            agg.close()      # idempotent
+        with open(snap) as f:
+            on_disk = json.load(f)
+        assert on_disk["schema"] == FLEET_CONTROL_SCHEMA
+        assert on_disk["n_peers"] == 1
+
+    def test_aggregator_restart_resumes_cursors(self, local_peer):
+        """The per-peer cursor survives an aggregator restart (the
+        persisted-cursor file), so a new aggregator does not re-merge
+        the whole history from seq -1."""
+        run_dir, _srv, _chron = local_peer
+        agg = FleetAggregator(run_dir=run_dir, scrape_interval_s=0.1,
+                              timeout_s=2.0)
+        _wait_for(lambda: agg.peers()
+                  and agg.peers()[0]["cursor"] >= 0,
+                  what="cursor advancing")
+        cursor = agg.peers()[0]["cursor"]
+        agg.close()
+        agg2 = FleetAggregator(run_dir=run_dir, scrape_interval_s=0.1,
+                               timeout_s=2.0)
+        try:
+            assert agg2.peers()[0]["cursor"] == cursor
+        finally:
+            agg2.close()
+
+
+# ------------------------------------------------- subprocess e2e
+
+def _read_ready(proc, timeout_s=30.0):
+    """Read the simulate-peer banner; returns its obs-server url."""
+    line = [None]
+
+    def _reader():
+        for ln in proc.stdout:
+            if ln.startswith("PEER_READY"):
+                line[0] = ln.strip()
+                return
+
+    t = threading.Thread(target=_reader, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if line[0] is None:
+        proc.kill()
+        pytest.fail("simulate-peer never printed PEER_READY")
+    return line[0].split("url=", 1)[1]
+
+
+def _drain(proc):
+    """Keep the pipe from filling after the banner."""
+    threading.Thread(target=proc.stdout.read, daemon=True).start()
+
+
+class TestFederationE2E:
+    def test_three_rank_fleet_with_injected_fault(self, tmp_path):
+        """The acceptance scenario: 3 subprocess ranks on one run dir,
+        chaos SIGKILL chronicled on rank 2 (then the process REALLY
+        killed), skew anomalies on the others. The aggregator must
+        merge one strictly-ordered resumable timeline, rank-label the
+        whole merged scrape, root the cross-rank incident at the fault
+        rank, and degrade the killed peer to non-ok without blocking."""
+        run_dir = str(tmp_path / "fleet")
+        n, fault_rank, fault_step = 3, 2, 6
+        procs = [fed_mod._spawn_peer(
+            run_dir, rank, steps=24, step_ms=25.0,
+            bad_frac=(0.5 if rank == 1 else 0.0),
+            fault_step=fault_step, fault_rank=fault_rank,
+            linger_s=120.0) for rank in range(n)]
+        agg = None
+        try:
+            for p in procs:
+                _read_ready(p)
+                _drain(p)
+            agg = FleetAggregator(run_dir=run_dir,
+                                  scrape_interval_s=0.15, timeout_s=3.0,
+                                  stale_after_s=1.5,
+                                  eval_interval_s=0.1,
+                                  job_name="fed-e2e")
+            _wait_for(lambda: len([p for p in agg.peers()
+                                   if p["status"] == "ok"]) == n,
+                      what="all peers scraped")
+            # wait until the injected chaos event crossed the merge
+            _wait_for(lambda: any(e.get("kind") == "chaos"
+                                  for e in agg.merged_events()),
+                      what="chaos event merged")
+            # now REALLY kill the victim: the fleet view must show it
+            procs[fault_rank].send_signal(signal.SIGKILL)
+            procs[fault_rank].wait(timeout=10)
+            _wait_for(lambda: next(
+                p["status"] for p in agg.peers()
+                if p["rank"] == fault_rank) != "ok",
+                what="killed peer going stale")
+            # healthy ranks keep scraping; the views answer promptly
+            t0 = time.monotonic()
+            events = agg.merged_events()
+            status = agg.status()
+            assert time.monotonic() - t0 < 3.0
+            assert status["n_stale"] >= 1
+            assert {p["status"] for p in agg.peers()
+                    if p["rank"] != fault_rank} == {"ok"}
+
+            # merged timeline: all ranks, strictly ordered, resumable
+            assert {e["rank"] for e in events} == set(range(n))
+            keys = [fed_mod._order_key(e) for e in events]
+            assert keys == sorted(keys) and len(set(keys)) == len(keys)
+            mid = fed_mod._format_cursor(events[len(events) // 2])
+            resumed = agg.merged_events(cursor=mid)
+            assert resumed == events[len(events) // 2 + 1:]
+
+            # merged scrape: every family from every rank, all labelled
+            text = agg.merged_metrics()
+            samples = [ln for ln in text.splitlines()
+                       if ln and not ln.startswith("#")]
+            assert all("rank=" in ln for ln in samples)
+            for rank in range(n):
+                assert f'sim_steps_total{{rank="{rank}"}}' in text
+
+            # cross-rank incident: rooted at the injected fault's rank
+            # and step, with the other ranks' skew anomalies as members
+            inc_doc = agg.fleet_incidents()
+            incs = inc_doc["incidents"]
+            assert incs, "no cross-rank incident correlated"
+            fault_incs = [i for i in incs
+                          if (i["root_cause"].get("chaos") == "sigkill")]
+            assert fault_incs, f"no sigkill-rooted incident: {incs}"
+            rc = fault_incs[0]["root_cause"]
+            assert rc["rank"] == fault_rank
+            assert rc["step"] == fault_step
+            member_ranks = {e.get("rank")
+                            for e in fault_incs[0]["events"]}
+            assert member_ranks >= {r for r in range(n)
+                                    if r != fault_rank}
+        finally:
+            if agg is not None:
+                agg.close()
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                p.wait(timeout=10)
+
+    def test_sigkilled_rank_resumes_chronicle_numbering(self, tmp_path):
+        """Elastic resume: a rank SIGKILL'd mid-run and restarted on
+        the same run dir must keep its chronicle numbering (seq resume
+        off the on-disk stream, an elastic_resume lifecycle event) and
+        re-announce, so the aggregator follows it to the new port and
+        the merged timeline stays strictly ordered across the kill."""
+        run_dir = str(tmp_path / "fleet")
+        first = fed_mod._spawn_peer(run_dir, 0, steps=200, step_ms=25.0,
+                                    linger_s=120.0)
+        agg = None
+        second = None
+        try:
+            url1 = _read_ready(first)
+            _drain(first)
+            agg = FleetAggregator(run_dir=run_dir,
+                                  scrape_interval_s=0.15,
+                                  timeout_s=3.0, stale_after_s=1.0)
+            _wait_for(lambda: len(agg.merged_events()) >= 3,
+                      what="first incarnation merging")
+            first.send_signal(signal.SIGKILL)
+            first.wait(timeout=10)
+            second = fed_mod._spawn_peer(run_dir, 0, steps=6,
+                                         step_ms=25.0, linger_s=120.0)
+            url2 = _read_ready(second)
+            _drain(second)
+            assert url2 != url1
+            # the aggregator follows the re-announce to the new port
+            _wait_for(lambda: agg.peers()
+                      and agg.peers()[0]["url"] == url2
+                      and agg.peers()[0]["status"] == "ok",
+                      what="aggregator following the resumed peer")
+            # the second incarnation chronicled an elastic resume —
+            # proof it resumed numbering instead of restarting at 0
+            _wait_for(lambda: any(
+                e.get("phase") == "elastic_resume"
+                for e in agg.merged_events()),
+                what="elastic_resume event merged")
+            events = agg.merged_events()
+            keys = [fed_mod._order_key(e) for e in events]
+            assert keys == sorted(keys) and len(set(keys)) == len(keys)
+            # on-disk stream agrees: seqs strictly increase across the
+            # kill (never reset), and the resume event names the seam
+            stream = os.path.join(run_dir, "events_rank_00000.jsonl")
+            with open(stream) as f:
+                disk = [json.loads(ln) for ln in f if ln.strip()]
+            seqs = [e["seq"] for e in disk]
+            assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+            resume = next(e for e in disk
+                          if e.get("phase") == "elastic_resume")
+            assert "resumed after seq" in resume["detail"]
+        finally:
+            if agg is not None:
+                agg.close()
+            for p in (first, second):
+                if p is not None and p.poll() is None:
+                    p.kill()
+            for p in (first, second):
+                if p is not None:
+                    p.wait(timeout=10)
