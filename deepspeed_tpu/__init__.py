@@ -249,19 +249,24 @@ def init_inference(model, mp_size=1, mpu=None, checkpoint=None, dtype=None,
 
 
 def init_serving(model=None, engine=None, params=None, checkpoint=None,
-                 dtype=None, config=None, **kwargs):
+                 dtype=None, config=None, draft_params=None,
+                 draft_scales=None, **kwargs):
     """Create a continuous-batching serving engine (serving/server.py).
 
     Pass an existing ``InferenceEngine`` via ``engine``, or a model (+
     ``params``/``checkpoint``/``dtype``) and one is built through
     :func:`init_inference`. ``config`` is a ds-config dict whose
-    ``serving`` block sizes the paged KV cache and the slot batch."""
+    ``serving`` block sizes the paged KV cache and the slot batch.
+    ``draft_params`` supplies an explicit small draft model for
+    ``serving.speculative`` (omit it to self-draft from the target's
+    first layers)."""
     if engine is None:
         assert model is not None, "init_serving needs a model or an engine"
         engine = init_inference(model, params=params, checkpoint=checkpoint,
                                 dtype=dtype, **kwargs)
     from deepspeed_tpu.serving.server import ServingEngine
-    return ServingEngine(engine, config=config)
+    return ServingEngine(engine, config=config, draft_params=draft_params,
+                         draft_scales=draft_scales)
 
 
 def add_config_arguments(parser):

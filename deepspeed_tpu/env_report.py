@@ -108,6 +108,10 @@ def telemetry_report():
     row("serving prefix cache (COW)", True,
         "(serving.prefix_cache block; DS_SERVING_PREFIX_CACHE=1; "
         "refcounted block sharing + copy-on-write forks)")
+    row("serving speculative decode", True,
+        "(serving.speculative block; DS_SERVING_SPEC=1/0; truncated-layer "
+        "self-draft + one-dispatch verify, rejections booked as "
+        "drafted_rejected)")
     row("serving router (SLO-aware)", True,
         "(serving.router block; prefix-affinity placement + "
         "ttft_slo_breach failover across replicas)")

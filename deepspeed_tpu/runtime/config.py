@@ -823,6 +823,68 @@ class DeepSpeedServingPrefixCacheConfig(DeepSpeedConfigObject):
                 f"(0 = uncapped), got {self.capacity_blocks}")
 
 
+class DeepSpeedServingSpeculativeConfig(DeepSpeedConfigObject):
+    """``serving.speculative`` sub-block (serving/speculative.py):
+    draft/verify speculative decoding over the paged KV. The default
+    draft is the truncated-layer self-draft — ``draft_layers`` 0 picks
+    ``n_layer // 4`` (floor 1) at engine construction; ``draft_model``
+    null means self-draft (an explicit small model is handed to the
+    engine programmatically as ``draft_params``). ``acceptance``
+    "exact" keeps outputs bit-exact vs the non-speculative engine;
+    "typical" trades parity on sampled slots for acceptance.
+    ``acceptance_floor`` arms the observatory's ``speculation_waste``
+    rule.
+
+    Env override (sweep ergonomics): ``DS_SERVING_SPEC`` = 1/0
+    force-toggles ``enabled``."""
+
+    def __init__(self, serving_dict):
+        sp = serving_dict.get(C.SERVING_SPECULATIVE, {}) or {}
+        self.enabled = sp.get(C.SERVING_SPEC_ENABLED,
+                              C.SERVING_SPEC_ENABLED_DEFAULT)
+        self.k = int(sp.get(C.SERVING_SPEC_K, C.SERVING_SPEC_K_DEFAULT))
+        self.draft_layers = int(sp.get(C.SERVING_SPEC_DRAFT_LAYERS,
+                                       C.SERVING_SPEC_DRAFT_LAYERS_DEFAULT))
+        self.draft_model = sp.get(C.SERVING_SPEC_DRAFT_MODEL,
+                                  C.SERVING_SPEC_DRAFT_MODEL_DEFAULT)
+        self.acceptance = sp.get(C.SERVING_SPEC_ACCEPTANCE,
+                                 C.SERVING_SPEC_ACCEPTANCE_DEFAULT)
+        self.typical_threshold = float(
+            sp.get(C.SERVING_SPEC_TYPICAL_THRESHOLD,
+                   C.SERVING_SPEC_TYPICAL_THRESHOLD_DEFAULT))
+        self.acceptance_floor = float(
+            sp.get(C.SERVING_SPEC_ACCEPTANCE_FLOOR,
+                   C.SERVING_SPEC_ACCEPTANCE_FLOOR_DEFAULT))
+        env = os.environ.get("DS_SERVING_SPEC")
+        if env is not None:
+            self.enabled = env.lower() in ("1", "true", "yes", "on")
+        if self.k < 1:
+            raise DeepSpeedConfigError(
+                f"serving.speculative.k must be >= 1, got {self.k}")
+        if self.draft_layers < 0:
+            raise DeepSpeedConfigError(
+                f"serving.speculative.draft_layers must be >= 0 "
+                f"(0 = auto), got {self.draft_layers}")
+        if self.acceptance not in ("exact", "typical"):
+            raise DeepSpeedConfigError(
+                f"serving.speculative.acceptance must be 'exact' or "
+                f"'typical', got {self.acceptance!r}")
+        if not 0.0 < self.typical_threshold <= 1.0:
+            raise DeepSpeedConfigError(
+                f"serving.speculative.typical_threshold must be in "
+                f"(0, 1], got {self.typical_threshold}")
+        if not 0.0 <= self.acceptance_floor <= 1.0:
+            raise DeepSpeedConfigError(
+                f"serving.speculative.acceptance_floor must be in "
+                f"[0, 1], got {self.acceptance_floor}")
+        if self.draft_model is not None and not isinstance(
+                self.draft_model, str):
+            raise DeepSpeedConfigError(
+                f"serving.speculative.draft_model must be null "
+                f"(self-draft) or a string tag, got "
+                f"{type(self.draft_model).__name__}")
+
+
 class DeepSpeedServingRouterConfig(DeepSpeedConfigObject):
     """``serving.router`` sub-block (serving/router.py
     ``ServingRouter``): admission scoring weights over per-replica
@@ -888,6 +950,7 @@ class DeepSpeedServingConfig(DeepSpeedConfigObject):
         self.observability = DeepSpeedServingObservabilityConfig(s)
         self.prefix_cache = DeepSpeedServingPrefixCacheConfig(s)
         self.router = DeepSpeedServingRouterConfig(s)
+        self.speculative = DeepSpeedServingSpeculativeConfig(s)
         for env, attr in (("DS_SERVING_MAX_BATCH", "max_batch"),
                           ("DS_SERVING_BLOCK_SIZE", "block_size"),
                           ("DS_SERVING_PREFILL_CHUNK", "prefill_chunk")):

@@ -702,6 +702,40 @@ SERVING_ATTENTION_IMPL_DEFAULT = "paged"
 SERVING_DECODE_STEPS = "decode_steps"
 SERVING_DECODE_STEPS_DEFAULT = 1
 
+# serving.speculative: draft/verify speculative decoding
+# (serving/speculative.py). A cheap draft proposes `k` greedy tokens,
+# then ONE target forward verifies all k+1 positions and keeps the
+# longest accepted prefix — decode is weight-bandwidth-bound at small
+# batch, so the verify runs at near-single-token cost. `draft_layers`
+# 0 -> auto (n_layer // 4, floor 1) selects the truncated-layer
+# self-draft (the target's own first layers — zero extra weights);
+# `draft_model` null -> self-draft (an explicit small model is passed
+# programmatically as `draft_params`). `acceptance` "exact" keeps
+# greedy AND sampled outputs bit-exact vs the non-speculative engine;
+# "typical" relaxes sampled slots to `typical_threshold` x the modal
+# probability for higher acceptance. `acceptance_floor` arms the
+# observatory's speculation_waste rule (windowed acceptance below the
+# floor -> warn; the guardian can disable speculation as an action).
+# Replaces the decode program with exactly {1 draft, 1 verify}
+# programs; rejected tokens are booked into the slot-step ledger's
+# drafted_rejected category. DS_SERVING_SPEC=1/0 force-toggles
+# `enabled`.
+SERVING_SPECULATIVE = "speculative"
+SERVING_SPEC_ENABLED = "enabled"
+SERVING_SPEC_ENABLED_DEFAULT = False
+SERVING_SPEC_K = "k"                        # drafted tokens per dispatch
+SERVING_SPEC_K_DEFAULT = 4
+SERVING_SPEC_DRAFT_LAYERS = "draft_layers"  # 0 -> n_layer // 4 (min 1)
+SERVING_SPEC_DRAFT_LAYERS_DEFAULT = 0
+SERVING_SPEC_DRAFT_MODEL = "draft_model"    # null -> self-draft
+SERVING_SPEC_DRAFT_MODEL_DEFAULT = None
+SERVING_SPEC_ACCEPTANCE = "acceptance"      # "exact" | "typical"
+SERVING_SPEC_ACCEPTANCE_DEFAULT = "exact"
+SERVING_SPEC_TYPICAL_THRESHOLD = "typical_threshold"
+SERVING_SPEC_TYPICAL_THRESHOLD_DEFAULT = 0.3
+SERVING_SPEC_ACCEPTANCE_FLOOR = "acceptance_floor"
+SERVING_SPEC_ACCEPTANCE_FLOOR_DEFAULT = 0.35
+
 # serving.prefix_cache: block-level shared-prefix KV reuse
 # (serving/kv_cache.py PrefixCache). FULL prompt blocks are
 # content-addressed by a chain hash of (parent digest, token ids,

@@ -135,6 +135,7 @@ class Guardian:
         self.fp16_rescue_fn = None      # () -> detail str
         self.pause_fn = None            # (reason) -> None
         self.resume_fn = None           # () -> None
+        self.spec_disable_fn = None     # (reason) -> detail str
 
         self._lock = threading.Lock()
         self._queue = []                # (source, anomaly-dict) pending
@@ -338,11 +339,26 @@ class Guardian:
             return
         step = int(step)
         overload_rule = None
+        waste_rule = None
         if self._queue:
             for source, a in self._drain():
                 rule = a.get("rule", "?")
                 if rule in self.pause_rules:
                     overload_rule = rule
+                elif rule == "speculation_waste":
+                    waste_rule = rule
+        # sustained speculation waste -> turn speculation off. One-way by
+        # design: the fallback retraces once, and flapping back on would
+        # retrace again every flip — the owning engine only re-enables on
+        # restart. Cooldown still applies so a burst of windowed firings
+        # books a single action.
+        if (waste_rule is not None
+                and self.action_counts.get("serving_spec_disable", 0) == 0
+                and self._cooldown_ok("serving_spec_disable", step)):
+            self._act("serving_spec_disable", waste_rule, step,
+                      self.spec_disable_fn, waste_rule,
+                      detail="windowed acceptance below floor: draft work "
+                             "is being rejected faster than it pays off")
         if overload_rule is not None:
             self._last_overload_step = step
             if not self.admission_paused:

@@ -507,6 +507,43 @@ class PagedKVCache:
                 v_all.transpose(1, 0, 2, 3).astype(dt))
         return out
 
+    def write_first_layers(self, pools, k_all, v_all, block_ids, offsets,
+                           n_layers):
+        """Write the FIRST ``n_layers`` layers' K/V in one scatter apiece
+        — the truncated-layer self-draft's write (serving/speculative.py):
+        a draft that is the target's first ``n_layers`` layers produces
+        bit-identical K/V for those layers, so its speculative positions
+        land in the SAME pools and the verify pass simply overwrites all
+        layers at the accepted positions.
+
+        k_all/v_all: ``[n_layers, B, H, D]``; block_ids/offsets: ``[B]``
+        int32; ``n_layers`` is a static Python int (the static slice
+        keeps this the same one-scatter shape as
+        :meth:`write_all_layers`, just over a layer prefix)."""
+        n = int(n_layers)
+        if n == self.n_layer:
+            return self.write_all_layers(pools, k_all, v_all, block_ids,
+                                         offsets)
+        out = dict(pools)
+        if self.int8_kv:
+            kq, ks = quantize_kv(k_all)        # scales [n, B, H]
+            vq, vs = quantize_kv(v_all)
+            out["k"] = pools["k"].at[:n, block_ids, :, offsets, :].set(
+                kq.transpose(1, 0, 2, 3))
+            out["v"] = pools["v"].at[:n, block_ids, :, offsets, :].set(
+                vq.transpose(1, 0, 2, 3))
+            out["k_scale"] = pools["k_scale"].at[
+                :n, block_ids, :, offsets].set(ks.transpose(1, 0, 2))
+            out["v_scale"] = pools["v_scale"].at[
+                :n, block_ids, :, offsets].set(vs.transpose(1, 0, 2))
+        else:
+            dt = pools["k"].dtype
+            out["k"] = pools["k"].at[:n, block_ids, :, offsets, :].set(
+                k_all.transpose(1, 0, 2, 3).astype(dt))
+            out["v"] = pools["v"].at[:n, block_ids, :, offsets, :].set(
+                v_all.transpose(1, 0, 2, 3).astype(dt))
+        return out
+
     # ------------------------------------------------------ traced gather
     def gather(self, pools, layer, block_tables):
         """Block table -> contiguous per-slot cache views.

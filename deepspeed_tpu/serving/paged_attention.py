@@ -106,6 +106,76 @@ def paged_decode_attention(q, k_cur, v_cur, layer, k_pool, v_pool,
     return acc / l[..., None]
 
 
+def paged_verify_attention(q, k_chunk, v_chunk, layer, k_pool, v_pool,
+                           block_tables, past_lens, *, k_scale_pool=None,
+                           v_scale_pool=None, sm_scale=None):
+    """Speculative verify: ``C = K+1`` queries PER SLOT over each slot's
+    PAST pages plus the candidate chunk itself (registers, causal).
+
+    The batched cross of the two functions above: decode's ``[B, MB]``
+    block tables and per-slot ``past_lens``, prefill's multi-position
+    chunk with the intra-chunk causal piece merged from registers. One
+    program verifies K drafted tokens for every slot in a single target
+    forward — the pool writes stay deferred, so a rejected suffix never
+    has to be undone on-device.
+
+    q/k_chunk/v_chunk: ``[B, H, C, D]`` (query c sits at absolute
+    position ``past_lens[b] + c``); block_tables: ``[B, MB]`` int32;
+    past_lens: ``[B]`` int32 tokens ALREADY in the pool. Returns
+    ``[B, H, C, D]`` fp32.
+    """
+    B, H, C, D = q.shape
+    BS = k_pool.shape[3]
+    if sm_scale is None:
+        sm_scale = D ** -0.5
+    quantized = k_scale_pool is not None
+    qf = q.astype(jnp.float32)
+    n_blocks = ((jnp.max(past_lens) + BS - 1) // BS).astype(jnp.int32)
+
+    def body(i, carry):
+        m, l, acc = carry
+        ids = block_tables[:, i]                       # [B]
+        kb = k_pool[layer, ids]                        # [B, H, BS, D]
+        vb = v_pool[layer, ids]
+        if quantized:
+            kb = kb.astype(jnp.float32) \
+                * k_scale_pool[layer, ids][..., None]
+            vb = vb.astype(jnp.float32) \
+                * v_scale_pool[layer, ids][..., None]
+        else:
+            kb = kb.astype(jnp.float32)
+            vb = vb.astype(jnp.float32)
+        s = jnp.einsum("bhcd,bhsd->bhcs", qf, kb) * sm_scale
+        col = i * BS + jnp.arange(BS, dtype=jnp.int32)
+        s = jnp.where(col[None, None, None, :]
+                      < past_lens[:, None, None, None], s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[..., None] \
+            + jnp.einsum("bhcs,bhsd->bhcd", p, vb)
+        return m_new, l_new, acc
+
+    m0 = jnp.full((B, H, C), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, C), jnp.float32)
+    a0 = jnp.zeros((B, H, C, D), jnp.float32)
+    m_p, l_p, a_p = jax.lax.fori_loop(0, n_blocks, body, (m0, l0, a0))
+    # intra-chunk causal piece from registers: candidate e visible to
+    # query c iff e <= c; query 0 always sees itself, so l can never be 0
+    s_in = jnp.einsum("bhcd,bhed->bhce", qf,
+                      k_chunk.astype(jnp.float32)) * sm_scale
+    causal = (jnp.arange(C)[:, None] >= jnp.arange(C)[None, :])
+    s_in = jnp.where(causal[None, None], s_in, NEG_INF)
+    m_in = jnp.max(s_in, axis=-1)
+    p_in = jnp.exp(s_in - m_in[..., None])
+    l_in = jnp.sum(p_in, axis=-1)
+    a_in = jnp.einsum("bhce,bhed->bhcd", p_in,
+                      v_chunk.astype(jnp.float32))
+    _, l, acc = _merge(m_p, l_p, a_p, m_in, l_in, a_in)
+    return acc / l[..., None]
+
+
 def paged_prefill_attention(q, k_chunk, v_chunk, layer, k_pool, v_pool,
                             bt_row, pos, start, *, k_scale_pool=None,
                             v_scale_pool=None, sm_scale=None):

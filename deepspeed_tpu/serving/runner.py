@@ -242,17 +242,24 @@ class PagedGPT2Runner:
         return _dense(h, p["mlp"]["proj"], _sub(s, "mlp", "proj"))
 
     # ---------------------------------------------------------- programs
-    def _decode_one(self, params, scales, pools, bt, pos, live, tok,
-                    temp, top_p, lanes):
-        """One decode iteration over the slot batch: embed each live
-        slot's token at its own position, run the stack, write all
-        layers' K/V, sample."""
+    def _stack_decode(self, params, scales, pools, bt, pos, live, tok,
+                      n_layers=None):
+        """Embed each live slot's token at its own position, run the
+        first ``n_layers`` of the stack (default: all), write those
+        layers' K/V, and return ``(pools, logits)``.
+
+        ``n_layers < cfg.n_layer`` is the truncated-layer self-draft of
+        serving/speculative.py: the SAME params pytree traced over a
+        layer prefix (plus the shared ln_f and tied head) — zero extra
+        weights, and the prefix layers' K/V are bit-identical to the
+        target's, so draft writes land in the same pools."""
         cfg = self.cfg
         bs = self.cache.block_size
+        L = cfg.n_layer if n_layers is None else int(n_layers)
         x = params["wte"][tok] + params["wpe"][pos].astype(
             params["wte"].dtype)
         kv_stack = []
-        for layer in range(cfg.n_layer):
+        for layer in range(L):
             p = params[f"h_{layer}"]
             s = _sub(scales, f"h_{layer}")
             pools, a, kv = self._attn_decode(p, s, layer, x, pools, bt,
@@ -267,14 +274,23 @@ class PagedGPT2Runner:
             row = jnp.take_along_axis(bt, (pos // bs)[:, None],
                                       axis=1)[:, 0]
             blk = jnp.where(live, row, 0)
-            pools = self.cache.write_all_layers(
+            pools = self.cache.write_first_layers(
                 pools, jnp.stack([k for k, _ in kv_stack]),
-                jnp.stack([v for _, v in kv_stack]), blk, pos % bs)
+                jnp.stack([v for _, v in kv_stack]), blk, pos % bs, L)
         x = _ln(x, params["ln_f"])
         logits = jnp.einsum("be,ve->bv", x, params["wte"],
                             preferred_element_type=jnp.float32)
+        return pools, logits
+
+    def _decode_one(self, params, scales, pools, bt, pos, live, tok,
+                    temp, top_p, lanes):
+        """One decode iteration over the slot batch: embed each live
+        slot's token at its own position, run the stack, write all
+        layers' K/V, sample."""
+        pools, logits = self._stack_decode(params, scales, pools, bt,
+                                           pos, live, tok)
         nxt = sample_tokens(logits, temp, top_p, lanes, pos,
-                            vocab_size=cfg.vocab_size)
+                            vocab_size=self.cfg.vocab_size)
         return pools, nxt
 
     def _decode_impl(self, params, scales, pools, bt, pos, active, tok,

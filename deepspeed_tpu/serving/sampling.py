@@ -24,6 +24,20 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
+def fold_position_lanes(rng_lanes, positions):
+    """Fold each slot's POSITION into its key lane: ``[B, 2]`` uint32
+    lanes + ``[B]`` int32 positions -> ``[B, 2]`` folded keys.
+
+    This is THE randomness schedule of the serving engine: a token's draw
+    depends only on (request seed, absolute position), never on which
+    batch slot, decode_steps grouping, or draft/verify path produced it.
+    The decode scan and the speculative verify program both call this
+    helper, so speculative acceptance under sampling compares the SAME
+    draw sequential decoding would have made at that position.
+    """
+    return jax.vmap(jax.random.fold_in)(rng_lanes, positions)
+
+
 def top_p_filter(logits, top_p):
     """Nucleus filter. logits ``[B, V]`` fp32, top_p ``[B]`` in (0, 1];
     p >= 1 keeps everything. Returns filtered logits with non-nucleus
@@ -56,7 +70,7 @@ def sample_tokens(logits, temperature, top_p, rng_lanes, positions,
         safe_t = jnp.where(greedy, 1.0, temperature)
         scaled = logits / safe_t[:, None]
         filtered = top_p_filter(scaled, top_p)
-        folded = jax.vmap(jax.random.fold_in)(rng_lanes, positions)
+        folded = fold_position_lanes(rng_lanes, positions)
         sampled = jax.vmap(jax.random.categorical)(folded, filtered)
         return jnp.where(greedy, argmax, sampled).astype(jnp.int32)
 

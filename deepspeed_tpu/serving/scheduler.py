@@ -94,6 +94,19 @@ class Request:
     last_token_t: Optional[float] = None
     finish_t: Optional[float] = None
     step_budget: int = 0        # tokens the next decode dispatch may emit
+    # --- speculative decoding state (serving/speculative.py) ---
+    spec_drafted: int = 0       # draft tokens proposed for this request
+    spec_accepted: int = 0      # of those, accepted by the verify pass
+    # (rejected drafts roll back as a position edit: cached_len simply
+    # does not advance past the accepted prefix)
+
+    @property
+    def spec_acceptance_rate(self) -> Optional[float]:
+        """Per-request acceptance: accepted/drafted, None before any
+        draft was proposed (e.g. speculation off)."""
+        if not self.spec_drafted:
+            return None
+        return self.spec_accepted / self.spec_drafted
 
     @property
     def full_prompt(self) -> List[int]:

@@ -85,7 +85,8 @@ class RequestOutput:
 
 class ServingEngine:
     def __init__(self, engine, config=None, registry=None, use_flash=None,
-                 guardian=None, obs_server=None, slo=None):
+                 guardian=None, obs_server=None, slo=None,
+                 draft_params=None, draft_scales=None):
         """``engine``: an ``InferenceEngine`` wrapping a GPT-2-family
         model; ``config``: ``DeepSpeedServingConfig``, a ds-config dict
         (with or without the outer ``{"serving": ...}``), or ``None`` for
@@ -97,7 +98,12 @@ class ServingEngine:
         slo.py) — like the guardian they fall back to the wrapped
         engine's own, so an engine armed with ``telemetry.server`` /
         ``telemetry.slo`` config exposes the serving report as a scrape
-        route and burns the serving latency objectives automatically."""
+        route and burns the serving latency objectives automatically.
+        ``draft_params``/``draft_scales``: an explicitly configured
+        small draft model for ``serving.speculative`` (params pytree,
+        pool- and vocab-compatible with the target — see
+        serving/speculative.py); ``None`` selects the truncated-layer
+        self-draft."""
         from deepspeed_tpu.runtime.config import DeepSpeedServingConfig
         if config is None:
             config = DeepSpeedServingConfig({})
@@ -129,10 +135,36 @@ class ServingEngine:
             model, self.cache, use_flash=use_flash,
             attention_impl=config.attention_impl,
             decode_steps=config.decode_steps)
+        # speculative decoding (serving/speculative.py): replaces the
+        # decode dispatch with a draft + verify program pair. The
+        # scheduler's per-dispatch token budget (and the slot-step
+        # ledger's K basis) becomes k+1 — the verify width — so block
+        # growth covers every candidate position and the ledger's
+        # sums-exact invariant holds on both engines of an A/B.
+        spec_cfg = getattr(config, "speculative", None)
+        self.speculative = None
+        self._spec_disabled_rule = None       # None = speculation live
+        if spec_cfg is not None and spec_cfg.enabled:
+            from deepspeed_tpu.serving.speculative import (
+                SpeculativeDecoder, default_draft_layers,
+                validate_draft_params)
+            draft_layers = spec_cfg.draft_layers or default_draft_layers(
+                cfg.n_layer)
+            if draft_params is not None:
+                validate_draft_params(draft_params, engine.params,
+                                      draft_layers)
+            self.speculative = SpeculativeDecoder(
+                self.runner, k=spec_cfg.k, draft_layers=draft_layers,
+                acceptance=spec_cfg.acceptance,
+                typical_threshold=spec_cfg.typical_threshold,
+                draft_params=draft_params, draft_scales=draft_scales)
+        dispatch_tokens = (self.speculative.k + 1
+                           if self.speculative is not None
+                           else int(config.decode_steps))
         self.scheduler = ContinuousBatchingScheduler(
             self.cache, max_batch=self.max_batch,
             max_model_len=self.max_model_len,
-            decode_steps=config.decode_steps)
+            decode_steps=dispatch_tokens)
         self.registry = registry if registry is not None \
             else _metrics.get_registry()
         # serving observatory (telemetry/serving_observatory.py): pure
@@ -143,9 +175,12 @@ class ServingEngine:
         if obs_cfg is not None and obs_cfg.enabled:
             self.observatory = ServingObservatory.from_config(
                 obs_cfg, max_batch=self.max_batch,
-                decode_steps=int(config.decode_steps),
+                decode_steps=dispatch_tokens,
                 registry=self.registry,
-                engine_state_fn=self._engine_state)
+                engine_state_fn=self._engine_state,
+                spec_acceptance_floor=(
+                    spec_cfg.acceptance_floor
+                    if self.speculative is not None else None))
             self.scheduler.observer = self.observatory
         # guardian overload degradation (runtime/guardian.py): the SLO
         # monitor's anomalies feed the guardian, whose serving policy
@@ -158,6 +193,7 @@ class ServingEngine:
                 and self.guardian.serving_degrade:
             self.guardian.pause_fn = self._pause_admission
             self.guardian.resume_fn = self._resume_admission
+            self.guardian.spec_disable_fn = self._disable_speculation
             if self.observatory is not None:
                 self.observatory.on_anomaly = self.guardian.hook("serving")
         # mission-control plane (telemetry/obs_server.py + slo.py),
@@ -207,6 +243,15 @@ class ServingEngine:
         # scalars), never a third decode/prefill signature
         self._copy_fn = self._watch.wrap(self.runner.copy_block,
                                          name="serving_block_copy")
+        # speculative programs: separately named so the acceptance pin
+        # "exactly {1 draft, 1 verify}, 0 retraces" reads per-program
+        # signature counts, the same discipline as decode/prefill
+        self._draft_fn = self._verify_fn = None
+        if self.speculative is not None:
+            self._draft_fn = self._watch.wrap(
+                self.speculative.draft_step, name="serving_draft_step")
+            self._verify_fn = self._watch.wrap(
+                self.speculative.verify_step, name="serving_verify_step")
         self.prefill = ChunkedPrefill(self._prefill_fn,
                                       chunk_size=config.prefill_chunk)
         from jax.sharding import NamedSharding, PartitionSpec
@@ -225,7 +270,11 @@ class ServingEngine:
             f"{self.cache.allocator.num_usable}) "
             f"max_model_len={self.max_model_len} "
             f"prefill_chunk={self.prefill.chunk_size} "
-            f"kv={'int8' if int8_kv else 'native'}", ranks=[0])
+            f"kv={'int8' if int8_kv else 'native'}"
+            + (f" speculative=k{self.speculative.k}/"
+               f"L{self.speculative.draft_layers}"
+               f"{'(draft model)' if draft_params is not None else ''}"
+               if self.speculative is not None else ""), ranks=[0])
 
     # ------------------------------------------------------------ submit
     def submit(self, prompt, max_new_tokens=16, temperature=0.0,
@@ -344,6 +393,24 @@ class ServingEngine:
             "serving_admission_paused",
             "1 while the guardian has admission paused").set(0)
         log_dist("serving: admission RESUMED", ranks=[0])
+
+    def _disable_speculation(self, rule):
+        """Guardian degradation action (``speculation_waste``): windowed
+        acceptance collapsed below the configured floor, so every draft
+        dispatch is mostly rejected compute — fall back to the plain
+        decode program. One-way for the serving lifetime: acceptance is
+        a property of the traffic/draft pairing, and flapping between
+        program sets would retrace."""
+        if self.speculative is None or self._spec_disabled_rule is not None:
+            return
+        self._spec_disabled_rule = str(rule)
+        self.registry.gauge(
+            "serving_speculation_disabled",
+            "1 after the guardian disabled speculative decoding").set(1)
+        self._chronicle_serving("speculation_disable", severity="warning",
+                                rule=str(rule))
+        log_dist(f"serving: speculation DISABLED (rule {rule}); decode "
+                 f"falls back to the plain program", ranks=[0])
 
     def _fail_all_pending(self, reason):
         """Fail every waiting AND slotted request with *reason* —
@@ -491,13 +558,36 @@ class ServingEngine:
             top_p[i] = r.top_p
             lanes[i] = self._lanes[r.req_id]
             budget[i] = r.step_budget
+        spec = (self.speculative
+                if self._spec_disabled_rule is None else None)
         t0 = time.perf_counter_ns()
         with trace_span("serving_decode", batch=len(decode_slots)):
             with self.engine.mesh:
-                self.pools, toks = self._decode_fn(
-                    self.engine.params, self.engine.quant_scales,
-                    self.pools, bt, pos, active, tok, temp, top_p, lanes,
-                    budget)
+                if spec is not None:
+                    # draft -> verify, device-to-device: the drafted
+                    # tokens feed the verify program WITHOUT a host
+                    # round-trip, so the pair keeps decode's one-sync-
+                    # per-dispatch discipline
+                    dparams = (spec.draft_params
+                               if spec.draft_params is not None
+                               else self.engine.params)
+                    dscales = (spec.draft_scales
+                               if spec.draft_params is not None
+                               else self.engine.quant_scales)
+                    self.pools, drafted = self._draft_fn(
+                        dparams, dscales, self.pools, bt, pos, active,
+                        tok, budget)
+                    self.pools, accepted, toks = self._verify_fn(
+                        self.engine.params, self.engine.quant_scales,
+                        self.pools, bt, pos, active, drafted, tok, temp,
+                        top_p, lanes, budget)
+                else:
+                    self.pools, toks = self._decode_fn(
+                        self.engine.params, self.engine.quant_scales,
+                        self.pools, bt, pos, active, tok, temp, top_p,
+                        lanes, budget)
+            if spec is not None:
+                accepted = np.asarray(accepted)    # [B]
             toks = np.asarray(toks)        # [K, B]; the one host sync
         t1 = time.perf_counter_ns()
         now = time.perf_counter()
@@ -509,11 +599,59 @@ class ServingEngine:
             self.observatory.record_decode(
                 {i: (slots[i], int(budget[i])) for i in decode_slots},
                 t0, t1)
+        if spec is None:
+            for i in decode_slots:
+                delivered = self._deliver(slots[i],
+                                          toks[:budget[i], i].tolist(),
+                                          now)
+                if acts is not None:
+                    acts[i] = ("decode", delivered)
+            return
+        # speculative delivery: per slot, min(accepted+1, budget) tokens
+        # are real (accepted drafts + the target's bonus token); the
+        # rest ROLL BACK by simply not advancing cached_len — the stale
+        # pool bytes past the accepted point are masked by past_lens and
+        # overwritten by the next dispatch. drafted_rejected books the
+        # rejection cost into the slot-step ledger.
+        drafted_t = accepted_t = rejected_t = 0
         for i in decode_slots:
-            delivered = self._deliver(slots[i],
-                                      toks[:budget[i], i].tolist(), now)
+            r = slots[i]
+            b = int(budget[i])
+            cap = min(int(accepted[i]) + 1, b)
+            delivered = self._deliver(r, toks[:cap, i].tolist(), now)
+            considered = min(spec.k, max(b - 1, 0))
+            rejected = considered - (cap - 1)
+            r.spec_drafted += considered
+            r.spec_accepted += cap - 1
+            drafted_t += considered
+            accepted_t += cap - 1
+            rejected_t += rejected
             if acts is not None:
-                acts[i] = ("decode", delivered)
+                acts[i] = ("decode", delivered, rejected)
+        if drafted_t:
+            self.registry.counter(
+                "serving_spec_drafted_total",
+                "draft tokens proposed to the verify program").inc(
+                    drafted_t)
+            self.registry.counter(
+                "serving_spec_accepted_total",
+                "draft tokens the target accepted").inc(accepted_t)
+            if rejected_t:
+                self.registry.counter(
+                    "serving_spec_rejected_total",
+                    "draft tokens the target rejected (rolled back as "
+                    "a position edit)").inc(rejected_t)
+            drafted_c = self.registry.counter(
+                "serving_spec_drafted_total",
+                "draft tokens proposed to the verify program").value
+            accepted_c = self.registry.counter(
+                "serving_spec_accepted_total",
+                "draft tokens the target accepted").value
+            self.registry.gauge(
+                "serving_spec_acceptance_rate",
+                "cumulative accepted/drafted ratio of speculative "
+                "decoding").set(
+                    accepted_c / drafted_c if drafted_c else 0.0)
 
     def _deliver(self, req, tokens, now):
         """Hand a dispatch's tokens to the request (one token in
@@ -952,10 +1090,18 @@ class ServingEngine:
         """Signature counts per compiled entry point (the 'one decode
         program' acceptance guard reads this)."""
         per_fn = self._watch._per_fn
-        return {
+        stats = {
             "decode_signatures": len(
                 per_fn.get("serving_decode_step", {}).get("sigs", ())),
             "prefill_signatures": len(
                 per_fn.get("serving_prefill_chunk", {}).get("sigs", ())),
             "retraces": self._watch.retraces,
         }
+        if self.speculative is not None:
+            # only present with speculation configured, so the exact
+            # dict pins on the non-speculative arms stay exact
+            stats["draft_signatures"] = len(
+                per_fn.get("serving_draft_step", {}).get("sigs", ()))
+            stats["verify_signatures"] = len(
+                per_fn.get("serving_verify_step", {}).get("sigs", ()))
+        return stats

@@ -73,15 +73,18 @@ from deepspeed_tpu.telemetry import tracer as _tracer_mod
 from deepspeed_tpu.telemetry.health import json_safe
 from deepspeed_tpu.utils.logging import logger
 
-SERVING_HEALTH_SCHEMA = "deepspeed_tpu.serving_health/2"
+SERVING_HEALTH_SCHEMA = "deepspeed_tpu.serving_health/3"
 
 # cached_prefill: prompt tokens a chunk advanced for a request whose
 # prefix was partly served read-only from the prefix cache — useful
 # work, split out so hit-rate shows up in the ledger, not just counters
+# drafted_rejected: speculative draft positions the verify pass refused —
+# the booked price of speculation (distinct from frozen: the slot DID
+# run those positions through the target, they just didn't advance it)
 SLOT_CATEGORIES = ("decode_useful", "cached_prefill", "prefill",
-                   "recompute", "frozen", "idle")
+                   "recompute", "frozen", "idle", "drafted_rejected")
 # wasted = everything that burned a slot without advancing a request
-WASTE_CATEGORIES = ("recompute", "frozen", "idle")
+WASTE_CATEGORIES = ("recompute", "frozen", "idle", "drafted_rejected")
 
 RULE_SEVERITY = {
     "ttft_slo_breach": "warning",
@@ -89,6 +92,7 @@ RULE_SEVERITY = {
     "preemption_thrash": "warning",
     "decode_stall": "critical",
     "no_progress": "critical",
+    "speculation_waste": "warning",
 }
 _SEVERITY_ORDER = ("critical", "warning", "watch")
 
@@ -157,7 +161,8 @@ class SlotStepLedger:
     def account(self, acts, occupied):
         """Book one scheduler step. ``acts`` maps slot →
         ``("prefill"|"cached_prefill"|"recompute", n_valid)`` or
-        ``("decode", delivered)``;
+        ``("decode", delivered)`` or — with speculation on —
+        ``("decode", delivered, drafted_rejected)``;
         ``occupied`` is the set of slots still holding a request (a slot
         neither acted nor occupied is idle; occupied-but-unscheduled is
         frozen — an invariant breach worth seeing, not hiding)."""
@@ -169,8 +174,12 @@ class SlotStepLedger:
                 u["frozen" if i in occupied else "idle"] += K
             elif a[0] == "decode":
                 d = min(max(int(a[1]), 0), K)
+                # 3-tuple: the speculative engine splits the non-useful
+                # remainder into verify-rejected drafts vs frozen budget
+                r = min(max(int(a[2]), 0), K - d) if len(a) > 2 else 0
                 u["decode_useful"] += d
-                u["frozen"] += K - d
+                u["drafted_rejected"] += r
+                u["frozen"] += K - d - r
             else:
                 u[a[0]] += K
         self.steps += 1
@@ -219,7 +228,8 @@ class ServingObservatory:
                  warmup_windows=1, ttft_slo_ms=1000.0, ttft_breach_frac=0.5,
                  queue_growth_windows=3, preemption_thrash=8,
                  no_progress_steps=200, timeline_ring=64, window_ring=128,
-                 trace_lanes=True, registry=None, on_escalate=None,
+                 trace_lanes=True, spec_acceptance_floor=None,
+                 registry=None, on_escalate=None,
                  on_anomaly=None, engine_state_fn=None, log_fn=None):
         self.max_batch = int(max_batch)
         self.job_name = job_name
@@ -232,6 +242,10 @@ class ServingObservatory:
         self.preemption_thrash = int(preemption_thrash)
         self.no_progress_steps = int(no_progress_steps)
         self.trace_lanes = bool(trace_lanes)
+        # None = speculation off (or unguarded): the speculation_waste
+        # rule only arms when the server hands over a floor
+        self.spec_acceptance_floor = (None if spec_acceptance_floor is None
+                                      else float(spec_acceptance_floor))
         self.registry = registry
         self.on_escalate = on_escalate if on_escalate is not None \
             else _flush_trace
@@ -271,7 +285,8 @@ class ServingObservatory:
 
     @classmethod
     def from_config(cls, obs_config, max_batch, decode_steps=1,
-                    job_name="", registry=None, on_escalate=None,
+                    job_name="", spec_acceptance_floor=None,
+                    registry=None, on_escalate=None,
                     on_anomaly=None, engine_state_fn=None):
         """Build from a parsed ``serving.observability`` block
         (:class:`~deepspeed_tpu.runtime.config.
@@ -290,6 +305,7 @@ class ServingObservatory:
             timeline_ring=obs_config.timeline_ring,
             window_ring=obs_config.window_ring,
             trace_lanes=obs_config.trace_lanes,
+            spec_acceptance_floor=spec_acceptance_floor,
             registry=registry, on_escalate=on_escalate,
             on_anomaly=on_anomaly, engine_state_fn=engine_state_fn)
 
@@ -657,6 +673,29 @@ class ServingObservatory:
                           f"slot-units advanced any request — the "
                           f"scheduler's forward-progress invariant "
                           f"broke"})
+        # speculation_waste: the window's decode work split badly between
+        # kept tokens and verify-rejected drafts. Only armed when the
+        # server configured a floor (speculation on), and only judged on
+        # windows that actually speculated (rejections booked — an
+        # all-accepted window has nothing to complain about).
+        if self.spec_acceptance_floor is not None:
+            kept = window["slot_units"]["decode_useful"]
+            rej = window["slot_units"]["drafted_rejected"]
+            if rej > 0:
+                acc = kept / (kept + rej)
+                if acc < self.spec_acceptance_floor:
+                    anoms.append({
+                        "rule": "speculation_waste",
+                        "step": window["end_step"],
+                        "severity": RULE_SEVERITY["speculation_waste"],
+                        "acceptance": round(acc, 4),
+                        "detail": f"windowed speculative acceptance "
+                                  f"{acc:.1%} fell below the "
+                                  f"{self.spec_acceptance_floor:.0%} "
+                                  f"floor ({kept} kept vs {rej} "
+                                  f"rejected draft units) — draft work "
+                                  f"is costing more than it saves; the "
+                                  f"guardian can disable speculation"})
         if anoms:
             self._escalate(anoms)
 
@@ -707,6 +746,7 @@ class ServingObservatory:
                 "queue_growth_windows": self.queue_growth_windows,
                 "preemption_thrash": self.preemption_thrash,
                 "no_progress_steps": self.no_progress_steps,
+                "spec_acceptance_floor": self.spec_acceptance_floor,
             },
             "slot_ledger": self.ledger.as_dict(),
             "counters": {

@@ -43,6 +43,24 @@ slot-step categories stop summing exactly. A router section reports
 aggregate tok/s for 1 vs 2 cache-armed replicas behind the
 prefix-affinity ServingRouter on the same trace shape.
 
+A third, speculative A/B section replays the SAME decode-heavy trace
+through a wider model (n_embd 512 — the weight-bandwidth-bound regime
+the technique targets) with speculation off then on at max_batch 1 and
+4. The spec-off arm decodes ``k+1`` tokens per dispatch (the existing
+multi-token scan) so both arms amortise host dispatch over identical
+token counts — the measured win is draft-layers-vs-all-layers compute,
+not dispatch accounting. The bench model is random-init, so the
+truncated-layer self-draft is made representative the honest way: the
+attn/mlp output-projection kernels of every layer ABOVE ``draft_layers``
+are damped (x0.4), making the draft's layer-prefix dominate the target
+logits the same way a well-trained draft tracks its target (~97%
+measured acceptance, with real rejections booked). Greedy parity is
+asserted token-for-token between the arms, and the regen REFUSES an
+artifact where spec-on loses the 1.5x floor at either batch size, the
+steady state is not exactly {1 draft, 1 verify} programs / 0 retraces,
+either arm's slot-step categories stop summing exactly, no rejections
+were booked, or parity breaks.
+
 Run:  JAX_PLATFORMS=cpu python tests/perf/serving_bench.py        # laptop
       python tests/perf/serving_bench.py                          # TPU
 Env:  SERVING_BENCH_OUT (default SERVING_BENCH.json at the repo root),
@@ -54,6 +72,11 @@ Env:  SERVING_BENCH_OUT (default SERVING_BENCH.json at the repo root),
       SERVING_BENCH_PREFIX_N / _PREFIX_POOL / _PREFIX_LEN / _REUSE
       (shared-prefix trace: requests 64, pool 4, prefix length 96,
       reuse ratio 0.9), SERVING_BENCH_ROUTER_N (router trace size, 32),
+      SERVING_BENCH_SPEC_K (drafted tokens per dispatch, default 6),
+      SERVING_BENCH_SPEC_LAYERS (self-draft depth, default 1),
+      SERVING_BENCH_SPEC_DAMP (tail damping factor, default 0.4),
+      SERVING_BENCH_SPEC_GEN (tokens per request, default 96),
+      SERVING_BENCH_SPEC_REPS (best-of replays per arm, default 3),
       BENCH_OBS_SERVER=1 (opt-in: replay the timed trace once more with
       the live obs endpoint armed and a background scraper polling
       /metrics + /api/report/serving; records the measured tok/s delta
@@ -211,7 +234,8 @@ def slot_steps_of(srv, warm, max_batch, K):
     units = {c: units_all[c] - warm["slot_units"][c] for c in units_all}
     sched_steps = steps_all - warm["slot_steps"]
     total_units = sum(units.values())
-    wasted_units = units["idle"] + units["frozen"] + units["recompute"]
+    wasted_units = (units["idle"] + units["frozen"] + units["recompute"]
+                    + units.get("drafted_rejected", 0))
     return {
         "steps": sched_steps,
         "max_batch": max_batch,
@@ -293,6 +317,185 @@ def run_obs_scraped(eng, serving_cfg, trace):
         offs.append(run_off())
         ons.append(run_on())
     return min(offs), min(ons), dict(scrapes, pairs=len(offs))
+
+
+def _anatomy_shares(srv, trace):
+    """Per-category device-time shares from a bounded profiler capture
+    around live serving steps (``ServingEngine.profile_window``).
+    Work is queued first so the annotated steps execute real dispatches;
+    tolerates an unavailable profiler (CPU wheels without programmatic
+    capture) by reporting ``{"enabled": False}``."""
+    for r in trace[:2]:
+        srv.submit(r.prompt, max_new_tokens=r.gen)
+    rep = srv.profile_window(
+        steps=4, write=False,
+        out=os.path.join("/tmp", "serving_bench_spec_anatomy",
+                         "anatomy.json"))
+    while srv.scheduler.has_work():
+        srv.step()
+    srv.collect()
+    if not rep.get("enabled"):
+        return {"enabled": False, "reason": rep.get("reason")}
+    cats = rep.get("categories_s", {})
+    tot = sum(cats.values()) or 1.0
+    return {"enabled": True,
+            "shares": {c: round(v / tot, 4) for c, v in cats.items()}}
+
+
+def run_spec_arm(eng, max_batch, trace, k, draft_layers, spec, reps,
+                 anatomy=False):
+    """One speculative-A/B arm: a warm ServingEngine replayed ``reps``
+    times on the same trace (best-of timing — CPU scheduler hiccups
+    can neither fake nor mask the win), slot-step ledger read over the
+    whole timed window (sums stay exact by construction across reps).
+    The spec-off arm runs the plain multi-token scan at
+    ``decode_steps=k+1`` so both arms deliver identical tokens per
+    dispatch."""
+    from deepspeed_tpu.serving.server import ServingEngine
+    from deepspeed_tpu.telemetry.metrics import MetricsRegistry
+
+    cfg = {"max_batch": max_batch, "block_size": 32, "prefill_chunk": 64,
+           "max_model_len": 256, "attention_impl": "gather",
+           "decode_steps": 1 if spec else k + 1,
+           "observability": {
+               "enabled": True, "window": 32,
+               "ttft_slo_ms": 1e12, "preemption_thrash": 10 ** 9,
+               "no_progress_steps": 10 ** 9, "trace_lanes": False,
+               "snapshot_file": os.path.join(
+                   "/tmp", "serving_bench_spec_health.json")}}
+    if spec:
+        cfg["speculative"] = {"enabled": True, "k": k,
+                              "draft_layers": draft_layers}
+    srv = ServingEngine(eng, config=cfg, registry=MetricsRegistry())
+    srv.submit(trace[0].prompt[:9], max_new_tokens=2)
+    while srv.scheduler.has_work():
+        srv.step()
+    srv.collect()
+    warm_units, warm_steps = srv.observatory.ledger.totals()
+    warm = {"slot_units": warm_units, "slot_steps": warm_steps}
+    best, toks = None, None
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        rids = [srv.submit(r.prompt, max_new_tokens=r.gen)
+                for r in trace]
+        while srv.scheduler.has_work():
+            srv.step()
+        elapsed = time.perf_counter() - t0
+        outs = {o.req_id: o for o in srv.collect()}
+        assert set(rids) == set(outs), "spec trace must fully drain"
+        toks = [outs[r].tokens for r in rids]
+        best = elapsed if best is None else min(best, elapsed)
+    useful = sum(r.gen for r in trace)
+    # ledger/stats read BEFORE the anatomy window so profiling steps
+    # don't leak into the timed attribution
+    slots = slot_steps_of(srv, warm, max_batch, k + 1)
+    arm = {
+        "elapsed_s": round(best, 4),
+        "tok_s": round(useful / best, 1),
+        "slot_steps": slots,
+        "compile": srv.compile_stats(),
+    }
+    if spec:
+        snap = srv.registry.snapshot()
+        drafted = snap["serving_spec_drafted_total"][0]["value"]
+        accepted = snap["serving_spec_accepted_total"][0]["value"]
+        arm["drafted"] = int(drafted)
+        arm["accepted"] = int(accepted)
+        arm["rejected"] = int(drafted - accepted)
+        arm["acceptance_rate"] = round(accepted / max(1, drafted), 4)
+    if anatomy:
+        arm["profile_window"] = _anatomy_shares(srv, trace)
+    srv.close()
+    return arm, toks
+
+
+def run_spec_section(kv):
+    """The speculative off/on A/B at bs in {1, 4}: dedicated wide model
+    (n_embd 512 — per-step compute dominated by streaming the weight
+    matrices, the regime where skipping 7 of 8 layers for drafted
+    tokens pays), tail-damped above ``draft_layers`` so the self-draft
+    is representative of a trained draft's acceptance."""
+    import copy
+
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+
+    k = int(os.environ.get("SERVING_BENCH_SPEC_K", "6"))
+    draft_layers = int(os.environ.get("SERVING_BENCH_SPEC_LAYERS", "1"))
+    damp = float(os.environ.get("SERVING_BENCH_SPEC_DAMP", "0.4"))
+    gen = int(os.environ.get("SERVING_BENCH_SPEC_GEN", "96"))
+    reps = int(os.environ.get("SERVING_BENCH_SPEC_REPS", "3"))
+    cfg = GPT2Config(vocab_size=512, n_positions=256, n_embd=512,
+                     n_layer=8, n_head=8, kv_cache_dtype=kv)
+    model = GPT2LMHeadModel(cfg)
+    params = jax.device_get(model.init(
+        jax.random.PRNGKey(0),
+        {"input_ids": jnp.zeros((1, 8), jnp.int32)})["params"])
+    params = copy.deepcopy(params)
+    for i in range(draft_layers, cfg.n_layer):
+        for blk, w in (("attn", "proj"), ("mlp", "proj")):
+            params[f"h_{i}"][blk][w]["kernel"] = (
+                params[f"h_{i}"][blk][w]["kernel"] * damp)
+    eng = deepspeed_tpu.init_inference(
+        model, params=jax.device_put(params), dtype=jnp.float32)
+
+    rng = np.random.default_rng(5)
+
+    def mk_trace(n):
+        return [TraceReq(rng.integers(
+            0, cfg.vocab_size,
+            (int(rng.integers(8, 33)),)).astype(np.int32), gen)
+            for _ in range(n)]
+
+    runs = []
+    for max_batch, n_req in ((1, 4), (4, 12)):
+        trace = mk_trace(n_req)
+        anatomy = max_batch == 4         # one capture pair is plenty
+        off, off_toks = run_spec_arm(eng, max_batch, trace, k,
+                                     draft_layers, False, reps,
+                                     anatomy=anatomy)
+        on, on_toks = run_spec_arm(eng, max_batch, trace, k,
+                                   draft_layers, True, reps,
+                                   anatomy=anatomy)
+        parity = all(np.array_equal(a, b)
+                     for a, b in zip(off_toks, on_toks))
+        run = {
+            "max_batch": max_batch,
+            "n_requests": n_req,
+            "useful_tokens": sum(r.gen for r in trace),
+            "tok_s": {"spec_off": off["tok_s"], "spec_on": on["tok_s"]},
+            "speedup": round(on["tok_s"] / off["tok_s"], 3),
+            "acceptance_rate": on["acceptance_rate"],
+            "drafted": on["drafted"],
+            "accepted": on["accepted"],
+            "rejected": on["rejected"],
+            "drafted_rejected_units":
+                on["slot_steps"]["units"]["drafted_rejected"],
+            "greedy_parity": parity,
+            "slot_steps": {"spec_off": off["slot_steps"],
+                           "spec_on": on["slot_steps"]},
+            "compile": {"spec_off": off["compile"],
+                        "spec_on": on["compile"]},
+        }
+        if anatomy:
+            run["profile_window"] = {
+                "spec_off": off["profile_window"],
+                "spec_on": on["profile_window"]}
+        runs.append(run)
+    return {
+        "config": {
+            "k": k, "draft_layers": draft_layers, "acceptance": "exact",
+            "tail_damp": damp, "gen_len": gen, "reps": reps,
+            "model": {"n_embd": cfg.n_embd, "n_layer": cfg.n_layer,
+                      "n_positions": cfg.n_positions,
+                      "vocab_size": cfg.vocab_size},
+            "spec_off_decode_steps": k + 1,
+        },
+        "runs": runs,
+    }
 
 
 def run_router(eng, serving_cfg, trace, n_replicas, make_registry):
@@ -487,8 +690,11 @@ def main():
                  for n in (1, 2)],
     }
 
+    # ---- speculative off/on A/B (dedicated bandwidth-bound model)
+    spec_section = run_spec_section(kv)
+
     doc = {
-        "schema": "deepspeed_tpu.serving_bench/3",
+        "schema": "deepspeed_tpu.serving_bench/4",
         "scenario": {
             "model": name, "n_embd": cfg.n_embd, "n_layer": cfg.n_layer,
             "backend": jax.default_backend(), "kv_cache": kv,
@@ -533,6 +739,7 @@ def main():
         },
         "prefix_cache": prefix_section,
         "router": router_section,
+        "speculative": spec_section,
     }
     doc["speedup"] = round(doc["serving"]["tok_s"]
                            / doc["baseline"]["tok_s"], 3)
@@ -586,6 +793,42 @@ def main():
     if pc_compile["decode_signatures"] != 1 or pc_compile["retraces"]:
         print("REFUSING to write artifact: cache-on run's decode "
               f"program count != 1 ({pc_compile})", file=sys.stderr)
+        sys.exit(1)
+    for run in spec_section["runs"]:
+        bs = run["max_batch"]
+        if run["speedup"] < 1.5:
+            print("REFUSING to write artifact: speculation gave only "
+                  f"{run['speedup']}x at max_batch={bs} — below the "
+                  "1.5x acceptance floor at bs<=4", file=sys.stderr)
+            sys.exit(1)
+        if not run["greedy_parity"]:
+            print("REFUSING to write artifact: speculative tokens "
+                  f"diverged from the plain greedy stream at "
+                  f"max_batch={bs} — lossless acceptance broke",
+                  file=sys.stderr)
+            sys.exit(1)
+        sc = run["compile"]["spec_on"]
+        if (sc.get("draft_signatures") != 1
+                or sc.get("verify_signatures") != 1
+                or sc["decode_signatures"] != 0 or sc["retraces"]):
+            print("REFUSING to write artifact: speculative steady state "
+                  f"is not exactly {{1 draft, 1 verify}} programs / 0 "
+                  f"retraces at max_batch={bs} ({sc})", file=sys.stderr)
+            sys.exit(1)
+        for label in ("spec_off", "spec_on"):
+            ss = run["slot_steps"][label]
+            if not ss["sums_exact"]:
+                print(f"REFUSING to write artifact: {label} slot-step "
+                      f"categories sum to {ss['total_units']} units at "
+                      f"max_batch={bs}, expected "
+                      f"{ss['expected_units']} — the by-construction "
+                      "invariant broke", file=sys.stderr)
+                sys.exit(1)
+    if not any(run["rejected"] > 0 for run in spec_section["runs"]):
+        print("REFUSING to write artifact: no drafted token was ever "
+              "rejected — the artifact must demonstrate speculation "
+              "cost being booked, not a draft that never misses",
+              file=sys.stderr)
         sys.exit(1)
     out = os.environ.get("SERVING_BENCH_OUT") or os.path.join(
         os.path.dirname(__file__), "..", "..", "SERVING_BENCH.json")

@@ -36,6 +36,12 @@ Asserts:
   ONE compiled decode program with zero retraces and zero extra backend
   compiles, the slot-step ledger's integer categories sum to
   steps x max_batch x decode_steps, and the disabled path is inert;
+* ``serving.speculative``: a speculative serving trace with observatory
+  AND chronicle armed runs decode through exactly TWO compiled programs
+  (one draft, one verify — zero plain-decode signatures), zero
+  retraces, zero extra backend compiles in steady state, and the
+  slot-step ledger (now carrying ``drafted_rejected``) still sums to
+  steps x max_batch x (k+1) exactly;
 * ``telemetry.fleet``: the fleet recorder is statically host-only
   outside its CLI demo and the one traced desync builder; with fleet
   shipping AND the desync sentinel armed the train step still compiles
@@ -525,6 +531,102 @@ def check_serving_obs_zero_extra_compiles():
           f"backend compiles with observability on; ledger "
           f"{sum(units.values())} units == {steps} steps x "
           f"{led.max_batch} x K={led.K}; disabled path inert")
+
+
+def check_spec_zero_extra_compiles():
+    """ISSUE-20 acceptance guard: SPECULATIVE serving with the full
+    observability plane armed (observatory + chronicle) runs the decode
+    path through exactly TWO compiled programs — one draft, one verify —
+    with ZERO retraces and zero plain-decode signatures, and a second
+    differently-shaped request wave adds exactly zero backend compiles.
+    The slot-step ledger's integer categories (now including
+    ``drafted_rejected``) still sum to steps x max_batch x (k+1)
+    exactly."""
+    import tempfile
+
+    import numpy as np
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+    from deepspeed_tpu.serving.server import ServingEngine
+    from deepspeed_tpu.telemetry import chronicle as chron_mod
+    from deepspeed_tpu.telemetry import compile_watch
+    from deepspeed_tpu.telemetry.chronicle import (RunChronicle,
+                                                   set_chronicle)
+    from deepspeed_tpu.telemetry.metrics import MetricsRegistry
+    from deepspeed_tpu.utils import groups
+    groups.destroy()
+    groups.initialize()
+    cfg = GPT2Config(vocab_size=256, n_positions=128, n_embd=32,
+                     n_layer=4, n_head=2)
+    model = GPT2LMHeadModel(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        {"input_ids": jnp.zeros((1, 8), jnp.int32)})["params"]
+    eng = deepspeed_tpu.init_inference(model, params=params,
+                                       dtype=jnp.float32)
+    registry = MetricsRegistry()
+    tmp = tempfile.mkdtemp(prefix="ds_srv_spec_")
+    set_chronicle(RunChronicle(run_dir=tmp, enabled=True))
+    srv = ServingEngine(eng, config={
+        "max_batch": 3, "block_size": 8, "prefill_chunk": 6,
+        "speculative": {"enabled": True, "k": 3},
+        "observability": {"enabled": True, "window": 4,
+                          "snapshot_file": os.path.join(
+                              tmp, "SERVING_HEALTH.json")}},
+        registry=registry)
+    assert srv.speculative is not None and srv.observatory is not None
+    assert chron_mod.get_chronicle().enabled, "chronicle must be armed"
+
+    def backend_compiles():
+        return sum(m.value for ms in registry.collect().values()
+                   for m in ms if m.name == "xla_backend_compiles_total")
+
+    compile_watch.install_global_listener(registry)
+    try:
+        rng = np.random.default_rng(7)
+        for plen, gen in ((9, 8), (3, 12), (17, 6)):    # warm all programs
+            srv.submit(rng.integers(0, cfg.vocab_size, (plen,)), gen)
+        srv.serve_forever()
+        after_warm = backend_compiles()
+        spec_steps = 0
+        for plen, gen in ((13, 9), (2, 5), (27, 11), (5, 7), (21, 8)):
+            srv.submit(rng.integers(0, cfg.vocab_size, (plen,)), gen)
+        while srv.scheduler.has_work() and spec_steps < 64:
+            srv.step()
+            spec_steps += 1
+        assert spec_steps >= 20 or not srv.scheduler.has_work(), \
+            "trace ended before exercising steady-state speculation"
+        assert backend_compiles() == after_warm, (
+            "speculative serving recompiled in steady state — draft + "
+            "verify must stay two fixed programs")
+    finally:
+        compile_watch.uninstall_global_listener()
+        chron_mod.reset_chronicle()
+    stats = srv.compile_stats()
+    assert stats == {"decode_signatures": 0, "prefill_signatures": 1,
+                     "retraces": 0, "draft_signatures": 1,
+                     "verify_signatures": 1}, stats
+    led = srv.observatory.ledger
+    units, steps = led.totals()
+    assert led.K == srv.speculative.k + 1, \
+        "the ledger's K basis must be the verify width k+1"
+    assert sum(units.values()) == steps * led.max_batch * led.K, (
+        f"slot-step ledger lost units under speculation: {units} over "
+        f"{steps} steps")
+    snap = registry.snapshot()
+    drafted = snap["serving_spec_drafted_total"][0]["value"]
+    accepted = snap["serving_spec_accepted_total"][0]["value"]
+    assert drafted > 0 and 0 < accepted <= drafted, (drafted, accepted)
+    srv.close()
+    print(f"speculative serving: exactly {{1 draft, 1 verify}} programs, "
+          f"0 retraces, 0 extra backend compiles over {steps} armed "
+          f"steps; ledger {sum(units.values())} units == {steps} x "
+          f"{led.max_batch} x K={led.K}; acceptance "
+          f"{accepted / drafted:.0%}")
 
 
 def check_fleet_zero_extra_compiles(steps=20, cadence=5):
@@ -1280,6 +1382,7 @@ def main(iters=200_000):
     check_comm_overlap_zero_extra_compiles()
     check_serving_obs_no_device_access()
     check_serving_obs_zero_extra_compiles()
+    check_spec_zero_extra_compiles()
     check_fleet_no_device_access()
     check_fleet_zero_extra_compiles()
     check_fleet_disabled_inert()

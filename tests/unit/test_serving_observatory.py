@@ -76,8 +76,29 @@ def test_ledger_sums_by_construction():
     # step 2: slot0 4 useful, slot1 idle, slot2 frozen; step 3: 12 idle
     assert units == {"decode_useful": 7, "cached_prefill": 0,
                      "prefill": 4, "recompute": 0, "frozen": 5,
-                     "idle": 20}
+                     "idle": 20, "drafted_rejected": 0}
     assert led.wasted_fraction() == (5 + 20) / 36
+
+
+def test_ledger_speculative_three_tuple_acts():
+    """Speculative decode acts carry (delivered, rejected); the rejected
+    drafts book into drafted_rejected, the un-dispatched remainder stays
+    frozen, and the by-construction sum survives."""
+    led = SlotStepLedger(max_batch=2, decode_steps=4)       # K = k+1 = 4
+    led.account({0: ("decode", 2, 1), 1: ("decode", 4, 0)}, occupied={0, 1})
+    led.account({0: ("decode", 1, 3)}, occupied={0})
+    units, steps = led.totals()
+    assert sum(units.values()) == steps * 2 * 4             # EXACT
+    assert units["decode_useful"] == 7
+    assert units["drafted_rejected"] == 4
+    assert units["frozen"] == 1        # step-1 slot-0 cap remainder
+    assert units["idle"] == 4          # slot 1 unoccupied in step 2
+    # rejected clamps into the K - delivered remainder
+    led2 = SlotStepLedger(max_batch=1, decode_steps=3)
+    led2.account({0: ("decode", 2, 9)}, occupied={0})
+    u2, _ = led2.totals()
+    assert u2 == {**{c: 0 for c in SLOT_CATEGORIES},
+                  "decode_useful": 2, "drafted_rejected": 1}
 
 
 def test_ledger_recompute_and_clamps():
@@ -326,7 +347,7 @@ def test_snapshot_is_strict_json(tmp_path):
     with open(path) as f:
         doc = json.load(f, parse_constant=lambda tok: pytest.fail(
             f"snapshot carries bare {tok!r}"))
-    assert doc["schema"] == "deepspeed_tpu.serving_health/2"
+    assert doc["schema"] == "deepspeed_tpu.serving_health/3"
     assert doc["anomalies"]
 
 
@@ -575,7 +596,7 @@ def test_e2e_livelock_error_carries_report(obs_serving):
         srv.serve_forever()
     err = ei.value
     assert "no progress" in str(err) and ".report" in str(err)
-    assert err.report["schema"] == "deepspeed_tpu.serving_health/2"
+    assert err.report["schema"] == "deepspeed_tpu.serving_health/3"
     st = err.report["engine_state"]["scheduler"]
     # last rites ran BEFORE the report: nothing is left pending, the
     # stuck request finished with the structured livelock reason
